@@ -1,0 +1,42 @@
+"""Drop-in alias: ``import distkeras`` → ``distkeras_tpu``.
+
+Reference users wrote ``from distkeras.trainers import ADAG`` etc.
+(reference package ``distkeras/``); this alias keeps those imports working
+verbatim against the TPU-native rebuild.
+"""
+
+import sys
+
+import distkeras_tpu
+from distkeras_tpu import *  # noqa: F401,F403
+from distkeras_tpu import (
+    data,
+    datasets,
+    model,
+    models,
+    ops,
+    parallel,
+    trainers,
+    transformers,
+    utils,
+)
+
+__version__ = distkeras_tpu.__version__
+
+# Register submodules so `import distkeras.trainers` / `from distkeras.utils
+# import serialize_keras_model` resolve exactly like the reference layout.
+for _name in (
+    "trainers", "utils", "data", "datasets", "model", "models", "ops",
+    "parallel", "transformers",
+):
+    sys.modules[f"distkeras.{_name}"] = getattr(distkeras_tpu, _name)
+
+
+def __getattr__(name):
+    # Late-bound modules (predictors, evaluators, workers, parameter_servers,
+    # networking, job_deployment) resolve on first access.
+    import importlib
+
+    mod = importlib.import_module(f"distkeras_tpu.{name}")
+    sys.modules[f"distkeras.{name}"] = mod
+    return mod

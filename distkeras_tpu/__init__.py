@@ -1,0 +1,55 @@
+"""distkeras_tpu — TPU-native rebuild of dist-keras (CAOYUE19930616/dist-keras).
+
+The reference framework is data-parallel distributed training of Keras models on
+Apache Spark: replicas are placed with ``rdd.mapPartitionsWithIndex`` and exchange
+weights with a driver-hosted TCP-socket parameter server
+(reference: ``distkeras/trainers.py``, ``distkeras/workers.py``,
+``distkeras/parameter_servers.py``, ``distkeras/networking.py`` — cited at
+module/symbol granularity throughout this repo because the reference mount was
+empty at survey time; see SURVEY.md §0).
+
+This rebuild keeps the trainer API surface
+(``SingleTrainer, ADAG, DOWNPOUR, AEASGD, EAMSGD, DynSGD``) but is TPU-first:
+
+- one SPMD replica per chip over a ``jax.sharding.Mesh`` (axis ``'dp'``) instead
+  of Spark executors;
+- the pull/commit parameter exchange is lowered to XLA collectives
+  (``psum``/``pmean`` over ICI) executed as each algorithm's *merge rule* at
+  communication-window boundaries (``distkeras_tpu.parallel``);
+- an optional genuinely-asynchronous parameter-server backend (host threads +
+  TCP, ``distkeras_tpu.parameter_servers``) preserves the reference's async
+  semantics for multi-slice/DCN deployments.
+
+``import distkeras`` is provided as a drop-in alias package.
+"""
+
+import os
+
+# The reference ran Keras on Theano/TF1; this rebuild runs Keras 3 on JAX.
+# Must be set before `import keras` anywhere in the process.
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+__version__ = "0.1.0"
+
+from distkeras_tpu import utils  # noqa: E402
+from distkeras_tpu.trainers import (  # noqa: E402
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    DynSGD,
+    EAMSGD,
+    SingleTrainer,
+    Trainer,
+)
+
+__all__ = [
+    "ADAG",
+    "AEASGD",
+    "DOWNPOUR",
+    "DynSGD",
+    "EAMSGD",
+    "SingleTrainer",
+    "Trainer",
+    "utils",
+    "__version__",
+]

@@ -1,0 +1,167 @@
+"""Column-batch dataset — the Spark DataFrame replacement.
+
+In the reference, training data lived in a Spark DataFrame whose RDD was
+repartitioned to ``num_workers`` partitions; each partition became one worker's
+shard (reference ``distkeras/trainers.py``, ``rdd.repartition`` +
+``mapPartitionsWithIndex``; SURVEY.md §1). On TPU the same role is played by a
+host-side column store that assembles *superbatches* shaped
+``[num_workers, window, batch, …]`` — the leading worker axis is sharded over
+the ``dp`` mesh axis so each chip receives exactly its own shard, and the
+``window`` axis is consumed by ``lax.scan`` inside one jitted step (no
+host↔device transfer inside the window).
+
+Rows are never materialized as Python objects: all columns are contiguous
+NumPy arrays, shuffles are index permutations, and shard assembly is a single
+reshape/transpose — the host never becomes the bottleneck the Spark driver was.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Mapping, Sequence
+
+import numpy as np
+
+
+class Dataset:
+    """Immutable named-column store (all columns share the leading row count)."""
+
+    def __init__(self, columns: Mapping[str, np.ndarray]):
+        if not columns:
+            raise ValueError("Dataset needs at least one column")
+        lengths = {k: len(v) for k, v in columns.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"column length mismatch: {lengths}")
+        self._columns = {k: np.asarray(v) for k, v in columns.items()}
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_arrays(cls, features, labels, features_col="features", label_col="label"):
+        return cls({features_col: features, label_col: labels})
+
+    # -- basic frame ops ----------------------------------------------------
+
+    @property
+    def columns(self) -> list[str]:
+        return list(self._columns)
+
+    def __len__(self) -> int:
+        return len(next(iter(self._columns.values())))
+
+    num_rows = property(__len__)
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self._columns[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._columns
+
+    def with_column(self, name: str, values: np.ndarray) -> "Dataset":
+        cols = dict(self._columns)
+        cols[name] = np.asarray(values)
+        return Dataset(cols)
+
+    def select(self, names: Sequence[str]) -> "Dataset":
+        return Dataset({n: self._columns[n] for n in names})
+
+    def drop(self, name: str) -> "Dataset":
+        return Dataset({k: v for k, v in self._columns.items() if k != name})
+
+    def take(self, n: int) -> "Dataset":
+        return Dataset({k: v[:n] for k, v in self._columns.items()})
+
+    def gather(self, idx: np.ndarray) -> "Dataset":
+        return Dataset({k: v[idx] for k, v in self._columns.items()})
+
+    def concat(self, other: "Dataset") -> "Dataset":
+        return Dataset(
+            {k: np.concatenate([v, other[k]]) for k, v in self._columns.items()}
+        )
+
+    def split(self, fraction: float, seed: int = 0) -> tuple["Dataset", "Dataset"]:
+        """Random train/test split. Parity: Spark ``df.randomSplit``."""
+        n = len(self)
+        perm = np.random.default_rng(seed).permutation(n)
+        cut = int(n * fraction)
+        return self.gather(perm[:cut]), self.gather(perm[cut:])
+
+    def shuffle(self, seed: int = 0) -> "Dataset":
+        """Full shuffle as an index permutation.
+
+        Parity: reference ``distkeras/utils.py :: shuffle(df)``.
+        """
+        perm = np.random.default_rng(seed).permutation(len(self))
+        return self.gather(perm)
+
+    # -- sharding / batching -------------------------------------------------
+
+    def superbatches(
+        self,
+        num_workers: int,
+        batch_size: int,
+        window: int,
+        columns: Sequence[str],
+        *,
+        seed: int | None = None,
+        drop_remainder: bool = True,
+    ) -> Iterator[tuple[np.ndarray, ...]]:
+        """Yield one epoch of superbatches ``[num_workers, window, batch, …]``.
+
+        This is the rebuilt ``rdd.repartition(num_workers)`` +
+        per-partition minibatch assembly (reference ``distkeras/workers.py``):
+        a worker's row range plays the role of its Spark partition. With
+        ``drop_remainder=True`` (default) rows left over after filling whole
+        superbatches are dropped (the reference's partition tails were likewise
+        truncated to whole minibatches); with ``drop_remainder=False`` the tail
+        superbatch is filled by wrapping around to the start, so every row
+        appears at least once (some up to twice) — XLA shapes stay static.
+        """
+        n = len(self)
+        rows_per_super = num_workers * batch_size * window
+        n_super = n // rows_per_super
+        if drop_remainder:
+            if n_super == 0:
+                raise ValueError(
+                    f"dataset of {n} rows too small for one superbatch of "
+                    f"{rows_per_super} rows (workers={num_workers} × "
+                    f"window={window} × batch={batch_size})"
+                )
+        else:
+            n_super = -(-n // rows_per_super)  # ceil: cover every row
+        idx = (
+            np.random.default_rng(seed).permutation(n)
+            if seed is not None
+            else np.arange(n)
+        )
+        if n < n_super * rows_per_super:  # wrap-pad the tail superbatch
+            idx = np.resize(idx, n_super * rows_per_super)
+        for s in range(n_super):
+            sl = idx[s * rows_per_super : (s + 1) * rows_per_super]
+            out = []
+            for c in columns:
+                col = self._columns[c][sl]
+                # Layout [window, W, batch, …] → [W, window, batch, …] so that
+                # sharding axis 0 over 'dp' gives each chip its own stream.
+                col = col.reshape((window, num_workers, batch_size) + col.shape[1:])
+                out.append(np.swapaxes(col, 0, 1))
+            yield tuple(out)
+
+    def batches(
+        self,
+        batch_size: int,
+        columns: Sequence[str],
+        *,
+        seed: int | None = None,
+        drop_remainder: bool = True,
+    ) -> Iterator[tuple[np.ndarray, ...]]:
+        """Plain single-stream minibatches (the ``SingleTrainer`` path)."""
+        for sb in self.superbatches(
+            1, batch_size, 1, columns, seed=seed, drop_remainder=drop_remainder
+        ):
+            yield tuple(a[0, 0] for a in sb)
+
+    def __repr__(self):
+        cols = ", ".join(
+            f"{k}:{v.dtype}{list(v.shape[1:])}" for k, v in self._columns.items()
+        )
+        return f"Dataset({len(self)} rows; {cols})"

@@ -1,0 +1,114 @@
+"""ModelSpec — the functional model contract the training engine consumes.
+
+The reference moved Keras 1.x models around as architecture-JSON + weights and
+called ``model.train_on_batch`` inside Spark executors (reference
+``distkeras/workers.py :: Worker.prepare_model/train``). Under XLA everything
+must be a pure function of explicit state, so the engine consumes a
+:class:`ModelSpec`:
+
+- ``init(rng) -> (params, state)`` — trainable params pytree + non-trainable
+  state pytree (batch-norm stats etc.; empty dict when stateless);
+- ``apply(params, state, x, training) -> (outputs, new_state)`` — pure, jit- and
+  vmap-traceable.
+
+Frontends:
+- :func:`from_flax` wraps any ``flax.linen`` module (the native zoo in
+  ``distkeras_tpu.models``);
+- :func:`from_keras` wraps a Keras 3 model via ``model.stateless_call`` so the
+  reference's user-facing contract — "hand a Keras model to a trainer" —
+  survives unchanged (SURVEY.md §7.3 hard part 2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    init: Callable[[jax.Array], tuple[Pytree, Pytree]]
+    apply: Callable[[Pytree, Pytree, Any, bool], tuple[Any, Pytree]]
+    name: str = "model"
+
+    def init_np(self, seed: int = 0) -> tuple[Pytree, Pytree]:
+        """Host-side init convenience returning NumPy pytrees."""
+        params, state = self.init(jax.random.PRNGKey(seed))
+        return (
+            jax.tree.map(np.asarray, params),
+            jax.tree.map(np.asarray, state),
+        )
+
+
+def from_flax(module, example_input, *, name: str | None = None,
+              mutable_collections: tuple[str, ...] = ("batch_stats",)) -> ModelSpec:
+    """Wrap a ``flax.linen`` module into a ModelSpec.
+
+    ``example_input`` may be an array or a tuple of arrays (multi-input
+    models); shapes are used for initialization only.
+    """
+    example = (
+        example_input if isinstance(example_input, tuple) else (example_input,)
+    )
+
+    def init(rng):
+        variables = module.init(rng, *example, training=False)
+        variables = dict(variables)
+        params = variables.pop("params")
+        return params, variables
+
+    def apply(params, state, x, training):
+        inputs = x if isinstance(x, tuple) else (x,)
+        mutable = [c for c in mutable_collections if c in state] if training else []
+        if mutable:
+            out, updated = module.apply(
+                {"params": params, **state}, *inputs, training=training,
+                mutable=mutable,
+            )
+            new_state = {**state, **dict(updated)}
+            return out, new_state
+        out = module.apply({"params": params, **state}, *inputs, training=training)
+        return out, state
+
+    return ModelSpec(init=init, apply=apply, name=name or type(module).__name__)
+
+
+def from_keras(model, *, name: str | None = None) -> ModelSpec:
+    """Wrap a built Keras 3 (JAX backend) model via ``stateless_call``.
+
+    Parity path: the reference user keeps writing Keras models
+    (reference ``distkeras/trainers.py :: Trainer.__init__(keras_model, …)``).
+    Trainable variables become the params pytree (a list, ordered like
+    ``model.trainable_variables``); non-trainables the state pytree.
+    """
+    import keras
+
+    if not model.built:
+        raise ValueError("Keras model must be built (call it once or set input shape)")
+
+    def init(rng):
+        del rng  # Keras models arrive already initialized; reuse their weights.
+        params = [np.asarray(v) for v in model.trainable_variables]
+        state = [np.asarray(v) for v in model.non_trainable_variables]
+        return params, state
+
+    def apply(params, state, x, training):
+        outputs, new_state = model.stateless_call(
+            params, state, x, training=training
+        )
+        return outputs, list(new_state)
+
+    return ModelSpec(init=init, apply=apply, name=name or model.name)
+
+
+def keras_weights_to_model(model, params, state) -> None:
+    """Write trained pytrees back into a live Keras model (in place)."""
+    for var, val in zip(model.trainable_variables, params):
+        var.assign(np.asarray(val))
+    for var, val in zip(model.non_trainable_variables, state):
+        var.assign(np.asarray(val))

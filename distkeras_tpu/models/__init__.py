@@ -1,0 +1,27 @@
+"""Native flax model zoo covering the reference's benchmark model families.
+
+The reference era's models were Keras 1.x MLP/CNN/LSTM (SURVEY.md §5.7); the
+five BASELINE configs map to:
+
+- :func:`mlp` — MNIST 3-layer MLP (config 1) and ATLAS-Higgs tabular MLP
+  (config 4);
+- :func:`lenet` — MNIST LeNet-style CNN (config 2, the north-star config);
+- :func:`vgg_small` — CIFAR-10 VGG-small (config 3);
+- :func:`lstm_classifier` — IMDB LSTM sentiment (config 5).
+
+All models emit **logits** (pair with the ``softmax_cross_entropy`` family) and
+default to bfloat16 activations with float32 parameters — bf16 keeps matmuls
+and convs on the MXU's fast path while fp32 master weights keep optimizer math
+exact.
+"""
+
+from distkeras_tpu.models.mlp import MLP, mlp
+from distkeras_tpu.models.cnn import LeNet, VGGSmall, lenet, vgg_small
+from distkeras_tpu.models.lstm import LSTMClassifier, lstm_classifier
+
+__all__ = [
+    "MLP", "mlp",
+    "LeNet", "lenet",
+    "VGGSmall", "vgg_small",
+    "LSTMClassifier", "lstm_classifier",
+]

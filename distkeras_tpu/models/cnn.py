@@ -1,0 +1,70 @@
+"""Convolutional families: LeNet (MNIST north-star) and VGG-small (CIFAR-10).
+
+bf16 activations keep convs on the MXU; pooling/reductions are cheap VPU work.
+No batch-norm in these configs (matching the 2016-era reference models), which
+also keeps every model in the zoo stateless — simpler SPMD state.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.model import ModelSpec, from_flax
+
+
+class LeNet(nn.Module):
+    """LeNet-style MNIST CNN (BASELINE config 2, the ADAG north-star model)."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(256, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class VGGSmall(nn.Module):
+    """VGG-small for CIFAR-10 (BASELINE config 3): 3 conv blocks + 2 dense."""
+
+    num_classes: int = 10
+    widths: tuple = (64, 128, 256)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = x.astype(self.dtype)
+        for w in self.widths:
+            x = nn.Conv(w, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.Conv(w, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(512, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def lenet(input_shape=(28, 28, 1), num_classes=10, dtype=jnp.bfloat16) -> ModelSpec:
+    module = LeNet(num_classes=num_classes, dtype=dtype)
+    example = jnp.zeros((1,) + tuple(input_shape), jnp.float32)
+    return from_flax(module, example, name="lenet")
+
+
+def vgg_small(input_shape=(32, 32, 3), num_classes=10, dtype=jnp.bfloat16) -> ModelSpec:
+    module = VGGSmall(num_classes=num_classes, dtype=dtype)
+    example = jnp.zeros((1,) + tuple(input_shape), jnp.float32)
+    return from_flax(module, example, name="vgg_small")

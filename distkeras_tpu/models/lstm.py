@@ -1,0 +1,54 @@
+"""LSTM sequence classifier (IMDB sentiment, BASELINE config 5).
+
+Variable-length sequences arrive pre-padded to a static length with a mask
+column (see ``distkeras_tpu.datasets.imdb`` / ``SequencePadTransformer``) —
+XLA traces one static-shape program, no recompiles per length bucket
+(SURVEY.md §7.3 hard part 3). The recurrence itself is a ``flax.linen.RNN``
+(``lax.scan`` underneath — compiler-friendly sequential control flow);
+classification reads a mask-weighted mean over valid timesteps, which avoids a
+gather on the last-valid index and fuses into the final matmul.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.model import ModelSpec, from_flax
+
+
+class LSTMClassifier(nn.Module):
+    vocab: int = 20000
+    embed_dim: int = 128
+    hidden_dim: int = 128
+    num_classes: int = 2
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens, mask=None, training: bool = False):
+        if mask is None:
+            mask = jnp.ones(tokens.shape, jnp.float32)
+        x = nn.Embed(self.vocab, self.embed_dim, dtype=self.dtype)(tokens)
+        rnn = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim, dtype=self.dtype))
+        outs = rnn(x)  # [batch, time, hidden]
+        m = mask.astype(jnp.float32)[..., None]
+        pooled = jnp.sum(outs.astype(jnp.float32) * m, axis=1) / jnp.maximum(
+            jnp.sum(m, axis=1), 1.0
+        )
+        logits = nn.Dense(self.num_classes, dtype=self.dtype)(
+            pooled.astype(self.dtype)
+        )
+        return logits.astype(jnp.float32)
+
+
+def lstm_classifier(vocab=20000, maxlen=200, embed_dim=128, hidden_dim=128,
+                    num_classes=2, dtype=jnp.bfloat16) -> ModelSpec:
+    module = LSTMClassifier(
+        vocab=vocab, embed_dim=embed_dim, hidden_dim=hidden_dim,
+        num_classes=num_classes, dtype=dtype,
+    )
+    example = (
+        jnp.zeros((1, maxlen), jnp.int32),
+        jnp.ones((1, maxlen), jnp.float32),
+    )
+    return from_flax(module, example, name="lstm_classifier")

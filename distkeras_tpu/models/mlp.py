@@ -1,0 +1,37 @@
+"""Dense MLP family (MNIST-MLP and Higgs-MLP benchmark configs)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.model import ModelSpec, from_flax
+
+
+class MLP(nn.Module):
+    """Flatten → hidden dense+relu stack → logits.
+
+    Compute dtype defaults to bfloat16 (MXU native); params stay float32.
+    """
+
+    hidden: Sequence[int] = (500, 300)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for h in self.hidden:
+            x = nn.Dense(h, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def mlp(input_shape=(28, 28, 1), hidden=(500, 300), num_classes=10,
+        dtype=jnp.bfloat16) -> ModelSpec:
+    module = MLP(hidden=tuple(hidden), num_classes=num_classes, dtype=dtype)
+    example = jnp.zeros((1,) + tuple(input_shape), jnp.float32)
+    return from_flax(module, example, name="mlp")

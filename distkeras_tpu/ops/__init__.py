@@ -1,0 +1,7 @@
+"""Numerical ops: losses, metrics, and Pallas TPU kernels for the hot paths."""
+
+from distkeras_tpu.ops import losses, metrics
+from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.ops.metrics import accuracy
+
+__all__ = ["losses", "metrics", "get_loss", "accuracy"]

@@ -1,0 +1,35 @@
+"""Evaluation metrics (pure JAX, traceable).
+
+Parity: the reference's only metric was classification accuracy
+(``distkeras/evaluators.py :: AccuracyEvaluator``, SURVEY.md §2b #17).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def accuracy(y_true, y_pred):
+    """Classification accuracy.
+
+    Accepts one-hot or integer ``y_true``; ``y_pred`` as class scores
+    (argmaxed) or already-integer predictions.
+    """
+    if y_pred.ndim > 1 and y_pred.shape[-1] > 1:
+        pred = jnp.argmax(y_pred, axis=-1)
+    else:
+        pred = jnp.round(y_pred).astype(jnp.int32).reshape(y_pred.shape[0], -1)[:, 0]
+    if y_true.ndim > 1 and y_true.shape[-1] > 1:
+        true = jnp.argmax(y_true, axis=-1)
+    else:
+        true = y_true.astype(jnp.int32).reshape(y_true.shape[0], -1)[:, 0]
+    return jnp.mean((pred == true).astype(jnp.float32))
+
+
+def top_k_accuracy(y_true, y_pred, k: int = 5):
+    if y_true.ndim > 1 and y_true.shape[-1] > 1:
+        true = jnp.argmax(y_true, axis=-1)
+    else:
+        true = y_true.astype(jnp.int32).reshape(-1)
+    topk = jnp.argsort(y_pred, axis=-1)[:, -k:]
+    return jnp.mean(jnp.any(topk == true[:, None], axis=-1).astype(jnp.float32))

@@ -1,0 +1,32 @@
+"""SPMD machinery: mesh discovery, collectives, merge rules, local-SGD engine.
+
+This package replaces the reference's entire L1+L2 (socket transport +
+parameter server, ``distkeras/networking.py`` + ``distkeras/parameter_servers.py``)
+for the default synchronous path: parameter exchange is an XLA collective over
+ICI at communication-window boundaries, not a TCP round-trip (SURVEY.md §2,
+"the part the north_star says to delete and replace with JAX collectives").
+"""
+
+from distkeras_tpu.parallel.mesh import get_mesh, mesh_info
+from distkeras_tpu.parallel.merge_rules import (
+    ADAGMerge,
+    DownpourMerge,
+    DynSGDMerge,
+    ElasticAverageMerge,
+    MergeRule,
+    get_merge_rule,
+)
+from distkeras_tpu.parallel.local_sgd import LocalSGDEngine, TrainState
+
+__all__ = [
+    "get_mesh",
+    "mesh_info",
+    "MergeRule",
+    "ADAGMerge",
+    "DownpourMerge",
+    "ElasticAverageMerge",
+    "DynSGDMerge",
+    "get_merge_rule",
+    "LocalSGDEngine",
+    "TrainState",
+]

@@ -1,0 +1,188 @@
+"""Local-SGD SPMD engine — the rebuilt executor hot loop.
+
+Reference execution model (SURVEY.md §3.1): each Spark executor ran a Python
+minibatch loop calling ``model.train_on_batch`` and, every
+``communication_window`` batches, did two pickled TCP round-trips with the
+driver's parameter server. Here the WHOLE window is one jitted XLA program:
+
+- worker replica params are stacked on a leading ``W`` axis and sharded over
+  the ``dp`` mesh axis (one replica per chip at ``W == n_devices``);
+- the ``communication_window`` local steps are a ``lax.scan`` vmapped over the
+  worker axis — no host round-trip, no Python, inside the window;
+- the merge rule's reduction over the worker axis compiles to a fused
+  ``psum``/``pmean`` over ICI, replacing pull/commit entirely;
+- state buffers are donated, so params/optimizer state update in place in HBM.
+
+The host's only jobs are feeding superbatches (``Dataset.superbatches``) and
+pulling an occasional loss scalar — the driver-process bottleneck of the
+reference (GIL-bound PS threads, SURVEY.md §3.3) has no analogue here.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from distkeras_tpu.model import ModelSpec
+from distkeras_tpu.parallel.merge_rules import MergeRule
+from distkeras_tpu.parallel.mesh import replicated_sharding, worker_sharding
+
+Pytree = Any
+LossStep = Callable[[Pytree, Pytree, tuple], tuple[jnp.ndarray, Pytree]]
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Full training state; lives sharded in HBM for the whole run."""
+
+    center: Pytree        # merged model params (replicated)
+    workers: Pytree       # per-replica params, stacked [W, …] (sharded 'dp')
+    nt: Pytree            # per-replica non-trainable model state [W, …]
+    opt_state: Pytree     # per-replica optimizer state [W, …]
+    step: jnp.ndarray     # windows completed (replicated scalar)
+
+
+class LocalSGDEngine:
+    """Builds and runs the jitted window step for one (model, rule) pair.
+
+    ``loss_step(params, nt, batch_tuple) -> (loss, new_nt)`` is supplied by the
+    trainer (it knows the column layout and loss).
+    """
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        loss_step: LossStep,
+        optimizer: optax.GradientTransformation,
+        rule: MergeRule,
+        mesh,
+        num_workers: int,
+        window: int,
+    ):
+        self.spec = spec
+        self.loss_step = loss_step
+        self.optimizer = optimizer
+        self.rule = rule
+        self.mesh = mesh
+        self.num_workers = int(num_workers)
+        self.window = int(window)
+        self._rep = replicated_sharding(mesh)
+        self._shard = worker_sharding(mesh)
+        self._window_step = None  # built lazily once state structure is known
+
+    # -- sharding layout -----------------------------------------------------
+
+    def _state_shardings(self, state: TrainState) -> TrainState:
+        rep, shard = self._rep, self._shard
+        return TrainState(
+            center=jax.tree.map(lambda _: rep, state.center),
+            workers=jax.tree.map(lambda _: shard, state.workers),
+            nt=jax.tree.map(lambda _: shard, state.nt),
+            opt_state=jax.tree.map(lambda _: shard, state.opt_state),
+            step=rep,
+        )
+
+    # -- init ----------------------------------------------------------------
+
+    def init_state(self, params: Pytree, nt: Pytree) -> TrainState:
+        """Broadcast initial params to all replicas, on device.
+
+        The broadcast happens inside jit with sharded out-shardings, so each
+        chip materializes only its own replica slice (no W host copies).
+        """
+        W = self.num_workers
+
+        def build(p, n):
+            workers = jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), p
+            )
+            nt_stacked = jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), n
+            )
+            opt = jax.vmap(self.optimizer.init)(workers)
+            return TrainState(
+                center=p,
+                workers=workers,
+                nt=nt_stacked,
+                opt_state=opt,
+                step=jnp.zeros((), jnp.int32),
+            )
+
+        params = jax.tree.map(jnp.asarray, params)
+        nt = jax.tree.map(jnp.asarray, nt)
+        abstract = jax.eval_shape(build, params, nt)
+        out_shardings = self._state_shardings(abstract)
+        state = jax.jit(build, out_shardings=_as_tree(out_shardings))(params, nt)
+        self._build_window_step(state)
+        return state
+
+    # -- the jitted window ---------------------------------------------------
+
+    def _build_window_step(self, state: TrainState):
+        rule, tx, loss_step = self.rule, self.optimizer, self.loss_step
+        shardings = _as_tree(self._state_shardings(state))
+        batch_sharding = self._shard
+
+        def worker_window(wparams, nt, opt, batches):
+            """One worker's `window` local steps (runs vmapped over W)."""
+
+            def one_step(carry, batch):
+                params, nt, opt = carry
+                (loss, new_nt), grads = jax.value_and_grad(
+                    loss_step, has_aux=True
+                )(params, nt, batch)
+                updates, opt = tx.update(grads, opt, params)
+                params = optax.apply_updates(params, updates)
+                return (params, new_nt, opt), loss
+
+            (wparams, nt, opt), losses = jax.lax.scan(
+                one_step, (wparams, nt, opt), batches
+            )
+            return wparams, nt, opt, jnp.mean(losses)
+
+        def window_step(state: TrainState, batch: tuple):
+            workers, nt, opt, losses = jax.vmap(worker_window)(
+                state.workers, state.nt, state.opt_state, batch
+            )
+            center, workers = rule.merge(state.center, workers)
+            new_state = TrainState(
+                center=center,
+                workers=workers,
+                nt=nt,
+                opt_state=opt,
+                step=state.step + 1,
+            )
+            return new_state, jnp.mean(losses)
+
+        self._window_step = jax.jit(
+            window_step,
+            in_shardings=(shardings, None),
+            out_shardings=(shardings, self._rep),
+            donate_argnums=(0,),
+        )
+        self._batch_sharding = batch_sharding
+
+    def run_window(self, state: TrainState, batch_arrays: tuple):
+        """Run one communication window. ``batch_arrays``: [W, window, B, …]."""
+        batch = tuple(
+            jax.device_put(a, self._batch_sharding) for a in batch_arrays
+        )
+        return self._window_step(state, batch)
+
+    # -- results -------------------------------------------------------------
+
+    def center_params(self, state: TrainState) -> Pytree:
+        return jax.tree.map(lambda x: jax.device_get(x), state.center)
+
+    def worker_nt(self, state: TrainState, i: int = 0) -> Pytree:
+        return jax.tree.map(lambda x: jax.device_get(x[i]), state.nt)
+
+
+def _as_tree(state_shardings: TrainState):
+    """flax.struct dataclass of shardings → plain pytree for jit APIs."""
+    return state_shardings

@@ -1,0 +1,204 @@
+"""Merge rules — each distributed algorithm's parameter-exchange semantics.
+
+Every reference algorithm shares one skeleton: a worker trains locally for
+``communication_window`` minibatches, then exchanges with the center
+(SURVEY.md §2b.3). They differ only in *what is committed* and *how the center
+folds it in*. Here each algorithm is a pure function on pytrees:
+
+    merge(center, workers_stacked) -> (center', workers_stacked')
+
+with ``workers_stacked`` carrying a leading ``W`` axis sharded over the ``dp``
+mesh axis — the reductions over that axis ARE the parameter exchange, lowered
+by XLA to ``psum``/``pmean`` over ICI instead of the reference's pickled TCP
+round-trips (reference ``distkeras/parameter_servers.py`` commit handlers).
+
+Because every optax update is additive (``params += update``), a worker's
+window-accumulated commit equals ``worker − center_at_pull``, so every rule
+needs only the post-window worker params and the window-start center — no
+separate accumulator threads through the scan.
+
+Async lowering note (SURVEY.md §7.3 hard part 1): the originals folded commits
+one at a time into a center guarded by a lock, so each fold saw the partial
+result of earlier folds. The sync lowering makes a deterministic, documented
+choice per rule (parallel fold for ADAG/DOWNPOUR/elastic; fold-position
+staleness for DynSGD). Each rule also provides :meth:`fold` — the one-commit
+form used by the genuinely-async parameter-server backend
+(``distkeras_tpu.parameter_servers``), so both backends share the same
+algorithm definitions and the unit tests pin them to one oracle.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def _delta(workers, center):
+    """Per-worker commit payload: worker − center, leafwise (stacked)."""
+    return jax.tree.map(lambda w, c: w - c[None], workers, center)
+
+
+def _reset_to(center, workers):
+    """Broadcast the new center back to every worker (the post-merge 'pull')."""
+    return jax.tree.map(
+        lambda c, w: jnp.broadcast_to(c[None].astype(w.dtype), w.shape), center, workers
+    )
+
+
+class MergeRule:
+    """Base: subclasses define sync ``merge`` and async one-commit ``fold``."""
+
+    #: whether workers are re-based onto the new center after each merge
+    resets_workers: bool = True
+
+    def merge(self, center: Pytree, workers: Pytree) -> tuple[Pytree, Pytree]:
+        raise NotImplementedError
+
+    def fold(self, center: Pytree, commit: Pytree, num_workers: int,
+             staleness: int) -> Pytree:
+        """Async PS form: fold ONE worker's commit (= its delta) into center."""
+        raise NotImplementedError
+
+
+class ADAGMerge(MergeRule):
+    """ADAG — asynchronous distributed adaptive gradients (the repo author's
+    algorithm; reference ``distkeras/trainers.py :: ADAG``).
+
+    Commit: the window-accumulated, locally-optimized update; fold: add the
+    commit normalized by the worker count — the normalization that reduced
+    staleness error in the async original. Sync lowering: center += mean over
+    workers of (worker − center). With ``communication_window=1`` and SGD this
+    is EXACTLY synchronous mean-gradient all-reduce — BASELINE.json's "sync
+    allreduce path".
+    """
+
+    def merge(self, center, workers):
+        deltas = _delta(workers, center)
+        center = jax.tree.map(
+            lambda c, d: c + jnp.mean(d, axis=0, dtype=c.dtype), center, deltas
+        )
+        return center, _reset_to(center, workers)
+
+    def fold(self, center, commit, num_workers, staleness):
+        return jax.tree.map(lambda c, d: c + d / num_workers, center, commit)
+
+
+class DownpourMerge(MergeRule):
+    """DOWNPOUR (Dean et al. 2012; reference ``distkeras/trainers.py ::
+    DOWNPOUR``): each worker's weight delta is added to the center unscaled.
+
+    Sync lowering: center += SUM over workers of (worker − center) — the same
+    total displacement the async PS accumulated over one round. Like the
+    original, the effective step grows with worker count; users tune
+    ``communication_window``/learning rate accordingly.
+    """
+
+    def merge(self, center, workers):
+        deltas = _delta(workers, center)
+        center = jax.tree.map(
+            lambda c, d: c + jnp.sum(d, axis=0, dtype=c.dtype), center, deltas
+        )
+        return center, _reset_to(center, workers)
+
+    def fold(self, center, commit, num_workers, staleness):
+        return jax.tree.map(jnp.add, center, commit)
+
+
+class ElasticAverageMerge(MergeRule):
+    """AEASGD / EAMSGD (Zhang, Choromanska & LeCun 2015; reference
+    ``distkeras/trainers.py :: AEASGD, EAMSGD``).
+
+    Workers keep their own variables (never re-based); each exchange moves
+    worker and center toward each other by the elastic force
+    ``alpha = rho · learning_rate``:
+
+        diff_i  = alpha · (worker_i − center)
+        worker_i −= diff_i
+        center  += Σ_i diff_i
+
+    Stability requires ``alpha · num_workers < 1`` in this lockstep fold (the
+    async original spread the folds over time); the constructor warns when
+    ``num_workers`` is known and the product reaches 1. EAMSGD differs only in
+    the worker-side optimizer (Nesterov momentum), configured in the trainer —
+    the merge rule is identical.
+    """
+
+    resets_workers = False
+
+    def __init__(self, alpha: float, num_workers: int | None = None):
+        self.alpha = float(alpha)
+        if num_workers is not None and self.alpha * num_workers >= 1.0:
+            import warnings
+
+            warnings.warn(
+                f"elastic force alpha={self.alpha:.3f} × num_workers="
+                f"{num_workers} = {self.alpha * num_workers:.2f} ≥ 1: the "
+                "lockstep center update will overshoot; lower rho, the "
+                "learning rate, or the worker count",
+                stacklevel=3,
+            )
+
+    def merge(self, center, workers):
+        a = self.alpha
+        diffs = jax.tree.map(lambda w, c: a * (w - c[None]), workers, center)
+        new_workers = jax.tree.map(jnp.subtract, workers, diffs)
+        new_center = jax.tree.map(
+            lambda c, d: c + jnp.sum(d, axis=0, dtype=c.dtype), center, diffs
+        )
+        return new_center, new_workers
+
+    def fold(self, center, commit, num_workers, staleness):
+        # Async form: commit is already the elastic difference alpha·(w − c).
+        return jax.tree.map(jnp.add, center, commit)
+
+    def worker_commit(self, worker, center):
+        """Async worker side: elastic difference, subtracted locally too."""
+        return jax.tree.map(lambda w, c: self.alpha * (w - c), worker, center)
+
+
+class DynSGDMerge(MergeRule):
+    """DynSGD — staleness-aware dynamic-LR SGD (after Jiang et al. 2017;
+    reference ``distkeras/trainers.py :: DynSGD``): each commit is scaled by
+    ``1/(τ+1)`` where τ counts center updates since that worker's last pull.
+
+    Deterministic lockstep lowering: within one merge the commits fold in
+    worker-index order, so worker *i* sees τ = i center updates from this
+    round: center += Σ_i (worker_i − center)/(i+1). The 1/(τ+1) formula is
+    preserved exactly; on TPU τ is the within-round fold position (documented
+    divergence from wall-clock staleness, which lockstep makes constant —
+    SURVEY.md §7.1).
+    """
+
+    def merge(self, center, workers):
+        deltas = _delta(workers, center)
+
+        def fold_leaf(c, d):
+            w = d.shape[0]
+            scale = 1.0 / (jnp.arange(w, dtype=jnp.float32) + 1.0)
+            scale = scale.reshape((w,) + (1,) * (d.ndim - 1)).astype(c.dtype)
+            return c + jnp.sum(d * scale, axis=0, dtype=c.dtype)
+
+        center = jax.tree.map(fold_leaf, center, deltas)
+        return center, _reset_to(center, workers)
+
+    def fold(self, center, commit, num_workers, staleness):
+        s = 1.0 / (float(staleness) + 1.0)
+        return jax.tree.map(lambda c, d: c + d * s, center, commit)
+
+
+def get_merge_rule(name: str, *, rho: float = 3.0, learning_rate: float = 0.05,
+                   **_) -> MergeRule:
+    name = name.lower()
+    if name == "adag":
+        return ADAGMerge()
+    if name == "downpour":
+        return DownpourMerge()
+    if name in ("aeasgd", "eamsgd", "easgd"):
+        return ElasticAverageMerge(alpha=rho * learning_rate)
+    if name == "dynsgd":
+        return DynSGDMerge()
+    raise ValueError(f"unknown merge rule {name!r}")

@@ -1,0 +1,66 @@
+"""Device-mesh discovery — the replacement for Spark replica placement.
+
+The reference placed one training replica per Spark partition with
+``rdd.mapPartitionsWithIndex(worker.train)`` (reference
+``distkeras/workers.py``; SURVEY.md §1). Here placement is declarative: a 1-D
+``jax.sharding.Mesh`` over the TPU slice with axis ``'dp'``, and every
+stacked-worker array is sharded over that axis. XLA then schedules the
+merge-rule reductions as ICI collectives; across hosts ``jax.distributed``
+handles discovery (see ``distkeras_tpu.job_deployment``).
+
+Workers-per-device is flexible: ``num_workers`` must be a multiple of the
+device count (k replicas per chip time-share it) or a divisor of it (submesh).
+The reference had the same freedom via Spark partition counts.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def get_mesh(num_workers: int | None = None, devices=None, axis: str = "dp") -> Mesh:
+    """Build the data-parallel mesh.
+
+    ``num_workers=None`` means one worker per visible device (the north-star
+    "one SPMD replica per chip"). A smaller worker count uses a contiguous
+    submesh; a larger one requires ``num_workers % n_devices == 0`` so the
+    stacked worker axis shards evenly.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if num_workers is None or num_workers >= n:
+        if num_workers is not None and num_workers % n != 0:
+            raise ValueError(
+                f"num_workers={num_workers} not a multiple of {n} devices"
+            )
+        use = devices
+    else:
+        if n % num_workers != 0:
+            raise ValueError(
+                f"num_workers={num_workers} does not divide {n} devices"
+            )
+        use = devices[:num_workers]
+    return Mesh(np.asarray(use), (axis,))
+
+
+def worker_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Sharding for stacked-worker arrays (leading W axis split over chips)."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for center/global state (same value on every chip)."""
+    return NamedSharding(mesh, P())
+
+
+def mesh_info(mesh: Mesh) -> dict:
+    devs = mesh.devices.flatten()
+    return {
+        "num_devices": len(devs),
+        "platform": devs[0].platform,
+        "device_kind": getattr(devs[0], "device_kind", "unknown"),
+        "axis_names": list(mesh.axis_names),
+        "num_hosts": len({d.process_index for d in devs}),
+    }

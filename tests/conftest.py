@@ -1,0 +1,35 @@
+"""Test configuration: fake 8-device CPU mesh.
+
+The reference's only "distributed without a cluster" mechanism was Spark
+``local[N]`` (SURVEY.md §4). The TPU analogue is XLA's forced host platform
+device count: 8 fake CPU devices give every trainer's collective path a real
+mesh in CI, no TPU required. Must be set before JAX is imported.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+# Force CPU regardless of any TPU platform the outer env selects (a TPU
+# plugin may already be registered by a sitecustomize hook before this
+# conftest runs, so the switch must go through jax.config, not env vars).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

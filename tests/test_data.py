@@ -1,0 +1,128 @@
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu import transformers as T
+
+
+def make_ds(n=100):
+    feats = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    labels = (np.arange(n) % 3).astype(np.int32)
+    return Dataset.from_arrays(feats, labels)
+
+
+def test_basic_frame_ops():
+    ds = make_ds(10)
+    assert len(ds) == 10
+    assert set(ds.columns) == {"features", "label"}
+    ds2 = ds.with_column("extra", np.ones(10))
+    assert "extra" in ds2 and "extra" not in ds
+    assert len(ds2.select(["extra"]).columns) == 1
+    tr, te = ds.split(0.7, seed=0)
+    assert len(tr) == 7 and len(te) == 3
+
+
+def test_column_length_mismatch_raises():
+    with pytest.raises(ValueError):
+        Dataset({"a": np.ones(3), "b": np.ones(4)})
+
+
+def test_shuffle_is_permutation():
+    ds = make_ds(50)
+    sh = ds.shuffle(seed=3)
+    assert not np.array_equal(sh["label"], ds["label"])
+    assert sorted(sh["features"][:, 0].tolist()) == sorted(
+        ds["features"][:, 0].tolist()
+    )
+
+
+def test_superbatch_layout_rows_disjoint_and_ordered():
+    """Worker w / window t / batch b must map to distinct dataset rows in the
+    [W, window, B, ...] layout, with each worker's stream disjoint."""
+    n, W, B, win = 96, 4, 3, 2
+    ds = make_ds(n)
+    sbs = list(ds.superbatches(W, B, win, ["features", "label"]))
+    assert len(sbs) == n // (W * B * win)
+    feats, labels = sbs[0]
+    assert feats.shape == (W, win, B, 4)
+    assert labels.shape == (W, win, B)
+    # Collect all row ids (features col 0 is 4*row) across the superbatch
+    row_ids = (feats[..., 0].reshape(-1) / 4).astype(int)
+    assert len(set(row_ids.tolist())) == W * B * win  # all distinct
+    # Window-major interleave: worker w, window t draws from block t
+    flat = feats[..., 0] / 4  # [W, win, B]
+    for t in range(win):
+        block = flat[:, t, :].reshape(-1)
+        expected = np.arange(t * W * B, (t + 1) * W * B)
+        assert set(block.astype(int).tolist()) == set(expected.tolist())
+
+
+def test_superbatch_too_small_raises():
+    ds = make_ds(10)
+    with pytest.raises(ValueError):
+        list(ds.superbatches(8, 4, 2, ["features"]))
+
+
+def test_batches_single_stream():
+    ds = make_ds(64)
+    bs = list(ds.batches(16, ["features", "label"]))
+    assert len(bs) == 4
+    x, y = bs[0]
+    assert x.shape == (16, 4) and y.shape == (16,)
+
+
+def test_onehot_transformer():
+    ds = make_ds(9)
+    out = T.OneHotTransformer(3, input_col="label", output_col="oh").transform(ds)
+    oh = out["oh"]
+    assert oh.shape == (9, 3)
+    assert np.array_equal(np.argmax(oh, -1), ds["label"])
+    assert np.allclose(oh.sum(-1), 1.0)
+
+
+def test_minmax_transformer():
+    ds = Dataset({"features": np.array([[0.0], [127.5], [255.0]], np.float32)})
+    out = T.MinMaxTransformer(0.0, 1.0, 0.0, 255.0).transform(ds)
+    assert np.allclose(out["features"].reshape(-1), [0.0, 0.5, 1.0])
+
+
+def test_reshape_transformer():
+    ds = Dataset({"features": np.zeros((5, 784), np.float32)})
+    out = T.ReshapeTransformer("features", "img", (28, 28, 1)).transform(ds)
+    assert out["img"].shape == (5, 28, 28, 1)
+
+
+def test_label_index_transformer():
+    ds = Dataset({"prediction": np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)})
+    out = T.LabelIndexTransformer().transform(ds)
+    assert np.array_equal(out["prediction_index"], [1, 0])
+
+
+def test_sequence_pad_transformer():
+    seqs = np.asarray([np.array([5, 6, 7]), np.array([1])], dtype=object)
+    ds = Dataset({"sequence": seqs})
+    out = T.SequencePadTransformer(5, input_col="sequence").transform(ds)
+    assert np.array_equal(out["tokens"][0], [5, 6, 7, 0, 0])
+    assert np.array_equal(out["mask"][1], [1, 0, 0, 0, 0])
+
+
+def test_pipeline_composes():
+    ds = make_ds(9)
+    pipe = T.TransformerPipeline([
+        T.OneHotTransformer(3, input_col="label", output_col="oh"),
+        T.MinMaxTransformer(0, 1, 0, 400, input_col="features"),
+    ])
+    out = pipe.transform(ds)
+    assert "oh" in out and out["features"].max() <= 1.0
+
+
+def test_dense_transformer_sparse_rows():
+    rows = np.asarray(
+        [(np.array([0, 2]), np.array([1.0, 3.0])),
+         (np.array([1]), np.array([2.0]))],
+        dtype=object,
+    )
+    ds = Dataset({"features": rows})
+    out = T.DenseTransformer(dim=4).transform(ds)
+    assert np.array_equal(out["features_dense"][0], [1.0, 0.0, 3.0, 0.0])
+    assert np.array_equal(out["features_dense"][1], [0.0, 2.0, 0.0, 0.0])

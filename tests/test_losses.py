@@ -1,0 +1,68 @@
+import numpy as np
+import jax.numpy as jnp
+
+from distkeras_tpu.ops import losses, metrics
+
+
+def test_mse_matches_numpy(rng):
+    y = rng.normal(size=(8, 3)).astype(np.float32)
+    p = rng.normal(size=(8, 3)).astype(np.float32)
+    assert np.isclose(losses.mean_squared_error(y, p), ((y - p) ** 2).mean(),
+                      rtol=1e-5)
+
+
+def test_categorical_crossentropy_probs(rng):
+    probs = rng.uniform(0.05, 1.0, size=(16, 10)).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    labels = rng.integers(0, 10, 16)
+    onehot = np.eye(10, dtype=np.float32)[labels]
+    expected = -np.log(probs[np.arange(16), labels]).mean()
+    assert np.isclose(losses.categorical_crossentropy(onehot, probs), expected,
+                      rtol=1e-4)
+
+
+def test_softmax_vs_sparse_agree(rng):
+    logits = rng.normal(size=(16, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    onehot = np.eye(10, dtype=np.float32)[labels]
+    a = losses.softmax_cross_entropy(onehot, logits)
+    b = losses.sparse_softmax_cross_entropy(labels, logits)
+    assert np.isclose(a, b, rtol=1e-5)
+
+
+def test_sigmoid_bce_stable_large_logits():
+    logits = np.array([500.0, -500.0], np.float32)
+    targets = np.array([1.0, 0.0], np.float32)
+    v = float(losses.sigmoid_binary_crossentropy(targets, logits))
+    assert np.isfinite(v) and v < 1e-3
+
+
+def test_masked_sequence_loss_ignores_padding(rng):
+    logits = rng.normal(size=(2, 5, 7)).astype(np.float32)
+    labels = rng.integers(0, 7, size=(2, 5)).astype(np.int32)
+    mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+    full = losses.masked_sparse_softmax_cross_entropy(labels, logits, mask)
+    # changing padded logits must not change the loss
+    logits2 = logits.copy()
+    logits2[0, 3:] += 100.0
+    full2 = losses.masked_sparse_softmax_cross_entropy(labels, logits2, mask)
+    assert np.isclose(float(full), float(full2), rtol=1e-6)
+
+
+def test_get_loss_resolution():
+    assert losses.get_loss("mse") is losses.mean_squared_error
+    fn = lambda a, b: 0.0
+    assert losses.get_loss(fn) is fn
+    try:
+        losses.get_loss("nope")
+        assert False
+    except ValueError:
+        pass
+
+
+def test_accuracy_onehot_and_int(rng):
+    logits = np.array([[2.0, 1.0], [0.0, 3.0], [5.0, 0.0]], np.float32)
+    labels_int = np.array([0, 1, 1], np.int32)
+    onehot = np.eye(2, dtype=np.float32)[labels_int]
+    assert np.isclose(float(metrics.accuracy(labels_int, logits)), 2 / 3)
+    assert np.isclose(float(metrics.accuracy(onehot, logits)), 2 / 3)

@@ -1,0 +1,101 @@
+"""Merge rules vs a NumPy oracle — the reference PS commit semantics
+(SURVEY.md §2b.3) as ground truth for both backends."""
+
+import numpy as np
+
+from distkeras_tpu import utils
+from distkeras_tpu.parallel import merge_rules as mr
+
+
+def setup_trees(W=4, seed=0):
+    rng = np.random.default_rng(seed)
+    center = {"w": rng.normal(size=(3, 2)).astype(np.float32),
+              "b": rng.normal(size=(2,)).astype(np.float32)}
+    workers = {
+        "w": np.stack([center["w"] + rng.normal(size=(3, 2)).astype(np.float32)
+                       for _ in range(W)]),
+        "b": np.stack([center["b"] + rng.normal(size=(2,)).astype(np.float32)
+                       for _ in range(W)]),
+    }
+    return center, workers
+
+
+def deltas(center, workers):
+    return {k: workers[k] - center[k][None] for k in center}
+
+
+def test_adag_is_mean_of_deltas():
+    center, workers = setup_trees()
+    d = deltas(center, workers)
+    new_center, new_workers = mr.ADAGMerge().merge(center, workers)
+    for k in center:
+        assert np.allclose(new_center[k], center[k] + d[k].mean(0), atol=1e-6)
+        # workers re-based onto the new center
+        assert np.allclose(new_workers[k], np.broadcast_to(
+            np.asarray(new_center[k])[None], workers[k].shape), atol=1e-6)
+
+
+def test_downpour_is_sum_of_deltas():
+    center, workers = setup_trees()
+    d = deltas(center, workers)
+    new_center, _ = mr.DownpourMerge().merge(center, workers)
+    for k in center:
+        assert np.allclose(new_center[k], center[k] + d[k].sum(0), atol=1e-5)
+
+
+def test_elastic_average_moves_both_sides():
+    center, workers = setup_trees()
+    alpha = 0.05
+    rule = mr.ElasticAverageMerge(alpha)
+    d = deltas(center, workers)
+    new_center, new_workers = rule.merge(center, workers)
+    for k in center:
+        diff = alpha * d[k]
+        assert np.allclose(new_center[k], center[k] + diff.sum(0), atol=1e-5)
+        assert np.allclose(new_workers[k], workers[k] - diff, atol=1e-6)
+    assert rule.resets_workers is False
+
+
+def test_dynsgd_fold_position_staleness():
+    center, workers = setup_trees()
+    d = deltas(center, workers)
+    new_center, _ = mr.DynSGDMerge().merge(center, workers)
+    W = workers["w"].shape[0]
+    for k in center:
+        scale = (1.0 / (np.arange(W) + 1.0)).reshape((W,) + (1,) * center[k].ndim)
+        expected = center[k] + (d[k] * scale).sum(0)
+        assert np.allclose(new_center[k], expected, atol=1e-5)
+
+
+def test_async_fold_matches_semantics():
+    center, workers = setup_trees(W=2)
+    d = deltas(center, workers)
+    one = {k: d[k][0] for k in d}
+    c_down = mr.DownpourMerge().fold(center, one, num_workers=2, staleness=0)
+    c_adag = mr.ADAGMerge().fold(center, one, num_workers=2, staleness=0)
+    c_dyn = mr.DynSGDMerge().fold(center, one, num_workers=2, staleness=3)
+    for k in center:
+        assert np.allclose(c_down[k], center[k] + one[k], atol=1e-6)
+        assert np.allclose(c_adag[k], center[k] + one[k] / 2, atol=1e-6)
+        assert np.allclose(c_dyn[k], center[k] + one[k] / 4, atol=1e-6)
+
+
+def test_adag_window1_equals_sync_sgd_allreduce():
+    """ADAG with window=1 must equal plain synchronous mean-gradient SGD."""
+    rng = np.random.default_rng(1)
+    center = {"w": rng.normal(size=(4,)).astype(np.float32)}
+    lr = 0.1
+    grads = rng.normal(size=(3, 4)).astype(np.float32)  # per-worker grads
+    # each worker does one SGD step from the center
+    workers = {"w": np.stack([center["w"] - lr * g for g in grads])}
+    new_center, _ = mr.ADAGMerge().merge(center, workers)
+    expected = center["w"] - lr * grads.mean(0)
+    assert np.allclose(new_center["w"], expected, atol=1e-6)
+
+
+def test_get_merge_rule():
+    assert isinstance(mr.get_merge_rule("adag"), mr.ADAGMerge)
+    assert isinstance(mr.get_merge_rule("downpour"), mr.DownpourMerge)
+    r = mr.get_merge_rule("aeasgd", rho=2.0, learning_rate=0.1)
+    assert isinstance(r, mr.ElasticAverageMerge) and np.isclose(r.alpha, 0.2)
+    assert isinstance(mr.get_merge_rule("dynsgd"), mr.DynSGDMerge)

@@ -1,0 +1,129 @@
+"""End-to-end trainer tests on the 8-fake-device CPU mesh (SURVEY.md §4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.models import mlp
+from distkeras_tpu.parallel.mesh import get_mesh
+from distkeras_tpu.trainers import (
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    DynSGD,
+    EAMSGD,
+    SingleTrainer,
+)
+
+
+def blobs_dataset(n=2048, dim=16, classes=4, seed=0):
+    """Linearly separable Gaussian blobs — any trainer must fit these."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 3.0, size=(classes, dim)).astype(np.float32)
+    labels = rng.integers(0, classes, size=n).astype(np.int32)
+    x = centers[labels] + rng.normal(0, 1.0, size=(n, dim)).astype(np.float32)
+    return Dataset.from_arrays(x, labels)
+
+
+def model_spec(dim=16, classes=4):
+    import jax.numpy as jnp
+    return mlp(input_shape=(dim,), hidden=(32,), num_classes=classes,
+               dtype=jnp.float32)
+
+
+def final_loss(trainer):
+    losses = trainer.get_history().losses()
+    return float(np.mean([float(l) for l in losses[-3:]]))
+
+
+def initial_loss(trainer):
+    return float(trainer.get_history().losses()[0])
+
+
+def test_single_trainer_learns():
+    ds = blobs_dataset()
+    t = SingleTrainer(model_spec(), loss="sparse_softmax_cross_entropy",
+                      worker_optimizer="sgd", learning_rate=0.1,
+                      batch_size=64, num_epoch=3)
+    params = t.train(ds)
+    assert params is not None
+    assert final_loss(t) < 0.25
+    assert final_loss(t) < initial_loss(t) / 3
+    assert t.get_training_time() > 0
+    assert len(t.get_history()) > 0
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (ADAG, dict(communication_window=4)),
+    (ADAG, dict(communication_window=1)),  # sync allreduce path
+    (DOWNPOUR, dict(communication_window=4, learning_rate=0.02)),
+    (AEASGD, dict(communication_window=8, learning_rate=0.05, rho=0.5)),
+    (EAMSGD, dict(communication_window=8, learning_rate=0.05, rho=0.5,
+                  momentum=0.8)),
+    (DynSGD, dict(communication_window=4)),
+])
+def test_distributed_trainers_learn_on_8_device_mesh(cls, kw):
+    assert len(jax.devices()) == 8, "conftest must provide 8 fake devices"
+    ds = blobs_dataset(n=4096)
+    kw.setdefault("learning_rate", 0.1)
+    t = cls(model_spec(), loss="sparse_softmax_cross_entropy",
+            worker_optimizer="sgd", num_workers=8, batch_size=32,
+            num_epoch=3, **kw)
+    t.train(ds, shuffle=True)
+    assert final_loss(t) < 0.5, f"{cls.__name__} failed to learn: {final_loss(t)}"
+
+
+def test_adag_one_worker_matches_single_trainer():
+    """With W=1/window=1 the distributed path must equal the oracle exactly."""
+    ds = blobs_dataset(n=512)
+    mesh = get_mesh(1)
+    common = dict(loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+                  learning_rate=0.05, batch_size=64, num_epoch=1, seed=7)
+    t1 = SingleTrainer(model_spec(), mesh=mesh, **common)
+    p1 = t1.train(ds)
+    t2 = ADAG(model_spec(), num_workers=1, communication_window=1, mesh=mesh,
+              **common)
+    p2 = t2.train(ds)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        assert np.allclose(a, b, atol=1e-5)
+
+
+def test_workers_actually_sharded_over_mesh():
+    """The stacked worker axis must be split across all 8 devices."""
+    from distkeras_tpu.parallel.local_sgd import LocalSGDEngine
+    from distkeras_tpu.parallel.merge_rules import ADAGMerge
+    import optax
+
+    spec = model_spec()
+    mesh = get_mesh(8)
+
+    def loss_step(params, nt, batch):
+        x, y = batch
+        out, new_nt = spec.apply(params, nt, x, training=True)
+        from distkeras_tpu.ops.losses import sparse_softmax_cross_entropy
+        return sparse_softmax_cross_entropy(y, out), new_nt
+
+    eng = LocalSGDEngine(spec, loss_step, optax.sgd(0.1), ADAGMerge(),
+                         mesh, num_workers=8, window=2)
+    params, nt = spec.init_np(0)
+    state = eng.init_state(params, nt)
+    leaf = jax.tree.leaves(state.workers)[0]
+    assert len(leaf.sharding.device_set) == 8
+    # center replicated
+    cleaf = jax.tree.leaves(state.center)[0]
+    assert cleaf.sharding.is_fully_replicated
+
+
+def test_deterministic_across_runs():
+    """Sync collective path is deterministic (SURVEY.md §5.2 build note)."""
+    ds = blobs_dataset(n=1024)
+    results = []
+    for _ in range(2):
+        t = ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+                 num_workers=8, batch_size=16, communication_window=2,
+                 learning_rate=0.05, num_epoch=1, seed=3)
+        p = t.train(ds)
+        results.append(jax.tree.leaves(p))
+    for a, b in zip(*results):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
