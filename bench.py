@@ -1,0 +1,115 @@
+"""Driver benchmark: ADAG on MNIST-CNN samples/sec (the north-star config).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` is the speedup over the reference-proxy denominator. The
+reference's own number (16-executor Spark/CPU cluster) is unrecoverable here
+(BASELINE.md: no Spark, no network), so per SURVEY.md §6 the documented proxy
+is a single-process CPU ``SingleTrainer`` on the same model/data, measured in
+this same run — i.e. ``vs_baseline = TPU samples/sec ÷ single-CPU-process
+samples/sec``. The north-star "≥12× a 16-executor cluster" corresponds to
+``vs_baseline ≥ 192`` under ideal linear Spark scaling (16 executors × 12).
+
+Everything except the final JSON goes to stderr.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def measure_samples_per_sec(device, rows, batch_size, window, epochs_timed=3,
+                            dtype=None):
+    """ADAG/LeNet steady-state samples/sec on `device` (warm jit cache).
+
+    Uses the device-resident epoch path — one upload + one dispatch per epoch,
+    exactly what the trainer's auto mode does — timed after one warm-up epoch.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu.datasets import mnist
+    from distkeras_tpu.models import lenet
+    from distkeras_tpu.ops.losses import sparse_softmax_cross_entropy
+    from distkeras_tpu.parallel.local_sgd import LocalSGDEngine
+    from distkeras_tpu.parallel.merge_rules import ADAGMerge
+    from distkeras_tpu.parallel.mesh import get_mesh
+
+    train, _ = mnist(n_train=rows, n_test=64)
+    mesh = get_mesh(1, devices=[device])
+    # bf16 on the MXU; the CPU proxy runs f32 (XLA:CPU bf16 conv emulation
+    # would unfairly slow the baseline — reference ran f32 too)
+    spec = lenet(dtype=dtype or (jnp.bfloat16 if device.platform == "tpu"
+                                 else jnp.float32))
+
+    def loss_step(params, nt, batch):
+        x, y = batch
+        out, new_nt = spec.apply(params, nt, x, training=True)
+        return sparse_softmax_cross_entropy(y, out), new_nt
+
+    engine = LocalSGDEngine(
+        spec, loss_step, optax.adam(1e-3), ADAGMerge(), mesh,
+        num_workers=1, window=window, batch_size=batch_size,
+    )
+    params, nt = spec.init_np(0)
+    state = engine.init_state(params, nt)
+    cols = ["features", "label"]
+    n_windows = rows // (batch_size * window)
+    staged = engine.stage_dataset(
+        train.worker_shards(1, batch_size, window, cols)
+    )
+
+    t0 = time.perf_counter()
+    state, _ = engine.run_epoch_resident(state, staged, 0)  # compile + warm
+    jax.block_until_ready(state.center)
+    log(f"[{device.platform}] compile+first epoch: {time.perf_counter()-t0:.1f}s")
+
+    start = time.perf_counter()
+    for e in range(epochs_timed):
+        state, losses = engine.run_epoch_resident(state, staged, e + 1)
+    jax.block_until_ready(state.center)
+    elapsed = time.perf_counter() - start
+    sps = epochs_timed * n_windows * batch_size * window / elapsed
+    log(f"[{device.platform}] {sps:,.0f} samples/sec "
+        f"({epochs_timed}×{n_windows} windows in {elapsed:.2f}s, "
+        f"final loss {float(losses[-1]):.4f})")
+    return sps
+
+
+def main():
+    sys.path.insert(0, ".")
+    accel = jax.devices()[0]
+    log(f"accelerator: {accel}")
+
+    value = measure_samples_per_sec(accel, rows=16384, batch_size=256, window=8)
+
+    try:
+        cpu = jax.devices("cpu")[0]
+        # smaller run: the CPU proxy only needs a stable steady-state rate
+        # (this host exposes a single CPU core — documented in BASELINE.md)
+        baseline = measure_samples_per_sec(
+            cpu, rows=768, batch_size=64, window=3, epochs_timed=1
+        )
+    except Exception as e:  # CPU backend unavailable — report raw number only
+        log(f"cpu proxy failed: {e}")
+        baseline = float("nan")
+
+    vs = value / baseline if baseline == baseline else -1.0
+    print(json.dumps({
+        "metric": "adag_mnist_cnn_samples_per_sec",
+        "value": round(value, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(vs, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

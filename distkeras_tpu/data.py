@@ -117,17 +117,9 @@ class Dataset:
         appears at least once (some up to twice) — XLA shapes stay static.
         """
         n = len(self)
-        rows_per_super = num_workers * batch_size * window
-        n_super = n // rows_per_super
-        if drop_remainder:
-            if n_super == 0:
-                raise ValueError(
-                    f"dataset of {n} rows too small for one superbatch of "
-                    f"{rows_per_super} rows (workers={num_workers} × "
-                    f"window={window} × batch={batch_size})"
-                )
-        else:
-            n_super = -(-n // rows_per_super)  # ceil: cover every row
+        n_super, rows_per_super = self._superbatch_counts(
+            num_workers, batch_size, window, cover_all=not drop_remainder
+        )
         idx = (
             np.random.default_rng(seed).permutation(n)
             if seed is not None
@@ -145,6 +137,73 @@ class Dataset:
                 col = col.reshape((window, num_workers, batch_size) + col.shape[1:])
                 out.append(np.swapaxes(col, 0, 1))
             yield tuple(out)
+
+    def worker_shards(
+        self,
+        num_workers: int,
+        batch_size: int,
+        window: int,
+        columns: Sequence[str],
+        *,
+        seed: int | None = None,
+        cover_all: bool = False,
+    ) -> tuple[np.ndarray, ...]:
+        """Per-worker row shards ``[num_workers, rows_per_worker, …]``.
+
+        The device-resident staging layout: upload once, then each epoch is
+        reshaped/shuffled on device (``LocalSGDEngine.run_epoch_resident``).
+        Rows are assigned to workers with the SAME window-major interleave as
+        :meth:`superbatches` — a worker's shard flattens as
+        ``[n_super, window, batch]`` — so resident and streaming training see
+        identical data order when unshuffled, and class-sorted datasets never
+        give a worker a single-class shard.
+
+        ``cover_all=True`` wraps the tail so every row appears at least once
+        (some twice); ``False`` drops the tail like :meth:`superbatches`.
+        """
+        n_super, rows_per_super = self._superbatch_counts(
+            num_workers, batch_size, window, cover_all
+        )
+        idx = (
+            np.random.default_rng(seed).permutation(len(self))
+            if seed is not None
+            else np.arange(len(self))
+        )
+        if len(idx) < n_super * rows_per_super:  # wrap-pad (cover_all)
+            idx = np.resize(idx, n_super * rows_per_super)
+        idx = idx[: n_super * rows_per_super]
+        out = []
+        for c in columns:
+            col = self._columns[c][idx]
+            col = col.reshape(
+                (n_super, window, num_workers, batch_size) + col.shape[1:]
+            )
+            # [S, win, W, B, …] → [W, S, win, B, …] → [W, rows_per_worker, …]
+            col = np.moveaxis(col, 2, 0)
+            out.append(
+                col.reshape(
+                    (num_workers, n_super * window * batch_size) + col.shape[4:]
+                )
+            )
+        return tuple(out)
+
+    def _superbatch_counts(
+        self, num_workers: int, batch_size: int, window: int,
+        cover_all: bool = False,
+    ) -> tuple[int, int]:
+        """Shared sizing/validation for all superbatch assemblies."""
+        n = len(self)
+        rows_per_super = num_workers * batch_size * window
+        n_super = n // rows_per_super
+        if cover_all:
+            n_super = -(-n // rows_per_super)
+        elif n_super == 0:
+            raise ValueError(
+                f"dataset of {n} rows too small for one superbatch of "
+                f"{rows_per_super} rows (workers={num_workers} × "
+                f"window={window} × batch={batch_size})"
+            )
+        return n_super, rows_per_super
 
     def batches(
         self,

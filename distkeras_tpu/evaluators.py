@@ -1,0 +1,50 @@
+"""Evaluators: score a prediction column against a label column.
+
+Parity: reference ``distkeras/evaluators.py :: AccuracyEvaluator``
+(SURVEY.md §2b #17), extended with loss-based evaluation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.ops.losses import get_loss
+
+
+class AccuracyEvaluator:
+    """Fraction of rows where prediction matches label.
+
+    Handles prediction columns holding class scores (argmaxed), probabilities,
+    or already-integer indices; labels one-hot or integer.
+    """
+
+    def __init__(self, prediction_col: str = "prediction", label_col: str = "label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, ds: Dataset) -> float:
+        pred = ds[self.prediction_col]
+        if pred.ndim > 1 and pred.shape[-1] > 1:
+            pred = np.argmax(pred, axis=-1)
+        else:
+            pred = np.round(pred.reshape(len(ds), -1)[:, 0])
+        label = ds[self.label_col]
+        if label.ndim > 1 and label.shape[-1] > 1:
+            label = np.argmax(label, axis=-1)
+        else:
+            label = label.reshape(len(ds), -1)[:, 0]
+        return float(np.mean(pred.astype(np.int64) == label.astype(np.int64)))
+
+
+class LossEvaluator:
+    """Mean loss of a prediction column vs labels (any registered loss)."""
+
+    def __init__(self, loss="mse", prediction_col: str = "prediction",
+                 label_col: str = "label"):
+        self.loss_fn = get_loss(loss)
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, ds: Dataset) -> float:
+        return float(self.loss_fn(ds[self.label_col], ds[self.prediction_col]))

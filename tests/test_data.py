@@ -126,3 +126,25 @@ def test_dense_transformer_sparse_rows():
     out = T.DenseTransformer(dim=4).transform(ds)
     assert np.array_equal(out["features_dense"][0], [1.0, 0.0, 3.0, 0.0])
     assert np.array_equal(out["features_dense"][1], [0.0, 2.0, 0.0, 0.0])
+
+
+def test_worker_shards_matches_superbatch_interleave():
+    n, W, B, win = 96, 4, 3, 2
+    ds = make_ds(n)
+    shards = ds.worker_shards(W, B, win, ["features", "label"])
+    feats = shards[0]
+    assert feats.shape == (W, (n // (W * B * win)) * win * B, 4)
+    # reconstruct the streaming view and compare row-for-row
+    sbs = list(ds.superbatches(W, B, win, ["features", "label"]))
+    for s, (sf, _) in enumerate(sbs):
+        for w in range(W):
+            got = feats[w, s * win * B : (s + 1) * win * B]
+            expected = sf[w].reshape(win * B, 4)
+            assert np.array_equal(got, expected)
+
+
+def test_worker_shards_cover_all_wraps_tail():
+    ds = make_ds(100)
+    shards = ds.worker_shards(2, 8, 2, ["features"], seed=1, cover_all=True)
+    rows = (shards[0][..., 0].reshape(-1) / 4).astype(int)
+    assert set(rows.tolist()) == set(range(100))  # every row present

@@ -127,3 +127,56 @@ def test_deterministic_across_runs():
         results.append(jax.tree.leaves(p))
     for a, b in zip(*results):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resident_vs_streaming_identical_single_worker():
+    """W=1, no shuffle: the HBM-resident epoch path and the streaming
+    per-window path must produce bit-identical results."""
+    ds = blobs_dataset(n=512)
+    mesh = get_mesh(1)
+    common = dict(loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+                  learning_rate=0.05, batch_size=64, num_epoch=2, seed=11,
+                  num_workers=1, communication_window=2, mesh=mesh)
+    t_res = ADAG(model_spec(), device_data=True, **common)
+    p_res = t_res.train(ds)
+    t_str = ADAG(model_spec(), device_data=False, **common)
+    p_str = t_str.train(ds)
+    for a, b in zip(jax.tree.leaves(p_res), jax.tree.leaves(p_str)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # histories match too
+    la = [round(float(x), 6) for x in t_res.get_history().losses()]
+    lb = [round(float(x), 6) for x in t_str.get_history().losses()]
+    assert la == lb
+
+
+def test_resident_vs_streaming_identical_multi_worker():
+    """W=8, no shuffle: worker_shards' interleave must match superbatches',
+    so both data paths produce bit-identical training."""
+    ds = blobs_dataset(n=2048)
+    common = dict(loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+                  learning_rate=0.05, batch_size=16, num_epoch=2, seed=5,
+                  num_workers=8, communication_window=2)
+    p_res = ADAG(model_spec(), device_data=True, **common).train(ds)
+    p_str = ADAG(model_spec(), device_data=False, **common).train(ds)
+    for a, b in zip(jax.tree.leaves(p_res), jax.tree.leaves(p_str)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_streaming_mode_learns_on_mesh():
+    ds = blobs_dataset(n=4096)
+    t = ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+             worker_optimizer="sgd", learning_rate=0.1, num_workers=8,
+             batch_size=32, communication_window=4, num_epoch=3,
+             device_data=False)
+    t.train(ds, shuffle=True)
+    assert final_loss(t) < 0.5
+
+
+def test_resident_shuffle_changes_order_but_still_learns():
+    ds = blobs_dataset(n=2048)
+    t = ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+             worker_optimizer="sgd", learning_rate=0.1, num_workers=8,
+             batch_size=16, communication_window=2, num_epoch=3,
+             device_data=True)
+    t.train(ds, shuffle=True)
+    assert final_loss(t) < 0.4
