@@ -1,0 +1,72 @@
+"""Transport layer: length-prefixed framing over TCP, host discovery.
+
+Parity: reference ``distkeras/networking.py`` — ``determine_host_address()``,
+``connect(host, port)``, ``send_data(sock, obj)`` / ``recv_data(sock)`` with
+pickled, length-prefixed frames (SURVEY.md §2b #13).
+
+Role in the rebuild: the DEFAULT parameter exchange is XLA collectives over
+ICI and never touches this module. TCP framing remains for the genuinely
+asynchronous parameter-server backend (``backend="ps"`` with
+``ps_transport="socket"``) — the path that generalizes to a PS reachable over
+DCN from multiple pod slices, where a compiler-scheduled collective cannot
+express true asynchrony.
+
+Framing: 8-byte big-endian length + payload. Payloads are
+``utils.serialize_weights`` blobs or small pickled control dicts; as in the
+reference, the wire format assumes both ends are the same trusted training
+job (do not expose the PS port beyond the job's network).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+from typing import Any
+
+_LEN = struct.Struct(">Q")
+
+
+def determine_host_address() -> str:
+    """Best-effort routable address of this host.
+
+    Parity: reference ``distkeras/networking.py :: determine_host_address``.
+    Uses the UDP-connect trick (no packets sent); falls back to loopback on
+    isolated hosts.
+    """
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def connect(host: str, port: int, timeout: float | None = 30.0) -> socket.socket:
+    """Open a TCP connection with Nagle disabled (small-frame latency)."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def send_data(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_data(sock: socket.socket) -> Any:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, length))

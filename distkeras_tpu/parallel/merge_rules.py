@@ -105,7 +105,8 @@ class DownpourMerge(MergeRule):
         return center, _reset_to(center, workers)
 
     def fold(self, center, commit, num_workers, staleness):
-        return jax.tree.map(jnp.add, center, commit)
+        # operator add: keeps host-side PS folds in numpy (see ElasticAverage)
+        return jax.tree.map(lambda c, d: c + d, center, commit)
 
 
 class ElasticAverageMerge(MergeRule):
@@ -153,7 +154,8 @@ class ElasticAverageMerge(MergeRule):
 
     def fold(self, center, commit, num_workers, staleness):
         # Async form: commit is already the elastic difference alpha·(w − c).
-        return jax.tree.map(jnp.add, center, commit)
+        # Operator add keeps host-side PS folds in numpy (no device bounce).
+        return jax.tree.map(lambda c, d: c + d, center, commit)
 
     def worker_commit(self, worker, center):
         """Async worker side: elastic difference, subtracted locally too."""

@@ -1,0 +1,220 @@
+"""Asynchronous parameter servers — the reference's center-variable semantics.
+
+Parity: reference ``distkeras/parameter_servers.py`` — ``ParameterServer``
+base with ``initialize / run / stop / get_model / num_updates``, a socket
+service loop (one handler thread per connection, a lock around the center
+weights, the self-connect ``cancel_accept`` shutdown trick), and per-algorithm
+commit folds (SURVEY.md §2b #11-12, §3.3).
+
+Role in the rebuild: the default path never runs a server — parameter exchange
+is a collective. This module exists for the *true-async* mode
+(``backend="ps"``): hogwild-style workers (host threads driving their own
+chip) pull/commit against a center that folds commits one at a time, exactly
+like the reference. The fold math is the SAME ``MergeRule.fold`` used by the
+sync lowering, so the unit tests pin both backends to one oracle. The socket
+variant is the DCN story: a PS reachable across pod slices.
+
+Staleness is tracked for real here: ``pull`` records the center version a
+worker saw; ``commit`` computes τ = center updates since that pull and hands
+it to the rule (DynSGD scales by 1/(τ+1); other rules ignore it).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from distkeras_tpu import networking, utils
+from distkeras_tpu.parallel.merge_rules import MergeRule
+
+Pytree = Any
+
+
+class ParameterServer:
+    """In-process center variable with per-algorithm fold semantics.
+
+    Base class of the hierarchy (reference ``ParameterServer``); also directly
+    usable as the shared-memory PS for same-process worker threads
+    (``ps_transport="inprocess"``).
+    """
+
+    def __init__(self, center: Pytree, rule: MergeRule, num_workers: int):
+        self.center = utils.tree_to_numpy(center)
+        self.rule = rule
+        self.num_workers = int(num_workers)
+        self.num_updates = 0
+        self._lock = threading.Lock()
+        self._pull_versions: dict[int, int] = {}
+
+    # -- service lifecycle (no-ops for the in-process PS) --------------------
+
+    def initialize(self) -> None:
+        pass
+
+    def run(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    # -- the wire actions ----------------------------------------------------
+
+    def pull(self, worker_id: int) -> Pytree:
+        """Return current center weights, recording the version seen."""
+        with self._lock:
+            self._pull_versions[worker_id] = self.num_updates
+            return jax_tree_copy(self.center)
+
+    def commit(self, worker_id: int, payload: Pytree) -> None:
+        """Fold one worker's commit into the center under the lock."""
+        with self._lock:
+            staleness = self.num_updates - self._pull_versions.get(worker_id, 0)
+            self.center = utils.tree_to_numpy(
+                self.rule.fold(
+                    self.center, payload, self.num_workers, staleness
+                )
+            )
+            self.num_updates += 1
+
+    def get_model(self) -> Pytree:
+        with self._lock:
+            return jax_tree_copy(self.center)
+
+
+def jax_tree_copy(tree: Pytree) -> Pytree:
+    import jax
+
+    return jax.tree.map(np.copy, tree)
+
+
+class SocketParameterServer(ParameterServer):
+    """TCP service wrapper: the reference's driver-hosted PS, DCN-ready.
+
+    Wire protocol (length-prefixed pickled frames, ``networking.py``):
+    client sends ``{"action": "pull"|"commit"|"stop", "worker_id": i,
+    "payload": blob?}``; ``pull`` answers with serialized weights.
+    """
+
+    def __init__(self, center: Pytree, rule: MergeRule, num_workers: int,
+                 host: str = "127.0.0.1", port: int = 0):
+        super().__init__(center, rule, num_workers)
+        self.host = host
+        self.port = int(port)
+        self._server_sock: Any = None
+        self._service_thread: threading.Thread | None = None
+        self._handlers: list[threading.Thread] = []
+        self._running = False
+
+    def initialize(self) -> None:
+        import socket as _socket
+
+        self._server_sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        self._server_sock.setsockopt(
+            _socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1
+        )
+        self._server_sock.bind((self.host, self.port))
+        self.port = self._server_sock.getsockname()[1]  # ephemeral resolved
+        self._server_sock.listen(64)
+        self._running = True
+
+    def start(self) -> None:
+        """Run the accept loop in a daemon thread (reference ``service()``)."""
+        self._service_thread = threading.Thread(target=self.run, daemon=True)
+        self._service_thread.start()
+
+    def run(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._server_sock.accept()
+            except OSError:
+                break
+            if not self._running:
+                conn.close()
+                break
+            conn.setsockopt(
+                __import__("socket").IPPROTO_TCP,
+                __import__("socket").TCP_NODELAY, 1,
+            )
+            t = threading.Thread(target=self._handle, args=(conn,), daemon=True)
+            t.start()
+            self._handlers.append(t)
+
+    def _handle(self, conn) -> None:
+        try:
+            while True:
+                msg = networking.recv_data(conn)
+                action = msg.get("action")
+                if action == "pull":
+                    weights = self.pull(msg["worker_id"])
+                    networking.send_data(
+                        conn, utils.serialize_weights(weights)
+                    )
+                elif action == "commit":
+                    self.commit(
+                        msg["worker_id"],
+                        utils.deserialize_weights(msg["payload"]),
+                    )
+                    networking.send_data(conn, {"ok": True})
+                elif action in ("stop", "bye"):
+                    break
+                else:
+                    networking.send_data(conn, {"error": f"bad action {action}"})
+        except (ConnectionError, EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self) -> None:
+        """Shut down, unblocking ``accept`` via the reference's self-connect
+        trick (``cancel_accept``), with a socket close as backstop."""
+        if not self._running:
+            return
+        self._running = False
+        try:
+            with networking.connect(self.host, self.port, timeout=5) as s:
+                networking.send_data(s, {"action": "bye"})
+        except OSError:
+            pass
+        if self._server_sock is not None:
+            self._server_sock.close()  # unblocks accept even if connect failed
+        if self._service_thread is not None:
+            self._service_thread.join(timeout=5)
+
+
+class ParameterServerClient:
+    """Worker-side proxy speaking the socket protocol (same call surface as
+    the in-process PS, so workers are transport-agnostic)."""
+
+    def __init__(self, host: str, port: int, worker_id: int):
+        self.worker_id = worker_id
+        self._sock = networking.connect(host, port)
+        # Blocking ops: a pull may legitimately wait behind many commits
+        # (GIL-contended host, slow DCN link) — don't time out mid-training.
+        self._sock.settimeout(None)
+
+    def pull(self, worker_id: int | None = None) -> Pytree:
+        networking.send_data(
+            self._sock,
+            {"action": "pull", "worker_id": self.worker_id},
+        )
+        return utils.deserialize_weights(networking.recv_data(self._sock))
+
+    def commit(self, worker_id: int | None, payload: Pytree) -> None:
+        networking.send_data(
+            self._sock,
+            {
+                "action": "commit",
+                "worker_id": self.worker_id,
+                "payload": utils.serialize_weights(payload),
+            },
+        )
+        networking.recv_data(self._sock)  # ack
+
+    def close(self) -> None:
+        try:
+            networking.send_data(self._sock, {"action": "bye"})
+        except OSError:
+            pass
+        self._sock.close()

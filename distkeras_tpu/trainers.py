@@ -160,7 +160,8 @@ class DistributedTrainer(Trainer):
                  features_col="features", label_col: str = "label",
                  num_epoch: int = 1, communication_window: int | None = None,
                  backend: str = "collective", mesh=None, seed: int = 0,
-                 device_data: bool | None = None):
+                 device_data: bool | None = None,
+                 ps_transport: str = "inprocess", ps_port: int = 0):
         super().__init__(keras_model, loss, worker_optimizer,
                          learning_rate=learning_rate, seed=seed)
         self.mesh = mesh if mesh is not None else get_mesh(num_workers)
@@ -181,6 +182,15 @@ class DistributedTrainer(Trainer):
         if backend not in ("collective", "ps"):
             raise ValueError(f"backend must be 'collective' or 'ps', got {backend!r}")
         self.backend = backend
+        # PS-backend options: in-process shared-memory PS (single host) or a
+        # TCP socket PS (the DCN/multi-slice story).
+        if ps_transport not in ("inprocess", "socket"):
+            raise ValueError(
+                f"ps_transport must be 'inprocess' or 'socket', got "
+                f"{ps_transport!r}"
+            )
+        self.ps_transport = ps_transport
+        self.ps_port = ps_port
         # device_data=True stages each epoch in HBM and scans all windows in
         # one dispatch; None = auto (on when the epoch fits the budget).
         self.device_data = device_data
@@ -271,15 +281,11 @@ class DistributedTrainer(Trainer):
         )
 
     def _train_ps(self, ds: Dataset, shuffle: bool):
-        try:
-            from distkeras_tpu.workers import run_async_training
-        except ImportError as e:
-            raise NotImplementedError(
-                "the async parameter-server backend is not available in this "
-                "build"
-            ) from e
+        from distkeras_tpu.workers import run_async_training
 
+        self.record_training_start()
         params, nt, history = run_async_training(self, ds, shuffle)
+        self.record_training_end()
         for rec in history:
             self.history.append(**rec)
         return self._finalize(params, nt)

@@ -1,0 +1,241 @@
+"""Async workers — hogwild replicas driving devices from host threads.
+
+Parity: reference ``distkeras/workers.py`` — per-algorithm workers whose
+``train(index, iterator)`` ran inside Spark executors: deserialize model,
+local ``train_on_batch`` loop, ``pull``/``commit`` against the PS every
+``communication_window`` batches (SURVEY.md §3.1). Here each worker is a host
+thread that owns a jitted local-window function executing on its assigned
+device (``jax.devices()[i % n]``); the thread does pull → window-on-device →
+commit, overlapping freely with other workers — genuinely asynchronous, like
+the reference, unlike the lockstep collective backend.
+
+The per-algorithm commit payloads match §2b.3:
+
+- ADAG / DOWNPOUR / DynSGD: window weight delta vs the pulled center (equal to
+  the accumulated optimizer update); worker re-bases onto the fresh center
+  after each commit.
+- AEASGD / EAMSGD: elastic difference ``alpha · (worker − center)``; the
+  worker subtracts it locally and keeps its own variable across windows.
+
+The center-side fold semantics live in ``MergeRule.fold`` (shared with the
+sync backend's oracle tests).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+
+from distkeras_tpu import utils
+from distkeras_tpu.parallel.merge_rules import ElasticAverageMerge
+from distkeras_tpu.parameter_servers import (
+    ParameterServer,
+    ParameterServerClient,
+    SocketParameterServer,
+)
+
+Pytree = Any
+
+
+def _build_local_window(loss_step, optimizer):
+    """One worker's jitted window: scan `window` local steps on its device."""
+    import optax
+
+    def window(params, nt, opt, batches):
+        def one_step(carry, batch):
+            params, nt, opt = carry
+            (loss, new_nt), grads = jax.value_and_grad(loss_step, has_aux=True)(
+                params, nt, batch
+            )
+            updates, opt = optimizer.update(grads, opt, params)
+            params = optax.apply_updates(params, updates)
+            return (params, new_nt, opt), loss
+
+        (params, nt, opt), losses = jax.lax.scan(
+            one_step, (params, nt, opt), batches
+        )
+        return params, nt, opt, jax.numpy.mean(losses)
+
+    return jax.jit(window)
+
+
+class AsyncWorker:
+    """One training replica on one device, exchanging with the PS."""
+
+    def __init__(self, worker_id: int, device, window_fn, optimizer, ps,
+                 rule, window: int, batch_size: int, nt, history, lock):
+        self.worker_id = worker_id
+        self.device = device
+        self.window_fn = window_fn
+        self.optimizer = optimizer
+        self.ps = ps
+        self.rule = rule
+        self.window = window
+        self.batch_size = batch_size
+        self.nt = nt
+        self.history = history
+        self.lock = lock
+        self.error: BaseException | None = None
+
+    def train(self, index: int, shard_cols: tuple, num_epoch: int,
+              shuffle: bool, seed: int) -> None:
+        """Reference signature spirit: ``Worker.train(index, iterator)``."""
+        try:
+            self._train(index, shard_cols, num_epoch, shuffle, seed)
+        except BaseException as e:  # surface thread failures to the driver
+            self.error = e
+
+    def _train(self, index, shard_cols, num_epoch, shuffle, seed):
+        rows = len(shard_cols[0])
+        win_rows = self.window * self.batch_size
+        n_windows = rows // win_rows
+        elastic = isinstance(self.rule, ElasticAverageMerge)
+
+        center = self.ps.pull(self.worker_id)
+        params = jax.device_put(center, self.device)
+        nt = jax.device_put(self.nt, self.device)
+        opt = jax.jit(self.optimizer.init)(params)
+
+        for epoch in range(num_epoch):
+            order = (
+                np.random.default_rng((seed, index, epoch)).permutation(rows)
+                if shuffle
+                else np.arange(rows)
+            )
+            for w in range(n_windows):
+                sl = order[w * win_rows : (w + 1) * win_rows]
+                batches = tuple(
+                    c[sl].reshape((self.window, self.batch_size) + c.shape[1:])
+                    for c in shard_cols
+                )
+                batches = jax.device_put(batches, self.device)
+                params, nt, opt, loss = self.window_fn(params, nt, opt, batches)
+
+                if elastic:
+                    # pull a FRESH center at exchange time (reference EASGD
+                    # semantics), commit the elastic difference, keep own
+                    # variable moved toward the center
+                    center = self.ps.pull(self.worker_id)
+                    host_params = utils.tree_to_numpy(params)
+                    diff = self.rule.worker_commit(host_params, center)
+                    self.ps.commit(self.worker_id, diff)
+                    params = jax.device_put(
+                        jax.tree.map(lambda p, d: p - d, host_params, diff),
+                        self.device,
+                    )
+                else:
+                    # commit window delta; re-base onto the fresh center
+                    delta = jax.tree.map(
+                        lambda p, c: np.asarray(p) - c,
+                        utils.tree_to_numpy(params), center,
+                    )
+                    self.ps.commit(self.worker_id, delta)
+                    center = self.ps.pull(self.worker_id)
+                    params = jax.device_put(center, self.device)
+
+                with self.lock:
+                    self.history.append({
+                        "loss": float(loss),
+                        "epoch": epoch,
+                        "worker": self.worker_id,
+                    })
+        self.final_nt = utils.tree_to_numpy(nt)
+
+
+def run_async_training(trainer, ds, shuffle: bool):
+    """Drive the PS backend for a DistributedTrainer (reference: the
+    ``mapPartitionsWithIndex(worker.train).collect()`` job).
+
+    Returns ``(center_params, nt, history_records)``.
+    """
+    spec = trainer.spec
+    rule = trainer.allocate_merge_rule()
+    optimizer = trainer.allocate_optimizer()
+    params, nt = spec.init_np(trainer.seed)
+    W = trainer.num_workers
+
+    transport = getattr(trainer, "ps_transport", "inprocess")
+    if transport == "socket":
+        ps = SocketParameterServer(
+            params, rule, W, port=getattr(trainer, "ps_port", 0)
+        )
+        ps.initialize()
+        ps.start()
+        clients = [
+            ParameterServerClient("127.0.0.1", ps.port, i) for i in range(W)
+        ]
+    elif transport == "inprocess":
+        ps = ParameterServer(params, rule, W)
+        clients = [_BoundPS(ps, i) for i in range(W)]
+    else:
+        raise ValueError(f"unknown ps_transport {transport!r}")
+
+    cols = trainer.features_col + [trainer.label_col]
+    shards = ds.worker_shards(
+        W, trainer.batch_size, trainer.communication_window, cols,
+        seed=trainer.seed if shuffle else None, cover_all=shuffle,
+    )  # tuple of [W, rows_pw, …]
+
+    window_fn = _build_local_window(trainer._loss_step(), optimizer)
+    devices = jax.devices()
+    history: list[dict] = []
+    hlock = threading.Lock()
+
+    workers = [
+        AsyncWorker(
+            i, devices[i % len(devices)], window_fn, optimizer,
+            clients[i], rule, trainer.communication_window,
+            trainer.batch_size, nt, history, hlock,
+        )
+        for i in range(W)
+    ]
+    threads = [
+        threading.Thread(
+            target=w.train,
+            args=(
+                i,
+                tuple(col[i] for col in shards),
+                trainer.num_epoch,
+                shuffle,
+                trainer.seed,
+            ),
+            daemon=True,
+        )
+        for i, w in enumerate(workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    if transport == "socket":
+        for c in clients:
+            c.close()
+    ps.stop()
+
+    errors = [w.error for w in workers if w.error is not None]
+    if errors:
+        raise errors[0]
+
+    final_nt = getattr(workers[0], "final_nt", nt)
+    return ps.get_model(), final_nt, history
+
+
+class _BoundPS:
+    """In-process client proxy: binds a worker_id to the shared PS object."""
+
+    def __init__(self, ps: ParameterServer, worker_id: int):
+        self._ps = ps
+        self.worker_id = worker_id
+
+    def pull(self, worker_id: int | None = None):
+        return self._ps.pull(self.worker_id)
+
+    def commit(self, worker_id: int | None, payload):
+        self._ps.commit(self.worker_id, payload)
+
+    def close(self):
+        pass

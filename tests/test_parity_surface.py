@@ -80,18 +80,18 @@ def test_aeasgd_warns_on_unstable_alpha():
     assert any("overshoot" in str(x.message) for x in w)
 
 
-def test_ps_backend_not_yet_available_is_clean():
-    import pytest
+def test_ps_backend_available_and_trains():
+    import jax.numpy as jnp
     from distkeras_tpu import ADAG
     from distkeras_tpu.data import Dataset
     from distkeras_tpu.models import mlp
 
-    ds = Dataset({"features": np.zeros((64, 4), np.float32),
+    ds = Dataset({"features": np.random.default_rng(0).normal(
+                      size=(64, 4)).astype(np.float32),
                   "label": np.zeros(64, np.int32)})
-    t = ADAG(mlp(input_shape=(4,), hidden=(8,), num_classes=2),
-             loss="mse", num_workers=1, backend="ps")
-    try:
-        t.train(ds)
-    except NotImplementedError:
-        pass  # acceptable until the PS backend lands
-    # once distkeras_tpu.workers exists this must train instead
+    t = ADAG(mlp(input_shape=(4,), hidden=(8,), num_classes=2,
+                 dtype=jnp.float32),
+             loss="sparse_softmax_cross_entropy", num_workers=1,
+             batch_size=16, communication_window=2, backend="ps")
+    t.train(ds)
+    assert len(t.get_history()) > 0

@@ -1,0 +1,122 @@
+"""Async parameter-server backend: networking, PS folds, hogwild training."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import networking, utils
+from distkeras_tpu.parallel.merge_rules import (
+    ADAGMerge,
+    DownpourMerge,
+    DynSGDMerge,
+)
+from distkeras_tpu.parameter_servers import (
+    ParameterServer,
+    ParameterServerClient,
+    SocketParameterServer,
+)
+from tests.test_trainers import blobs_dataset, final_loss, model_spec
+
+
+def test_framing_roundtrip_over_socketpair():
+    import socket
+
+    a, b = socket.socketpair()
+    payload = {"action": "commit", "x": np.arange(5, dtype=np.float32)}
+    networking.send_data(a, payload)
+    got = networking.recv_data(b)
+    assert got["action"] == "commit"
+    assert np.array_equal(got["x"], payload["x"])
+    a.close(); b.close()
+
+
+def test_determine_host_address_returns_ip():
+    addr = networking.determine_host_address()
+    assert isinstance(addr, str) and addr.count(".") == 3
+
+
+def test_inprocess_ps_fold_and_version_counting():
+    center = {"w": np.zeros(3, np.float32)}
+    ps = ParameterServer(center, DownpourMerge(), num_workers=2)
+    w0 = ps.pull(0)
+    assert np.array_equal(w0["w"], [0, 0, 0])
+    ps.commit(0, {"w": np.ones(3, np.float32)})
+    ps.commit(1, {"w": np.ones(3, np.float32)})
+    assert ps.num_updates == 2
+    assert np.allclose(ps.get_model()["w"], 2.0)
+
+
+def test_ps_staleness_tracking_dynsgd():
+    """Worker 0 pulls at version 0; two other commits land before worker 0's
+    commit → τ=2 → scale 1/3."""
+    center = {"w": np.zeros(1, np.float32)}
+    ps = ParameterServer(center, DynSGDMerge(), num_workers=3)
+    ps.pull(0)
+    ps.pull(1); ps.commit(1, {"w": np.array([3.0], np.float32)})  # τ=0 → 3.0
+    ps.pull(2); ps.commit(2, {"w": np.array([4.0], np.float32)})  # τ=0 → +4
+    ps.commit(0, {"w": np.array([3.0], np.float32)})              # τ=2 → +1
+    assert np.allclose(ps.get_model()["w"], 3.0 + 4.0 + 1.0)
+
+
+def test_socket_ps_pull_commit_concurrent():
+    center = {"w": np.zeros(4, np.float32), "b": np.zeros(2, np.float32)}
+    ps = SocketParameterServer(center, ADAGMerge(), num_workers=4)
+    ps.initialize()
+    ps.start()
+    try:
+        def worker(i):
+            c = ParameterServerClient("127.0.0.1", ps.port, i)
+            for _ in range(5):
+                c.pull()
+                c.commit(i, {"w": np.full(4, 0.5, np.float32),
+                             "b": np.full(2, 0.25, np.float32)})
+            c.close()
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # ADAG fold: each commit adds payload / num_workers
+        assert ps.num_updates == 20
+        assert np.allclose(ps.get_model()["w"], 20 * 0.5 / 4)
+        assert np.allclose(ps.get_model()["b"], 20 * 0.25 / 4)
+    finally:
+        ps.stop()
+
+
+@pytest.mark.parametrize("cls_name,kw", [
+    ("ADAG", dict(communication_window=2)),
+    ("DOWNPOUR", dict(communication_window=2, learning_rate=0.02)),
+    ("AEASGD", dict(communication_window=4, learning_rate=0.05, rho=0.5)),
+    ("EAMSGD", dict(communication_window=4, learning_rate=0.05, rho=0.5,
+                    momentum=0.8)),
+    ("DynSGD", dict(communication_window=2)),
+])
+def test_ps_backend_trainers_learn(cls_name, kw):
+    import distkeras_tpu as dk
+
+    ds = blobs_dataset(n=2048)
+    kw.setdefault("learning_rate", 0.1)
+    cls = getattr(dk, cls_name)
+    t = cls(model_spec(), loss="sparse_softmax_cross_entropy",
+            worker_optimizer="sgd", num_workers=4, batch_size=32,
+            num_epoch=3, backend="ps", **kw)
+    t.train(ds, shuffle=True)
+    assert final_loss(t) < 0.6, f"{cls_name} ps backend: {final_loss(t)}"
+    # history carries per-worker records
+    workers_seen = {r.get("worker") for r in t.get_history()}
+    assert workers_seen == {0, 1, 2, 3}
+
+
+def test_ps_backend_socket_transport_end_to_end():
+    from distkeras_tpu import ADAG
+
+    ds = blobs_dataset(n=1024)
+    t = ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+             worker_optimizer="sgd", learning_rate=0.1, num_workers=2,
+             batch_size=32, communication_window=2, num_epoch=2,
+             backend="ps", ps_transport="socket")
+    t.train(ds, shuffle=True)
+    assert final_loss(t) < 0.6
