@@ -1,0 +1,66 @@
+"""Checkpoint/resume — strictly-better recovery than the reference.
+
+The reference had NO checkpointing: the trained model existed only in the
+driver-process PS at run end, and a driver failure lost the run (SURVEY.md §5.3
+/ §5.4). Here the full training state (center params, stacked worker params,
+optimizer state, step) is snapshotted atomically at epoch boundaries and a
+trainer can resume mid-run.
+
+Format: one file per checkpoint — ``utils.serialize_weights`` blob (npz +
+treedef) written to a temp name and atomically renamed, plus a small JSON
+sidecar index. No external checkpoint service needed; works on any POSIX
+filesystem (GCS-fuse on pods).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any
+
+import jax
+
+from distkeras_tpu import utils
+
+Pytree = Any
+
+_PREFIX = "ckpt_"
+_SUFFIX = ".dkc"
+
+
+def save_checkpoint(directory, tree: Pytree, step: int, keep: int = 3) -> Path:
+    """Atomically write ``tree`` as checkpoint ``step``; prune old ones."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    host_tree = jax.tree.map(lambda x: jax.device_get(x), tree)
+    blob = utils.serialize_weights(host_tree)
+    final = directory / f"{_PREFIX}{step:012d}{_SUFFIX}"
+    tmp = directory / f".tmp_{final.name}"
+    tmp.write_bytes(blob)
+    os.replace(tmp, final)
+    (directory / "latest.json").write_text(
+        json.dumps({"step": step, "file": final.name})
+    )
+    for old in sorted(directory.glob(f"{_PREFIX}*{_SUFFIX}"))[:-keep]:
+        old.unlink(missing_ok=True)
+    return final
+
+
+def latest_step(directory) -> int | None:
+    directory = Path(directory)
+    ckpts = sorted(directory.glob(f"{_PREFIX}*{_SUFFIX}"))
+    if not ckpts:
+        return None
+    return int(ckpts[-1].name[len(_PREFIX) : -len(_SUFFIX)])
+
+
+def restore_checkpoint(directory, step: int | None = None) -> tuple[Pytree, int]:
+    """Load checkpoint ``step`` (default: latest). Returns (tree, step)."""
+    directory = Path(directory)
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = directory / f"{_PREFIX}{step:012d}{_SUFFIX}"
+    return utils.deserialize_weights(path.read_bytes()), step

@@ -1,0 +1,128 @@
+"""Multi-host launching — the successor of the reference's remote submission.
+
+Parity: reference ``distkeras/job_deployment.py :: Job`` (+ ``Punchcard``
+manifest) packaged a training script and submitted it to a remote Spark
+cluster over SSH (SURVEY.md §3.5). The TPU-pod equivalent has two parts:
+
+- :func:`initialize_cluster` — in-process multi-host bring-up: wraps
+  ``jax.distributed.initialize`` (TPU pods auto-discover coordinator/topology
+  from the TPU metadata env; explicit args cover CPU/GPU clusters). After it
+  returns, ``jax.devices()`` spans every host's chips and the collective
+  backend works unchanged — replica placement needs no scheduler at all.
+- :class:`Job` — host-fan-out helper: renders the per-host launch commands
+  (``ssh host python script.py`` with coordinator env) from a
+  :class:`Punchcard` manifest, and can execute them via a pluggable runner.
+  With no SSH available (this build environment has zero egress) the default
+  runner just returns the commands; operators or tests inject their own.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Sequence
+
+
+def initialize_cluster(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids: Sequence[int] | None = None,
+) -> dict:
+    """Join this process to the training cluster.
+
+    On TPU pods call with no arguments on every host (libtpu discovers the
+    coordinator). Returns a summary dict of the global topology.
+    """
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = int(num_processes)
+    if process_id is not None:
+        kwargs["process_id"] = int(process_id)
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = list(local_device_ids)
+    jax.distributed.initialize(**kwargs)
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+    }
+
+
+@dataclass
+class Punchcard:
+    """Job manifest (parity: reference ``Punchcard`` [U], SURVEY.md §2b #18)."""
+
+    script: str
+    hosts: list[str] = field(default_factory=list)
+    coordinator_port: int = 8476
+    env: dict = field(default_factory=dict)
+    args: list[str] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path) -> "Punchcard":
+        return cls(**json.loads(Path(path).read_text()))
+
+    def save(self, path) -> None:
+        Path(path).write_text(json.dumps(self.__dict__, indent=2))
+
+
+class Job:
+    """Render/execute the per-host launch fan-out for a Punchcard.
+
+    Parity: reference ``Job.run()`` (SSH → spark-submit). The runner is a
+    callable ``(host, command) -> None``; the default collects commands
+    without executing (no network in this environment).
+    """
+
+    def __init__(self, punchcard: Punchcard,
+                 runner: Callable[[str, str], None] | None = None):
+        self.punchcard = punchcard
+        self.runner = runner
+        self.commands: list[tuple[str, str]] = []
+
+    def render_commands(self) -> list[tuple[str, str]]:
+        pc = self.punchcard
+        hosts = pc.hosts or ["localhost"]
+        coordinator = f"{hosts[0]}:{pc.coordinator_port}"
+        cmds = []
+        for i, host in enumerate(hosts):
+            env = {
+                "DISTKERAS_COORDINATOR": coordinator,
+                "DISTKERAS_NUM_PROCESSES": str(len(hosts)),
+                "DISTKERAS_PROCESS_ID": str(i),
+                **pc.env,
+            }
+            env_str = " ".join(
+                f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
+            )
+            argv = " ".join(shlex.quote(a) for a in [pc.script, *pc.args])
+            cmds.append((host, f"{env_str} python {argv}"))
+        return cmds
+
+    def run(self) -> list[tuple[str, str]]:
+        self.commands = self.render_commands()
+        if self.runner is not None:
+            for host, cmd in self.commands:
+                self.runner(host, cmd)
+        return self.commands
+
+
+def cluster_args_from_env() -> dict:
+    """Read the DISTKERAS_* coordinator env set by :class:`Job`."""
+    out = {}
+    if addr := os.environ.get("DISTKERAS_COORDINATOR"):
+        out["coordinator_address"] = addr
+    if n := os.environ.get("DISTKERAS_NUM_PROCESSES"):
+        out["num_processes"] = int(n)
+    if i := os.environ.get("DISTKERAS_PROCESS_ID"):
+        out["process_id"] = int(i)
+    return out

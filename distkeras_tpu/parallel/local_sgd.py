@@ -126,6 +126,20 @@ class LocalSGDEngine:
         self._build_window_step(state)
         return state
 
+    def init_state_from(self, host_state: TrainState) -> TrainState:
+        """Place a restored (host) TrainState onto the mesh (resume path)."""
+        leaves = jax.tree.leaves(host_state.workers)
+        if leaves and leaves[0].shape[0] != self.num_workers:
+            raise ValueError(
+                f"checkpoint has {leaves[0].shape[0]} workers, engine expects "
+                f"{self.num_workers}"
+            )
+        self._abstract_state = jax.eval_shape(lambda s: s, host_state)
+        shardings = self._state_shardings(self._abstract_state)
+        state = jax.device_put(host_state, _as_tree(shardings))
+        self._build_window_step(state)
+        return state
+
     # -- the jitted window ---------------------------------------------------
 
     def _window_fn(self, state: TrainState, batch: tuple):

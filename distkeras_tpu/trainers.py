@@ -161,7 +161,9 @@ class DistributedTrainer(Trainer):
                  num_epoch: int = 1, communication_window: int | None = None,
                  backend: str = "collective", mesh=None, seed: int = 0,
                  device_data: bool | None = None,
-                 ps_transport: str = "inprocess", ps_port: int = 0):
+                 ps_transport: str = "inprocess", ps_port: int = 0,
+                 checkpoint_dir=None, checkpoint_every: int = 1,
+                 resume: bool = False):
         super().__init__(keras_model, loss, worker_optimizer,
                          learning_rate=learning_rate, seed=seed)
         self.mesh = mesh if mesh is not None else get_mesh(num_workers)
@@ -195,6 +197,11 @@ class DistributedTrainer(Trainer):
         # one dispatch; None = auto (on when the epoch fits the budget).
         self.device_data = device_data
         self.device_data_budget_bytes = 512 * 1024 * 1024
+        # Checkpoint/resume (absent in the reference — SURVEY.md §5.4):
+        # snapshot full TrainState every `checkpoint_every` epochs.
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.resume = bool(resume)
 
     # -- seams kept from the reference ------------------------------------
 
@@ -239,6 +246,14 @@ class DistributedTrainer(Trainer):
         )
         params, nt = self.spec.init_np(self.seed)
         state = engine.init_state(params, nt)
+        start_epoch = 0
+        if self.checkpoint_dir and self.resume:
+            from distkeras_tpu import checkpoint as ckpt
+
+            if ckpt.latest_step(self.checkpoint_dir) is not None:
+                payload, step = ckpt.restore_checkpoint(self.checkpoint_dir)
+                state = engine.init_state_from(payload["state"])
+                start_epoch = int(payload["epoch"]) + 1
         cols = self.features_col + [self.label_col]
 
         use_resident = self.device_data
@@ -259,13 +274,14 @@ class DistributedTrainer(Trainer):
                 self.num_workers, self.batch_size, self.communication_window,
                 cols, seed=self.seed if shuffle else None, cover_all=shuffle,
             ))
-            for epoch in range(self.num_epoch):
+            for epoch in range(start_epoch, self.num_epoch):
                 seed = (self.seed + epoch) if shuffle else None
                 state, losses = engine.run_epoch_resident(state, staged, seed)
                 # losses: device array [windows] — no host sync in the loop
                 self.history.append(losses=losses, epoch=epoch)
+                self._maybe_checkpoint(state, epoch)
         else:
-            for epoch in range(self.num_epoch):
+            for epoch in range(start_epoch, self.num_epoch):
                 seed = (self.seed + epoch) if shuffle else None
                 for batch in ds.superbatches(
                     self.num_workers, self.batch_size,
@@ -273,6 +289,7 @@ class DistributedTrainer(Trainer):
                 ):
                     state, loss = engine.run_window(state, batch)
                     self.history.append(loss=loss, epoch=epoch)
+                self._maybe_checkpoint(state, epoch)
         jax.block_until_ready(state.center)
         self.record_training_end()
         self._materialize_history()
@@ -290,6 +307,17 @@ class DistributedTrainer(Trainer):
             self.history.append(**rec)
         return self._finalize(params, nt)
 
+    def _maybe_checkpoint(self, state, epoch: int):
+        if not self.checkpoint_dir:
+            return
+        if (epoch + 1) % self.checkpoint_every and epoch + 1 != self.num_epoch:
+            return
+        from distkeras_tpu import checkpoint as ckpt
+
+        ckpt.save_checkpoint(
+            self.checkpoint_dir, {"state": state, "epoch": epoch}, step=epoch
+        )
+
     def _materialize_history(self):
         """Pull device loss scalars to host and expand per-epoch loss arrays
         into one record per window (the reference's per-window history)."""
@@ -306,6 +334,13 @@ class DistributedTrainer(Trainer):
             else:
                 expanded.append(rec)
         self.history.records = expanded
+
+
+class AsynchronousDistributedTrainer(DistributedTrainer):
+    """Parity alias: the reference's base class for the five asynchronous
+    algorithms (reference ``distkeras/trainers.py ::
+    AsynchronousDistributedTrainer``, which added ``communication_window``;
+    here ``DistributedTrainer`` already carries it)."""
 
 
 class SingleTrainer(DistributedTrainer):
@@ -333,7 +368,7 @@ class SingleTrainer(DistributedTrainer):
         return ADAGMerge()  # with W=1 the merge is the identity fold
 
 
-class ADAG(DistributedTrainer):
+class ADAG(AsynchronousDistributedTrainer):
     """Asynchronous Distributed Adaptive Gradients — the recommended default.
 
     Parity: reference ``distkeras/trainers.py :: ADAG``. Sync lowering: mean
@@ -347,7 +382,7 @@ class ADAG(DistributedTrainer):
         return ADAGMerge()
 
 
-class DOWNPOUR(DistributedTrainer):
+class DOWNPOUR(AsynchronousDistributedTrainer):
     """Downpour SGD (Dean et al. 2012).
 
     Parity: reference ``distkeras/trainers.py :: DOWNPOUR`` — workers push
@@ -360,7 +395,7 @@ class DOWNPOUR(DistributedTrainer):
         return DownpourMerge()
 
 
-class AEASGD(DistributedTrainer):
+class AEASGD(AsynchronousDistributedTrainer):
     """Asynchronous Elastic-Averaging SGD (Zhang, Choromanska & LeCun 2015).
 
     Parity: reference ``distkeras/trainers.py :: AEASGD`` with its ``rho``
@@ -402,7 +437,7 @@ class EAMSGD(AEASGD):
         )
 
 
-class DynSGD(DistributedTrainer):
+class DynSGD(AsynchronousDistributedTrainer):
     """Staleness-aware dynamic-learning-rate SGD (after Jiang et al. 2017).
 
     Parity: reference ``distkeras/trainers.py :: DynSGD`` — commits scaled by

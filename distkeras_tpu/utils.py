@@ -159,6 +159,40 @@ def json_default(o):
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# Row/frame parity helpers (reference ``distkeras/utils.py``).
+# ---------------------------------------------------------------------------
+
+
+def shuffle(dataset):
+    """Parity: reference ``distkeras/utils.py :: shuffle(df)``."""
+    return dataset.shuffle()
+
+
+def new_dataframe_row(row: Mapping, name: str, value) -> dict:
+    """Parity: reference ``new_dataframe_row`` — row + one new column."""
+    out = dict(row)
+    out[name] = value
+    return out
+
+
+def to_vector(label, n: int) -> np.ndarray:
+    """Integer class label → one-hot float vector (parity: ``to_vector``)."""
+    v = np.zeros(n, dtype=np.float32)
+    v[int(label)] = 1.0
+    return v
+
+
+def to_dense_vector(values, indices=None, n: int | None = None) -> np.ndarray:
+    """Sparse (indices, values) → dense vector (parity: ``to_dense_vector``);
+    with ``indices=None`` just casts to a dense float array."""
+    if indices is None:
+        return np.asarray(values, dtype=np.float32)
+    out = np.zeros(n, dtype=np.float32)
+    out[np.asarray(indices, dtype=np.int64)] = values
+    return out
+
+
 class History:
     """Append-only per-run training history (loss per step/window per worker)."""
 

@@ -1,0 +1,119 @@
+"""Aux subsystems: checkpoint/resume, job deployment, parity aliases."""
+
+import numpy as np
+import pytest
+
+from tests.test_trainers import blobs_dataset, model_spec
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from distkeras_tpu import checkpoint as ckpt
+
+    tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "nested": {"b": np.ones(4, np.int32)}}
+    ckpt.save_checkpoint(tmp_path, tree, step=3)
+    ckpt.save_checkpoint(tmp_path, {"a": tree["a"] * 2,
+                                    "nested": tree["nested"]}, step=7)
+    assert ckpt.latest_step(tmp_path) == 7
+    restored, step = ckpt.restore_checkpoint(tmp_path)
+    assert step == 7
+    assert np.allclose(restored["a"], tree["a"] * 2)
+    old, _ = ckpt.restore_checkpoint(tmp_path, step=3)
+    assert np.allclose(old["a"], tree["a"])
+
+
+def test_checkpoint_keep_prunes(tmp_path):
+    from distkeras_tpu import checkpoint as ckpt
+
+    for s in range(6):
+        ckpt.save_checkpoint(tmp_path, {"x": np.zeros(1)}, step=s, keep=2)
+    steps = sorted(
+        int(p.name[5:-4]) for p in tmp_path.glob("ckpt_*.dkc")
+    )
+    assert steps == [4, 5]
+
+
+def test_trainer_resume_continues(tmp_path):
+    """Train 2 epochs w/ checkpointing == train 1, resume, train 1 more."""
+    import jax
+    from distkeras_tpu import ADAG
+
+    ds = blobs_dataset(n=512)
+    common = dict(loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+                  learning_rate=0.05, num_workers=4, batch_size=16,
+                  communication_window=2, seed=9)
+
+    full = ADAG(model_spec(), num_epoch=2, **common)
+    p_full = full.train(ds)
+
+    d = tmp_path / "ck"
+    t1 = ADAG(model_spec(), num_epoch=1, checkpoint_dir=d, **common)
+    t1.train(ds)
+    t2 = ADAG(model_spec(), num_epoch=2, checkpoint_dir=d, resume=True,
+              **common)
+    p_resumed = t2.train(ds)
+
+    for a, b in zip(jax.tree.leaves(p_full), jax.tree.leaves(p_resumed)):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    # resumed run only trained the second epoch
+    epochs = {r.get("epoch") for r in t2.get_history()}
+    assert epochs == {1}
+
+
+def test_job_renders_per_host_commands():
+    from distkeras_tpu.job_deployment import Job, Punchcard
+
+    pc = Punchcard(script="train.py", hosts=["tpu-a", "tpu-b"],
+                   args=["--epochs", "3"], env={"FOO": "1"})
+    cmds = Job(pc).run()
+    assert len(cmds) == 2
+    host0, cmd0 = cmds[0]
+    assert host0 == "tpu-a"
+    assert "DISTKERAS_COORDINATOR=tpu-a:8476" in cmd0
+    assert "DISTKERAS_PROCESS_ID=0" in cmd0
+    assert "train.py --epochs 3" in cmd0
+    _, cmd1 = cmds[1]
+    assert "DISTKERAS_PROCESS_ID=1" in cmd1
+
+
+def test_punchcard_save_load(tmp_path):
+    from distkeras_tpu.job_deployment import Punchcard
+
+    pc = Punchcard(script="x.py", hosts=["h1"], coordinator_port=9000)
+    path = tmp_path / "job.json"
+    pc.save(path)
+    back = Punchcard.load(path)
+    assert back.script == "x.py" and back.coordinator_port == 9000
+
+
+def test_cluster_args_from_env(monkeypatch):
+    from distkeras_tpu.job_deployment import cluster_args_from_env
+
+    monkeypatch.setenv("DISTKERAS_COORDINATOR", "h:1234")
+    monkeypatch.setenv("DISTKERAS_NUM_PROCESSES", "4")
+    monkeypatch.setenv("DISTKERAS_PROCESS_ID", "2")
+    args = cluster_args_from_env()
+    assert args == {"coordinator_address": "h:1234", "num_processes": 4,
+                    "process_id": 2}
+
+
+def test_asynchronous_distributed_trainer_alias():
+    import distkeras_tpu.trainers as tr
+
+    assert issubclass(tr.ADAG, tr.AsynchronousDistributedTrainer)
+    assert issubclass(tr.EAMSGD, tr.AsynchronousDistributedTrainer)
+    assert issubclass(tr.AsynchronousDistributedTrainer, tr.DistributedTrainer)
+
+
+def test_utils_parity_helpers():
+    from distkeras_tpu import utils
+    from distkeras_tpu.data import Dataset
+
+    ds = Dataset({"x": np.arange(10)})
+    assert len(utils.shuffle(ds)) == 10
+    row = utils.new_dataframe_row({"a": 1}, "b", 2)
+    assert row == {"a": 1, "b": 2}
+    assert np.array_equal(utils.to_vector(2, 4), [0, 0, 1, 0])
+    assert np.array_equal(
+        utils.to_dense_vector([1.0, 2.0], [0, 3], 4), [1, 0, 0, 2]
+    )
