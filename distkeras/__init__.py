@@ -34,9 +34,15 @@ for _name in (
 
 def __getattr__(name):
     # Late-bound modules (predictors, evaluators, workers, parameter_servers,
-    # networking, job_deployment) resolve on first access.
+    # networking, job_deployment) resolve on first access. Unknown names must
+    # raise AttributeError so hasattr()/getattr(..., default) behave normally.
     import importlib
 
-    mod = importlib.import_module(f"distkeras_tpu.{name}")
+    try:
+        mod = importlib.import_module(f"distkeras_tpu.{name}")
+    except ImportError as e:
+        raise AttributeError(
+            f"module 'distkeras' has no attribute {name!r}"
+        ) from e
     sys.modules[f"distkeras.{name}"] = mod
     return mod

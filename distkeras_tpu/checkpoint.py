@@ -29,6 +29,12 @@ _PREFIX = "ckpt_"
 _SUFFIX = ".dkc"
 
 
+def should_checkpoint(epoch: int, every: int, num_epoch: int) -> bool:
+    """Single source of truth for the epoch-checkpoint cadence, shared by the
+    collective and PS backends: every ``every`` epochs, plus the final one."""
+    return (epoch + 1) % every == 0 or epoch + 1 == num_epoch
+
+
 def save_checkpoint(directory, tree: Pytree, step: int, keep: int = 3) -> Path:
     """Atomically write ``tree`` as checkpoint ``step``; prune old ones."""
     directory = Path(directory)

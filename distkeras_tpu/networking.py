@@ -11,20 +11,56 @@ asynchronous parameter-server backend (``backend="ps"`` with
 DCN from multiple pod slices, where a compiler-scheduled collective cannot
 express true asynchrony.
 
-Framing: 8-byte big-endian length + payload. Payloads are
-``utils.serialize_weights`` blobs or small pickled control dicts; as in the
-reference, the wire format assumes both ends are the same trusted training
-job (do not expose the PS port beyond the job's network).
+Framing: 8-byte big-endian length + payload. Payloads are control dicts whose
+weight pytrees are plain containers (dict/list/tuple) of numpy arrays, decoded
+by a restricted unpickler that resolves no globals beyond numpy array
+reconstruction — a forged frame cannot execute code or allocate unboundedly
+(length cap). The PS binds loopback by default; as in the reference, expose it
+beyond the job's network only deliberately.
 """
 
 from __future__ import annotations
 
+import io
 import pickle
 import socket
 import struct
 from typing import Any
 
 _LEN = struct.Struct(">Q")
+
+#: Upper bound on accepted frame size (defense in depth: a malformed or
+#: malicious length prefix must not trigger multi-GB allocations). 2 GiB is
+#: far above any weight blob this framework ships in one frame.
+MAX_FRAME_BYTES = 2 * 1024 * 1024 * 1024
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Unpickler for control frames: primitives + numpy arrays only.
+
+    Frames on this wire are control dicts of primitives (actions, ids,
+    serialized-weight ``bytes`` blobs) and occasionally bare numpy arrays;
+    no other global may be resolved, closing the arbitrary-code-execution
+    hole that ``pickle.loads`` on untrusted bytes opens.
+    """
+
+    _ALLOWED = {
+        ("numpy", "ndarray"),
+        ("numpy", "dtype"),
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy._core.multiarray", "scalar"),
+        ("numpy.core.multiarray", "scalar"),
+        ("numpy._core.numeric", "_frombuffer"),
+        ("numpy.core.numeric", "_frombuffer"),
+    }
+
+    def find_class(self, module, name):
+        if (module, name) in self._ALLOWED:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"frame tried to load disallowed global {module}.{name}"
+        )
 
 
 def determine_host_address() -> str:
@@ -67,6 +103,10 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def recv_data(sock: socket.socket) -> Any:
+def recv_data(sock: socket.socket, max_bytes: int = MAX_FRAME_BYTES) -> Any:
     (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, length))
+    if length > max_bytes:
+        raise ConnectionError(
+            f"frame of {length} bytes exceeds the {max_bytes}-byte cap"
+        )
+    return _RestrictedUnpickler(io.BytesIO(_recv_exact(sock, length))).load()

@@ -1,7 +1,20 @@
-"""Numerical ops: losses, metrics, and Pallas TPU kernels for the hot paths."""
+"""Numerical ops: losses, metrics, and Pallas TPU kernels
+(``ops.pallas_kernels.fused_adam``, selectable as
+``worker_optimizer="fused_adam"``)."""
 
 from distkeras_tpu.ops import losses, metrics
 from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.ops.metrics import accuracy
 
-__all__ = ["losses", "metrics", "get_loss", "accuracy"]
+
+def __getattr__(name):
+    # pallas_kernels imports jax.experimental.pallas; keep it lazy so plain
+    # loss/metric users never pay for it
+    if name == "pallas_kernels":
+        from distkeras_tpu.ops import pallas_kernels
+
+        return pallas_kernels
+    raise AttributeError(f"module 'distkeras_tpu.ops' has no attribute {name!r}")
+
+
+__all__ = ["losses", "metrics", "get_loss", "accuracy", "pallas_kernels"]

@@ -92,9 +92,10 @@ def jax_tree_copy(tree: Pytree) -> Pytree:
 class SocketParameterServer(ParameterServer):
     """TCP service wrapper: the reference's driver-hosted PS, DCN-ready.
 
-    Wire protocol (length-prefixed pickled frames, ``networking.py``):
-    client sends ``{"action": "pull"|"commit"|"stop", "worker_id": i,
-    "payload": blob?}``; ``pull`` answers with serialized weights.
+    Wire protocol (length-prefixed restricted-pickle frames,
+    ``networking.py``): client sends ``{"action": "pull"|"commit"|"stop",
+    "worker_id": i, "payload": tree?}``; ``pull`` answers
+    ``{"weights": tree}``. Trees are plain containers of numpy arrays.
     """
 
     def __init__(self, center: Pytree, rule: MergeRule, num_workers: int,
@@ -142,20 +143,21 @@ class SocketParameterServer(ParameterServer):
             self._handlers.append(t)
 
     def _handle(self, conn) -> None:
+        # Weight pytrees travel as plain containers + ndarrays INSIDE the
+        # restricted-unpickled control frame — never as a nested pickle blob,
+        # so no unrestricted pickle.loads ever touches wire bytes. (Wire trees
+        # are model params: nested dict/list/tuple of arrays. Custom pytree
+        # node types are rejected by the restricted unpickler by design.)
         try:
             while True:
                 msg = networking.recv_data(conn)
                 action = msg.get("action")
                 if action == "pull":
-                    weights = self.pull(msg["worker_id"])
                     networking.send_data(
-                        conn, utils.serialize_weights(weights)
+                        conn, {"weights": self.pull(msg["worker_id"])}
                     )
                 elif action == "commit":
-                    self.commit(
-                        msg["worker_id"],
-                        utils.deserialize_weights(msg["payload"]),
-                    )
+                    self.commit(msg["worker_id"], msg["payload"])
                     networking.send_data(conn, {"ok": True})
                 elif action in ("stop", "bye"):
                     break
@@ -199,7 +201,7 @@ class ParameterServerClient:
             self._sock,
             {"action": "pull", "worker_id": self.worker_id},
         )
-        return utils.deserialize_weights(networking.recv_data(self._sock))
+        return networking.recv_data(self._sock)["weights"]
 
     def commit(self, worker_id: int | None, payload: Pytree) -> None:
         networking.send_data(
@@ -207,7 +209,7 @@ class ParameterServerClient:
             {
                 "action": "commit",
                 "worker_id": self.worker_id,
-                "payload": utils.serialize_weights(payload),
+                "payload": utils.tree_to_numpy(payload),
             },
         )
         networking.recv_data(self._sock)  # ack

@@ -27,6 +27,9 @@ written back into the model you passed) or native
 
 from __future__ import annotations
 
+import contextlib
+import json
+import time
 from typing import Any, Callable, Sequence
 
 import jax
@@ -60,6 +63,10 @@ def resolve_optimizer(worker_optimizer, learning_rate: float,
         return optax.sgd(learning_rate)
     if name == "adam":
         return optax.adam(learning_rate)
+    if name == "fused_adam":
+        from distkeras_tpu.ops.pallas_kernels import fused_adam
+
+        return fused_adam(learning_rate)
     if name == "adagrad":
         return optax.adagrad(learning_rate)
     if name == "rmsprop":
@@ -163,7 +170,8 @@ class DistributedTrainer(Trainer):
                  device_data: bool | None = None,
                  ps_transport: str = "inprocess", ps_port: int = 0,
                  checkpoint_dir=None, checkpoint_every: int = 1,
-                 resume: bool = False):
+                 resume: bool = False, profile_dir=None,
+                 log_metrics: bool = False):
         super().__init__(keras_model, loss, worker_optimizer,
                          learning_rate=learning_rate, seed=seed)
         self.mesh = mesh if mesh is not None else get_mesh(num_workers)
@@ -195,6 +203,15 @@ class DistributedTrainer(Trainer):
         self.ps_port = ps_port
         # device_data=True stages each epoch in HBM and scans all windows in
         # one dispatch; None = auto (on when the epoch fits the budget).
+        # NOTE on shuffle semantics: with shuffle=False the two paths are
+        # bit-identical (tested). With shuffle=True they differ: the streaming
+        # path reshuffles rows globally across workers each epoch and drops
+        # the tail, while the resident path fixes worker shard assignment once
+        # (like Spark partitions), shuffles within each shard on device, and
+        # wrap-pads the tail so no row is permanently excluded. Auto mode
+        # therefore picks between two valid but different shuffle regimes
+        # based on dataset size; pass device_data explicitly if the exact
+        # regime matters.
         self.device_data = device_data
         self.device_data_budget_bytes = 512 * 1024 * 1024
         # Checkpoint/resume (absent in the reference — SURVEY.md §5.4):
@@ -202,6 +219,13 @@ class DistributedTrainer(Trainer):
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = int(checkpoint_every)
         self.resume = bool(resume)
+        # Observability (SURVEY.md §5.1/§5.5 build notes — beyond-reference):
+        # profile_dir writes a jax.profiler trace of the run; log_metrics
+        # streams one JSON line per epoch (loss, samples/sec, updates/sec)
+        # to stdout and records the same in the history.
+        self.profile_dir = profile_dir
+        self.log_metrics = bool(log_metrics)
+        self.metrics_: list[dict] = []
 
     # -- seams kept from the reference ------------------------------------
 
@@ -229,9 +253,28 @@ class DistributedTrainer(Trainer):
 
     def train(self, dataset, shuffle: bool = False):
         ds = self._coerce_dataset(dataset)
-        if self.backend == "ps":
-            return self._train_ps(ds, shuffle)
-        return self._train_collective(ds, shuffle)
+        ctx = (
+            jax.profiler.trace(str(self.profile_dir))
+            if self.profile_dir else contextlib.nullcontext()
+        )
+        with ctx:
+            if self.backend == "ps":
+                return self._train_ps(ds, shuffle)
+            return self._train_collective(ds, shuffle)
+
+    def _epoch_metrics(self, epoch: int, rows: int, updates: int,
+                       elapsed: float):
+        """Record + optionally stream per-epoch throughput."""
+        rec = {
+            "epoch": epoch,
+            "samples_per_sec": round(rows / elapsed, 1),
+            "updates_per_sec": round(updates / elapsed, 2),
+            "wall_time": round(elapsed, 4),
+        }
+        self.metrics_.append(rec)
+        self.history.append(**rec)
+        if self.log_metrics:
+            print(json.dumps({"metric": "epoch", **rec}), flush=True)
 
     def _train_collective(self, ds: Dataset, shuffle: bool):
         engine = LocalSGDEngine(
@@ -274,21 +317,46 @@ class DistributedTrainer(Trainer):
                 self.num_workers, self.batch_size, self.communication_window,
                 cols, seed=self.seed if shuffle else None, cover_all=shuffle,
             ))
+            rows_pw = staged[0].shape[1]
+            n_windows = rows_pw // (self.communication_window * self.batch_size)
+            epoch_rows = (
+                self.num_workers * n_windows
+                * self.communication_window * self.batch_size
+            )
             for epoch in range(start_epoch, self.num_epoch):
                 seed = (self.seed + epoch) if shuffle else None
+                t0 = time.perf_counter() if self.log_metrics else 0.0
                 state, losses = engine.run_epoch_resident(state, staged, seed)
                 # losses: device array [windows] — no host sync in the loop
+                # unless metrics are being streamed
                 self.history.append(losses=losses, epoch=epoch)
+                if self.log_metrics:
+                    jax.block_until_ready(losses)
+                    self._epoch_metrics(
+                        epoch, epoch_rows, n_windows, time.perf_counter() - t0
+                    )
                 self._maybe_checkpoint(state, epoch)
         else:
+            win_rows = (
+                self.num_workers * self.communication_window * self.batch_size
+            )
             for epoch in range(start_epoch, self.num_epoch):
                 seed = (self.seed + epoch) if shuffle else None
+                t0 = time.perf_counter() if self.log_metrics else 0.0
+                n_windows = 0
                 for batch in ds.superbatches(
                     self.num_workers, self.batch_size,
                     self.communication_window, cols, seed=seed,
                 ):
                     state, loss = engine.run_window(state, batch)
                     self.history.append(loss=loss, epoch=epoch)
+                    n_windows += 1
+                if self.log_metrics and n_windows:
+                    jax.block_until_ready(loss)
+                    self._epoch_metrics(
+                        epoch, n_windows * win_rows, n_windows,
+                        time.perf_counter() - t0,
+                    )
                 self._maybe_checkpoint(state, epoch)
         jax.block_until_ready(state.center)
         self.record_training_end()
@@ -301,19 +369,34 @@ class DistributedTrainer(Trainer):
         from distkeras_tpu.workers import run_async_training
 
         self.record_training_start()
+        t0 = time.perf_counter()
         params, nt, history = run_async_training(self, ds, shuffle)
+        elapsed = time.perf_counter() - t0
         self.record_training_end()
         for rec in history:
             self.history.append(**rec)
+        if self.log_metrics and elapsed > 0:
+            # hogwild epochs overlap freely — report whole-run throughput
+            n_updates = sum(1 for r in history if "loss" in r)
+            rows = n_updates * self.communication_window * self.batch_size
+            rec = {
+                "samples_per_sec": round(rows / elapsed, 1),
+                "updates_per_sec": round(n_updates / elapsed, 2),
+                "wall_time": round(elapsed, 4),
+            }
+            self.metrics_.append(rec)
+            self.history.append(**rec)
+            print(json.dumps({"metric": "run", **rec}), flush=True)
         return self._finalize(params, nt)
 
     def _maybe_checkpoint(self, state, epoch: int):
         if not self.checkpoint_dir:
             return
-        if (epoch + 1) % self.checkpoint_every and epoch + 1 != self.num_epoch:
-            return
         from distkeras_tpu import checkpoint as ckpt
 
+        if not ckpt.should_checkpoint(epoch, self.checkpoint_every,
+                                      self.num_epoch):
+            return
         ckpt.save_checkpoint(
             self.checkpoint_dir, {"state": state, "epoch": epoch}, step=epoch
         )

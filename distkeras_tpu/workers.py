@@ -66,7 +66,10 @@ class AsyncWorker:
     """One training replica on one device, exchanging with the PS."""
 
     def __init__(self, worker_id: int, device, window_fn, optimizer, ps,
-                 rule, window: int, batch_size: int, nt, history, lock):
+                 rule, window: int, batch_size: int, nt, history, lock,
+                 barrier: threading.Barrier | None = None,
+                 ckpt_pred=None,
+                 restore: dict | None = None, start_epoch: int = 0):
         self.worker_id = worker_id
         self.device = device
         self.window_fn = window_fn
@@ -78,6 +81,17 @@ class AsyncWorker:
         self.nt = nt
         self.history = history
         self.lock = lock
+        # Epoch barrier, installed only when checkpointing is on: workers
+        # rendezvous at epoch boundaries the cadence predicate selects, so one
+        # of them can snapshot a consistent (center, per-worker state) tuple.
+        # Without a checkpoint_dir epochs stay free-running (hogwild), as in
+        # the reference. ckpt_pred is identical across workers, so they all
+        # agree on which epochs rendezvous.
+        self.barrier = barrier
+        self.ckpt_pred = ckpt_pred
+        self.restore = restore
+        self.start_epoch = int(start_epoch)
+        self.snapshot: dict | None = None
         self.error: BaseException | None = None
 
     def train(self, index: int, shard_cols: tuple, num_epoch: int,
@@ -87,6 +101,8 @@ class AsyncWorker:
             self._train(index, shard_cols, num_epoch, shuffle, seed)
         except BaseException as e:  # surface thread failures to the driver
             self.error = e
+            if self.barrier is not None:
+                self.barrier.abort()  # don't deadlock peers at the barrier
 
     def _train(self, index, shard_cols, num_epoch, shuffle, seed):
         rows = len(shard_cols[0])
@@ -94,12 +110,25 @@ class AsyncWorker:
         n_windows = rows // win_rows
         elastic = isinstance(self.rule, ElasticAverageMerge)
 
-        center = self.ps.pull(self.worker_id)
-        params = jax.device_put(center, self.device)
-        nt = jax.device_put(self.nt, self.device)
-        opt = jax.jit(self.optimizer.init)(params)
+        if self.restore is not None:
+            # Optimizer state and non-trainables always come from the snapshot.
+            # Elastic workers own their variables, so params are restored too;
+            # delta workers re-base onto the restored center (matching the
+            # post-commit pull they do mid-run).
+            nt = jax.device_put(self.restore["nt"], self.device)
+            opt = jax.device_put(self.restore["opt"], self.device)
+            if elastic:
+                params = jax.device_put(self.restore["params"], self.device)
+            else:
+                center = self.ps.pull(self.worker_id)
+                params = jax.device_put(center, self.device)
+        else:
+            center = self.ps.pull(self.worker_id)
+            params = jax.device_put(center, self.device)
+            nt = jax.device_put(self.nt, self.device)
+            opt = jax.jit(self.optimizer.init)(params)
 
-        for epoch in range(num_epoch):
+        for epoch in range(self.start_epoch, num_epoch):
             order = (
                 np.random.default_rng((seed, index, epoch)).permutation(rows)
                 if shuffle
@@ -142,6 +171,14 @@ class AsyncWorker:
                         "epoch": epoch,
                         "worker": self.worker_id,
                     })
+            if self.barrier is not None and self.ckpt_pred(epoch):
+                self.snapshot = {
+                    "params": utils.tree_to_numpy(params),
+                    "opt": utils.tree_to_numpy(opt),
+                    "nt": utils.tree_to_numpy(nt),
+                }
+                self._epoch_done = epoch
+                self.barrier.wait()  # one thread runs the checkpoint action
         self.final_nt = utils.tree_to_numpy(nt)
 
 
@@ -156,6 +193,28 @@ def run_async_training(trainer, ds, shuffle: bool):
     optimizer = trainer.allocate_optimizer()
     params, nt = spec.init_np(trainer.seed)
     W = trainer.num_workers
+
+    # Checkpoint/resume (parity with the collective backend): restore the PS
+    # center + per-worker (params, opt, nt) saved at an epoch barrier.
+    ckpt_dir = getattr(trainer, "checkpoint_dir", None)
+    start_epoch = 0
+    restores: list[dict | None] = [None] * W
+    restored_updates = 0
+    if ckpt_dir and getattr(trainer, "resume", False):
+        from distkeras_tpu import checkpoint as ckpt
+
+        if ckpt.latest_step(ckpt_dir) is not None:
+            payload, step = ckpt.restore_checkpoint(ckpt_dir)
+            saved_workers = payload["workers"]
+            if len(saved_workers) != W:
+                raise ValueError(
+                    f"checkpoint has {len(saved_workers)} workers, trainer "
+                    f"expects {W}"
+                )
+            params = payload["center"]
+            restores = list(saved_workers)
+            restored_updates = int(payload.get("num_updates", 0))
+            start_epoch = int(payload["epoch"]) + 1
 
     transport = getattr(trainer, "ps_transport", "inprocess")
     if transport == "socket":
@@ -179,16 +238,49 @@ def run_async_training(trainer, ds, shuffle: bool):
         seed=trainer.seed if shuffle else None, cover_all=shuffle,
     )  # tuple of [W, rows_pw, …]
 
+    if restored_updates:
+        ps.num_updates = restored_updates
+
     window_fn = _build_local_window(trainer._loss_step(), optimizer)
     devices = jax.devices()
     history: list[dict] = []
     hlock = threading.Lock()
+
+    workers: list[AsyncWorker] = []
+    barrier = None
+    ckpt_pred = None
+    if ckpt_dir:
+        from distkeras_tpu import checkpoint as ckpt
+
+        every = int(getattr(trainer, "checkpoint_every", 1))
+
+        def ckpt_pred(epoch, _every=every, _n=trainer.num_epoch):
+            return ckpt.should_checkpoint(epoch, _every, _n)
+
+        def _checkpoint_action():
+            # runs in one worker thread while all others wait at the barrier;
+            # only cadence-selected epochs reach the barrier at all
+            epoch = workers[0]._epoch_done
+            ckpt.save_checkpoint(
+                ckpt_dir,
+                {
+                    "center": ps.get_model(),
+                    "workers": [w.snapshot for w in workers],
+                    "num_updates": ps.num_updates,
+                    "epoch": epoch,
+                },
+                step=epoch,
+            )
+
+        barrier = threading.Barrier(W, action=_checkpoint_action)
 
     workers = [
         AsyncWorker(
             i, devices[i % len(devices)], window_fn, optimizer,
             clients[i], rule, trainer.communication_window,
             trainer.batch_size, nt, history, hlock,
+            barrier=barrier, ckpt_pred=ckpt_pred,
+            restore=restores[i], start_epoch=start_epoch,
         )
         for i in range(W)
     ]
@@ -218,6 +310,9 @@ def run_async_training(trainer, ds, shuffle: bool):
 
     errors = [w.error for w in workers if w.error is not None]
     if errors:
+        # a BrokenBarrierError is a symptom of a peer's failure — surface the
+        # root cause first
+        errors.sort(key=lambda e: isinstance(e, threading.BrokenBarrierError))
         raise errors[0]
 
     final_nt = getattr(workers[0], "final_nt", nt)

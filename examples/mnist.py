@@ -10,13 +10,16 @@ Run (defaults: ADAG on LeNet, one worker per device)::
     python examples/mnist.py --trainer adag --epochs 2
     python examples/mnist.py --trainer downpour --workers 8
     python examples/mnist.py --trainer single          # 1-replica oracle
+    python examples/mnist.py --frontend keras          # Keras 3 user model
 """
 
 import argparse
+import os
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("KERAS_BACKEND", "jax")
 
 import jax
 import numpy as np
@@ -38,10 +41,40 @@ TRAINERS = {
 }
 
 
+def build_keras_model(kind: str):
+    """A user-written Keras 3 model, exactly as reference users wrote them."""
+    import keras
+
+    if kind == "cnn":
+        layers = [
+            keras.layers.Input((28, 28, 1)),
+            keras.layers.Conv2D(32, 5, padding="same", activation="relu"),
+            keras.layers.MaxPooling2D(),
+            keras.layers.Conv2D(64, 5, padding="same", activation="relu"),
+            keras.layers.MaxPooling2D(),
+            keras.layers.Flatten(),
+            keras.layers.Dense(256, activation="relu"),
+            keras.layers.Dense(10),
+        ]
+    else:
+        layers = [
+            keras.layers.Input((28, 28, 1)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(500, activation="relu"),
+            keras.layers.Dense(300, activation="relu"),
+            keras.layers.Dense(10),
+        ]
+    return keras.Sequential(layers)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--trainer", choices=sorted(TRAINERS), default="adag")
     ap.add_argument("--model", choices=["cnn", "mlp"], default="cnn")
+    ap.add_argument("--frontend", choices=["native", "keras"], default="native",
+                    help="native flax model zoo, or a user-written Keras 3 "
+                         "model handed straight to the trainer (the "
+                         "reference's primary contract)")
     ap.add_argument("--workers", type=int, default=None)
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--batch-size", type=int, default=128)
@@ -59,7 +92,10 @@ def main():
     onehot = OneHotTransformer(10, input_col="label", output_col="label_onehot")
     train = onehot.transform(train)
 
-    model = lenet() if args.model == "cnn" else mlp()
+    if args.frontend == "keras":
+        model = build_keras_model(args.model)
+    else:
+        model = lenet() if args.model == "cnn" else mlp()
     cls = TRAINERS[args.trainer]
     kw = dict(
         loss="softmax_cross_entropy",
@@ -77,7 +113,6 @@ def main():
 
     trainer.train(train, shuffle=True)
     losses = [float(l) for l in trainer.get_history().losses()]
-    n_seen = args.epochs * (len(train) // 1)
     print(
         f"trained {args.trainer} in {trainer.get_training_time():.1f}s "
         f"({len(losses)} windows): loss {losses[0]:.4f} -> {losses[-1]:.4f}"

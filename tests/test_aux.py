@@ -60,6 +60,126 @@ def test_trainer_resume_continues(tmp_path):
     assert epochs == {1}
 
 
+def test_ps_backend_resume_continues(tmp_path):
+    """PS backend: train 2 epochs w/ checkpointing == train 1, resume, +1.
+
+    W=1 keeps the hogwild path deterministic; adam exercises optimizer-state
+    restoration (plain SGD would pass even if opt state were dropped).
+    """
+    import jax
+    from distkeras_tpu import ADAG
+
+    ds = blobs_dataset(n=512)
+    common = dict(loss="sparse_softmax_cross_entropy", worker_optimizer="adam",
+                  learning_rate=2e-3, num_workers=1, batch_size=16,
+                  communication_window=2, backend="ps", seed=9)
+
+    full = ADAG(model_spec(), num_epoch=2, **common)
+    p_full = full.train(ds)
+
+    d = tmp_path / "ck"
+    t1 = ADAG(model_spec(), num_epoch=1, checkpoint_dir=d, **common)
+    t1.train(ds)
+    assert list(d.glob("ckpt_*.dkc")), "PS backend wrote no checkpoints"
+    t2 = ADAG(model_spec(), num_epoch=2, checkpoint_dir=d, resume=True,
+              **common)
+    p_resumed = t2.train(ds)
+
+    for a, b in zip(jax.tree.leaves(p_full), jax.tree.leaves(p_resumed)):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    epochs = {r.get("epoch") for r in t2.get_history()}
+    assert epochs == {1}
+
+
+def test_ps_backend_resume_multiworker_smoke(tmp_path):
+    """W=4 hogwild: checkpoints are written at epoch barriers and a resumed
+    run trains only the remaining epochs (bit-equality is not defined for
+    hogwild — commit interleaving is nondeterministic by design)."""
+    from distkeras_tpu import DOWNPOUR
+
+    ds = blobs_dataset(n=1024)
+    common = dict(loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+                  learning_rate=0.02, num_workers=4, batch_size=16,
+                  communication_window=2, backend="ps", seed=3)
+    d = tmp_path / "ck"
+    t1 = DOWNPOUR(model_spec(), num_epoch=2, checkpoint_dir=d, **common)
+    t1.train(ds)
+    steps = sorted(int(p.name[5:-4]) for p in d.glob("ckpt_*.dkc"))
+    assert steps == [0, 1]
+    t2 = DOWNPOUR(model_spec(), num_epoch=3, checkpoint_dir=d, resume=True,
+                  **common)
+    t2.train(ds)
+    assert {r.get("epoch") for r in t2.get_history()} == {2}
+    losses = [float(l) for l in t2.get_history().losses()]
+    assert np.all(np.isfinite(losses))
+
+
+def test_ps_backend_resume_worker_count_mismatch_raises(tmp_path):
+    from distkeras_tpu import DOWNPOUR
+
+    ds = blobs_dataset(n=512)
+    common = dict(loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+                  learning_rate=0.02, batch_size=16, communication_window=2,
+                  backend="ps", seed=3)
+    d = tmp_path / "ck"
+    DOWNPOUR(model_spec(), num_epoch=1, num_workers=2, checkpoint_dir=d,
+             **common).train(ds)
+    with pytest.raises(ValueError, match="workers"):
+        DOWNPOUR(model_spec(), num_epoch=2, num_workers=4, checkpoint_dir=d,
+                 resume=True, **common).train(ds)
+
+
+def test_profiler_and_metrics_stream(tmp_path, capsys):
+    """profile_dir writes a jax.profiler trace; log_metrics streams per-epoch
+    JSONL with samples/sec + updates/sec (SURVEY.md §5.1/§5.5 build notes)."""
+    import json
+    from distkeras_tpu import ADAG
+
+    ds = blobs_dataset(n=512)
+    prof = tmp_path / "prof"
+    t = ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+             worker_optimizer="sgd", learning_rate=0.05, num_workers=4,
+             batch_size=16, communication_window=2, num_epoch=2,
+             profile_dir=prof, log_metrics=True)
+    t.train(ds)
+    # profiler artifacts exist
+    assert any(prof.rglob("*")), "profile_dir is empty"
+    # one JSON metrics line per epoch on stdout
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    epoch_lines = [l for l in lines if l.get("metric") == "epoch"]
+    assert len(epoch_lines) == 2
+    assert epoch_lines[0]["samples_per_sec"] > 0
+    assert epoch_lines[0]["updates_per_sec"] > 0
+    # and the same metrics live in the history / metrics_
+    assert len(t.metrics_) == 2
+    assert any("samples_per_sec" in r for r in t.get_history())
+
+
+def test_initialize_cluster_kwargs_plumbing(monkeypatch):
+    """initialize_cluster must forward exactly the provided kwargs to
+    jax.distributed.initialize and report the global topology."""
+    import jax
+    from distkeras_tpu import job_deployment as jd
+
+    seen = {}
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: seen.update(kw))
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    info = jd.initialize_cluster("coord:9999", num_processes=2, process_id=1,
+                                 local_device_ids=[0, 1])
+    assert seen == {"coordinator_address": "coord:9999", "num_processes": 2,
+                    "process_id": 1, "local_device_ids": [0, 1]}
+    assert info["process_index"] == 1 and info["process_count"] == 2
+    assert info["global_devices"] == 8  # the fake CPU mesh
+
+    # no-arg TPU-pod form: nothing forwarded
+    seen.clear()
+    jd.initialize_cluster()
+    assert seen == {}
+
+
 def test_job_renders_per_host_commands():
     from distkeras_tpu.job_deployment import Job, Punchcard
 

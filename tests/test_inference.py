@@ -1,0 +1,96 @@
+"""Inference-path coverage: ModelPredictor (incl. the pad-and-trim path),
+LabelIndexPredictor, and the evaluators (SURVEY.md §3.4 parity surface)."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.evaluators import AccuracyEvaluator, LossEvaluator
+from distkeras_tpu.predictors import LabelIndexPredictor, ModelPredictor
+from tests.test_trainers import blobs_dataset, model_spec
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """A spec + params good enough to beat chance on the blobs."""
+    from distkeras_tpu import SingleTrainer
+
+    ds = blobs_dataset(n=1024)
+    t = SingleTrainer(model_spec(), loss="sparse_softmax_cross_entropy",
+                      worker_optimizer="sgd", learning_rate=0.1,
+                      batch_size=64, num_epoch=3)
+    t.train(ds)
+    return t.spec, t.trained_params_, t.trained_nt_
+
+
+def test_predictor_pad_path_matches_direct_apply(trained):
+    """n not divisible by batch_size: pad rows must be trimmed, predictions
+    must equal a direct un-padded apply."""
+    spec, params, nt = trained
+    ds = blobs_dataset(n=70, seed=5)
+    pred = ModelPredictor(spec, params, nt, batch_size=32)
+    out = pred.predict(ds)
+    assert out["prediction"].shape == (70, 4)
+    direct, _ = spec.apply(params, nt, ds["features"], False)
+    np.testing.assert_allclose(out["prediction"], np.asarray(direct),
+                               rtol=1e-5, atol=1e-5)
+    # original dataset untouched (with_column returns a new frame)
+    assert "prediction" not in ds
+
+
+def test_predictor_exact_multiple_of_batch(trained):
+    spec, params, nt = trained
+    ds = blobs_dataset(n=64, seed=6)
+    out = ModelPredictor(spec, params, nt, batch_size=32).predict(ds)
+    assert out["prediction"].shape == (64, 4)
+
+
+def test_label_index_predictor_emits_classes(trained):
+    spec, params, nt = trained
+    # same seed as training: blob centers are seed-dependent
+    ds = blobs_dataset(n=50, seed=0)
+    out = LabelIndexPredictor(spec, params, nt, batch_size=16).predict(ds)
+    assert out["prediction"].shape == (50,)
+    assert out["prediction"].dtype == np.int32
+    assert float(np.mean(out["prediction"] == ds["label"])) > 0.8
+
+
+def test_accuracy_evaluator_score_matrix_vs_integer_labels():
+    ds = Dataset({
+        "prediction": np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]],
+                               np.float32),
+        "label": np.array([1, 0, 0], np.int32),
+    })
+    assert AccuracyEvaluator().evaluate(ds) == pytest.approx(2 / 3)
+
+
+def test_accuracy_evaluator_onehot_labels():
+    ds = Dataset({
+        "prediction": np.array([[0.1, 0.9], [0.8, 0.2]], np.float32),
+        "label": np.array([[0, 1], [0, 1]], np.float32),
+    })
+    assert AccuracyEvaluator().evaluate(ds) == pytest.approx(0.5)
+
+
+def test_accuracy_evaluator_integer_predictions():
+    ds = Dataset({
+        "prediction": np.array([1, 0, 1, 1], np.int32),
+        "label": np.array([1, 1, 1, 0], np.int32),
+    })
+    assert AccuracyEvaluator().evaluate(ds) == pytest.approx(0.5)
+
+
+def test_accuracy_evaluator_binary_probability_column():
+    ds = Dataset({
+        "prediction": np.array([0.9, 0.2, 0.6], np.float32),
+        "label": np.array([1, 0, 0], np.int32),
+    })
+    assert AccuracyEvaluator().evaluate(ds) == pytest.approx(2 / 3)
+
+
+def test_loss_evaluator_mse():
+    ds = Dataset({
+        "prediction": np.array([1.0, 2.0], np.float32),
+        "label": np.array([0.0, 2.0], np.float32),
+    })
+    assert LossEvaluator("mse").evaluate(ds) == pytest.approx(0.5)

@@ -36,6 +36,33 @@ def test_determine_host_address_returns_ip():
     assert isinstance(addr, str) and addr.count(".") == 3
 
 
+def test_recv_data_rejects_oversized_frame():
+    import socket
+    import struct
+
+    a, b = socket.socketpair()
+    # forge a length prefix above the cap without sending a body
+    a.sendall(struct.pack(">Q", networking.MAX_FRAME_BYTES + 1))
+    with pytest.raises(ConnectionError, match="cap"):
+        networking.recv_data(b)
+    a.close(); b.close()
+
+
+def test_recv_data_rejects_arbitrary_globals():
+    """The restricted unpickler must refuse frames that resolve non-allowlisted
+    globals (the pickle RCE vector)."""
+    import pickle
+    import socket
+    import struct
+
+    a, b = socket.socketpair()
+    evil = pickle.dumps(print)  # any callable global outside the allowlist
+    a.sendall(struct.pack(">Q", len(evil)) + evil)
+    with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+        networking.recv_data(b)
+    a.close(); b.close()
+
+
 def test_inprocess_ps_fold_and_version_counting():
     center = {"w": np.zeros(3, np.float32)}
     ps = ParameterServer(center, DownpourMerge(), num_workers=2)
