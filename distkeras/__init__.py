@@ -40,7 +40,9 @@ def __getattr__(name):
 
     try:
         mod = importlib.import_module(f"distkeras_tpu.{name}")
-    except ImportError as e:
+    except ModuleNotFoundError as e:
+        if e.name != f"distkeras_tpu.{name}":
+            raise  # a real submodule broke on ITS dependency — surface that
         raise AttributeError(
             f"module 'distkeras' has no attribute {name!r}"
         ) from e

@@ -17,4 +17,4 @@ def __getattr__(name):
     raise AttributeError(f"module 'distkeras_tpu.ops' has no attribute {name!r}")
 
 
-__all__ = ["losses", "metrics", "get_loss", "accuracy", "pallas_kernels"]
+__all__ = ["losses", "metrics", "get_loss", "accuracy"]

@@ -21,6 +21,7 @@ it to the rule (DynSGD scales by 1/(τ+1); other rules ignore it).
 
 from __future__ import annotations
 
+import pickle
 import threading
 from typing import Any
 
@@ -164,6 +165,10 @@ class SocketParameterServer(ParameterServer):
                 else:
                     networking.send_data(conn, {"error": f"bad action {action}"})
         except (ConnectionError, EOFError, OSError):
+            pass
+        except pickle.UnpicklingError:
+            # hostile/garbled frame rejected by the restricted unpickler —
+            # drop the connection quietly, don't kill the handler loudly
             pass
         finally:
             conn.close()

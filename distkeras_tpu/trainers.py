@@ -262,19 +262,21 @@ class DistributedTrainer(Trainer):
                 return self._train_ps(ds, shuffle)
             return self._train_collective(ds, shuffle)
 
-    def _epoch_metrics(self, epoch: int, rows: int, updates: int,
-                       elapsed: float):
-        """Record + optionally stream per-epoch throughput."""
+    def _epoch_metrics(self, epoch: int | None, rows: int, updates: int,
+                       elapsed: float, label: str = "epoch"):
+        """Record + optionally stream throughput (per epoch, or whole-run
+        with ``epoch=None`` for the free-running PS backend)."""
         rec = {
-            "epoch": epoch,
             "samples_per_sec": round(rows / elapsed, 1),
             "updates_per_sec": round(updates / elapsed, 2),
             "wall_time": round(elapsed, 4),
         }
+        if epoch is not None:
+            rec = {"epoch": epoch, **rec}
         self.metrics_.append(rec)
         self.history.append(**rec)
         if self.log_metrics:
-            print(json.dumps({"metric": "epoch", **rec}), flush=True)
+            print(json.dumps({"metric": label, **rec}), flush=True)
 
     def _train_collective(self, ds: Dataset, shuffle: bool):
         engine = LocalSGDEngine(
@@ -379,14 +381,7 @@ class DistributedTrainer(Trainer):
             # hogwild epochs overlap freely — report whole-run throughput
             n_updates = sum(1 for r in history if "loss" in r)
             rows = n_updates * self.communication_window * self.batch_size
-            rec = {
-                "samples_per_sec": round(rows / elapsed, 1),
-                "updates_per_sec": round(n_updates / elapsed, 2),
-                "wall_time": round(elapsed, 4),
-            }
-            self.metrics_.append(rec)
-            self.history.append(**rec)
-            print(json.dumps({"metric": "run", **rec}), flush=True)
+            self._epoch_metrics(None, rows, n_updates, elapsed, label="run")
         return self._finalize(params, nt)
 
     def _maybe_checkpoint(self, state, epoch: int):

@@ -173,10 +173,14 @@ class AsyncWorker:
                     })
             if self.barrier is not None and self.ckpt_pred(epoch):
                 self.snapshot = {
-                    "params": utils.tree_to_numpy(params),
                     "opt": utils.tree_to_numpy(opt),
                     "nt": utils.tree_to_numpy(nt),
                 }
+                if elastic:
+                    # only elastic workers own their variables; delta workers
+                    # re-base onto the restored center, so saving their params
+                    # would bloat every checkpoint by W unused model copies
+                    self.snapshot["params"] = utils.tree_to_numpy(params)
                 self._epoch_done = epoch
                 self.barrier.wait()  # one thread runs the checkpoint action
         self.final_nt = utils.tree_to_numpy(nt)
