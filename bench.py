@@ -1,20 +1,32 @@
-"""Driver benchmark: ADAG on MNIST-CNN samples/sec (the north-star config).
+"""Driver benchmark: all five BASELINE configs, samples/sec + MFU.
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints ONE JSON line on stdout (the north-star config — ADAG/MNIST-CNN):
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": N}
+
+Everything else goes to stderr: one JSON line per BASELINE config
+(samples/sec, analytic MFU) and, with ``--scaling``, a stacked-worker scaling
+sweep W ∈ {1,2,4,8} on one chip (real multi-chip is unavailable here; see
+SCALING.md).
 
 ``vs_baseline`` is the speedup over the reference-proxy denominator. The
-reference's own number (16-executor Spark/CPU cluster) is unrecoverable here
-(BASELINE.md: no Spark, no network), so per SURVEY.md §6 the documented proxy
-is a single-process CPU ``SingleTrainer`` on the same model/data, measured in
-this same run — i.e. ``vs_baseline = TPU samples/sec ÷ single-CPU-process
-samples/sec``. The north-star "≥12× a 16-executor cluster" corresponds to
-``vs_baseline ≥ 192`` under ideal linear Spark scaling (16 executors × 12).
+reference's own number (16-executor Spark/CPU cluster) is unrecoverable
+(BASELINE.md), so per SURVEY.md §6 the documented proxy is a single-process
+CPU run of the same model with the SAME batch_size/communication_window
+(fewer rows; ≥3 timed epochs post-warmup), measured in this run. The
+north-star "≥12× a 16-executor cluster" corresponds to ``vs_baseline ≥ 192``
+under ideal linear Spark scaling (16 executors × 12).
 
-Everything except the final JSON goes to stderr.
+MFU = samples/sec × analytic training FLOPs/sample ÷ chip peak. Training
+FLOPs are counted as 3× forward (fwd + ~2× bwd), conv/dense/LSTM matmul terms
+only — elementwise ops excluded, so MFU is slightly underestimated. Peak
+defaults to 197 bf16 TFLOP/s (TPU v5e); override with
+``DISTKERAS_PEAK_TFLOPS``.
 """
 
+import argparse
 import json
+import os
 import sys
 import time
 
@@ -26,89 +38,284 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def measure_samples_per_sec(device, rows, batch_size, window, epochs_timed=3,
-                            dtype=None):
-    """ADAG/LeNet steady-state samples/sec on `device` (warm jit cache).
+# ---------------------------------------------------------------------------
+# Analytic training-FLOP models (3× forward; matmul terms only)
+# ---------------------------------------------------------------------------
 
-    Uses the device-resident epoch path — one upload + one dispatch per epoch,
-    exactly what the trainer's auto mode does — timed after one warm-up epoch.
-    """
-    import jax.numpy as jnp
-    import optax
 
-    from distkeras_tpu.datasets import mnist
-    from distkeras_tpu.models import lenet
+def mlp_flops(dims):
+    return 3 * 2 * sum(a * b for a, b in zip(dims, dims[1:]))
+
+
+def lenet_flops():
+    fwd = (
+        2 * 25 * 1 * 32 * 28 * 28      # conv1 5×5×1→32 @ 28×28
+        + 2 * 25 * 32 * 64 * 14 * 14   # conv2 5×5×32→64 @ 14×14
+        + 2 * 3136 * 256               # dense1
+        + 2 * 256 * 10                 # head
+    )
+    return 3 * fwd
+
+
+def vgg_small_flops():
+    fwd = 0
+    res, cin = 32 * 32, 3
+    for w in (64, 128, 256):
+        fwd += 2 * 9 * cin * w * res + 2 * 9 * w * w * res
+        cin, res = w, res // 4
+    fwd += 2 * 4096 * 512 + 2 * 512 * 10
+    return 3 * fwd
+
+
+def lstm_flops(maxlen=200, embed=128, hidden=128):
+    fwd = maxlen * 8 * hidden * (embed + hidden) + 2 * hidden * 2
+    return 3 * fwd
+
+
+def peak_flops(device) -> float | None:
+    if device.platform != "tpu":
+        return None
+    env = os.environ.get("DISTKERAS_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    return 197e12  # TPU v5e bf16 peak
+
+
+# ---------------------------------------------------------------------------
+# Measurement core: steady-state samples/sec of one (model, rule) config on
+# one device via the HBM-resident epoch path (what the trainer's auto mode
+# uses) — one upload, one dispatch per epoch, timed after a warm-up epoch.
+# ---------------------------------------------------------------------------
+
+
+def measure(device, spec, rule, optimizer, train, cols, batch_size, window,
+            num_workers=1, epochs_timed=3):
     from distkeras_tpu.ops.losses import sparse_softmax_cross_entropy
     from distkeras_tpu.parallel.local_sgd import LocalSGDEngine
-    from distkeras_tpu.parallel.merge_rules import ADAGMerge
     from distkeras_tpu.parallel.mesh import get_mesh
 
-    train, _ = mnist(n_train=rows, n_test=64)
-    mesh = get_mesh(1, devices=[device])
-    # bf16 on the MXU; the CPU proxy runs f32 (XLA:CPU bf16 conv emulation
-    # would unfairly slow the baseline — reference ran f32 too)
-    spec = lenet(dtype=dtype or (jnp.bfloat16 if device.platform == "tpu"
-                                 else jnp.float32))
+    n_feat = len(cols) - 1
 
     def loss_step(params, nt, batch):
-        x, y = batch
+        feats, y = batch[:n_feat], batch[n_feat]
+        x = feats[0] if n_feat == 1 else tuple(feats)
         out, new_nt = spec.apply(params, nt, x, training=True)
         return sparse_softmax_cross_entropy(y, out), new_nt
 
+    # one physical device; num_workers > 1 stacks replicas on it
+    mesh = get_mesh(1, devices=[device])
     engine = LocalSGDEngine(
-        spec, loss_step, optax.adam(1e-3), ADAGMerge(), mesh,
-        num_workers=1, window=window, batch_size=batch_size,
+        spec, loss_step, optimizer, rule, mesh,
+        num_workers=num_workers, window=window, batch_size=batch_size,
     )
     params, nt = spec.init_np(0)
     state = engine.init_state(params, nt)
-    cols = ["features", "label"]
-    n_windows = rows // (batch_size * window)
     staged = engine.stage_dataset(
-        train.worker_shards(1, batch_size, window, cols)
+        train.worker_shards(num_workers, batch_size, window, cols)
     )
+    rows_pw = staged[0].shape[1]
+    n_windows = rows_pw // (batch_size * window)
+    epoch_rows = num_workers * n_windows * batch_size * window
 
     t0 = time.perf_counter()
     state, _ = engine.run_epoch_resident(state, staged, 0)  # compile + warm
     jax.block_until_ready(state.center)
-    log(f"[{device.platform}] compile+first epoch: {time.perf_counter()-t0:.1f}s")
+    log(f"  compile+warm epoch: {time.perf_counter() - t0:.1f}s")
 
     start = time.perf_counter()
     for e in range(epochs_timed):
         state, losses = engine.run_epoch_resident(state, staged, e + 1)
     jax.block_until_ready(state.center)
     elapsed = time.perf_counter() - start
-    sps = epochs_timed * n_windows * batch_size * window / elapsed
-    log(f"[{device.platform}] {sps:,.0f} samples/sec "
-        f"({epochs_timed}×{n_windows} windows in {elapsed:.2f}s, "
-        f"final loss {float(losses[-1]):.4f})")
+    sps = epochs_timed * epoch_rows / elapsed
+    log(f"  {sps:,.0f} samples/sec ({epochs_timed}×{n_windows} windows × "
+        f"{num_workers}w in {elapsed:.2f}s, final loss "
+        f"{float(losses[-1]):.4f})")
     return sps
 
 
+def emit(name, sps, flops_per_sample, peak, extra=None):
+    rec = {
+        "config": name,
+        "samples_per_sec": round(sps, 1),
+        "flops_per_sample": int(flops_per_sample),
+    }
+    if peak:
+        rec["tflops_delivered"] = round(sps * flops_per_sample / 1e12, 2)
+        rec["mfu"] = round(sps * flops_per_sample / peak, 4)
+    if extra:
+        rec.update(extra)
+    log(json.dumps(rec))
+    return rec
+
+
+def run_all_configs(accel):
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu.datasets import cifar10, higgs, imdb, mnist
+    from distkeras_tpu.models import lenet, lstm_classifier, mlp, vgg_small
+    from distkeras_tpu.parallel.merge_rules import (
+        ADAGMerge,
+        DownpourMerge,
+        DynSGDMerge,
+        ElasticAverageMerge,
+    )
+
+    peak = peak_flops(accel)
+    on_tpu = accel.platform == "tpu"
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    results = {}
+
+    def cfg(tpu_val, cpu_val):
+        # accelerator-sized vs CPU-only-host-sized run parameters (single-core
+        # XLA:CPU convs are ~4 orders of magnitude slower — see SCALING.md)
+        return tpu_val if on_tpu else cpu_val
+
+    # -- config 1: MNIST 3-layer MLP, SingleTrainer (single-process CPU) ----
+    log("[config 1] MNIST-MLP / SingleTrainer (single-process CPU)")
+    cpu = jax.devices("cpu")[0]
+    train, _ = mnist(n_train=8192, n_test=64)
+    sps = measure(cpu, mlp(dtype=jnp.float32), ADAGMerge(), optax.sgd(0.01),
+                  train, ["features", "label"], batch_size=64, window=1)
+    results["mnist_mlp_single_cpu"] = emit(
+        "mnist_mlp_single_cpu", sps, mlp_flops((784, 500, 300, 10)), None)
+
+    # -- config 2: MNIST LeNet CNN, ADAG (the north-star) -------------------
+    log(f"[config 2] MNIST-CNN / ADAG on {accel.platform}")
+    train, _ = mnist(n_train=cfg(65536, 768), n_test=64)
+    sps = measure(accel, lenet(dtype=dt), ADAGMerge(), optax.adam(1e-3),
+                  train, ["features", "label"], batch_size=cfg(256, 64),
+                  window=cfg(8, 3), epochs_timed=cfg(3, 1))
+    results["adag_mnist_cnn"] = emit(
+        "adag_mnist_cnn", sps, lenet_flops(), peak)
+
+    # -- config 3: CIFAR-10 VGG-small, DOWNPOUR -----------------------------
+    log(f"[config 3] CIFAR10-VGG / DOWNPOUR on {accel.platform}")
+    train, _ = cifar10(n_train=cfg(8192, 64), n_test=64)
+    sps = measure(accel, vgg_small(dtype=dt), DownpourMerge(),
+                  optax.adam(5e-4), train, ["features", "label"],
+                  batch_size=cfg(256, 16), window=cfg(4, 2),
+                  epochs_timed=cfg(3, 1))
+    results["downpour_cifar_vgg"] = emit(
+        "downpour_cifar_vgg", sps, vgg_small_flops(), peak)
+
+    # -- config 4: Higgs tabular MLP, AEASGD + EAMSGD -----------------------
+    log(f"[config 4] Higgs-MLP / AEASGD+EAMSGD on {accel.platform}")
+    train, _ = higgs(n_train=cfg(32768, 4096), n_test=64)
+    hdims = (28, 256, 128, 2)
+    hspec = mlp(input_shape=(28,), hidden=hdims[1:-1], num_classes=2, dtype=dt)
+    for nm, opt in (("aeasgd", optax.sgd(0.05)),
+                    ("eamsgd", optax.sgd(0.05, momentum=0.9, nesterov=True))):
+        sps = measure(accel, hspec,
+                      ElasticAverageMerge(alpha=0.05), opt, train,
+                      ["features", "label"], batch_size=cfg(512, 128),
+                      window=cfg(8, 4), epochs_timed=cfg(3, 1))
+        results[f"{nm}_higgs_mlp"] = emit(
+            f"{nm}_higgs_mlp", sps, mlp_flops(hdims), peak)
+
+    # -- config 5: IMDB LSTM, DynSGD ----------------------------------------
+    log(f"[config 5] IMDB-LSTM / DynSGD on {accel.platform}")
+    train, _ = imdb(n_train=cfg(4096, 128), n_test=64)
+    sps = measure(accel, lstm_classifier(dtype=dt), DynSGDMerge(),
+                  optax.adam(1e-3), train, ["features", "mask", "label"],
+                  batch_size=cfg(64, 16), window=cfg(4, 2),
+                  epochs_timed=cfg(3, 1))
+    results["dynsgd_imdb_lstm"] = emit(
+        "dynsgd_imdb_lstm", sps, lstm_flops(), peak)
+
+    return results
+
+
+def run_scaling(accel):
+    """Stacked-worker scaling on ONE chip: W replicas time-share the device.
+
+    This is the honest single-chip substitute for a chip-scaling curve (no
+    multi-chip hardware here): it shows the engine keeps the MXU busy as the
+    worker dimension grows — per-worker batch is held constant, so total work
+    scales with W.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu.datasets import mnist
+    from distkeras_tpu.models import lenet
+    from distkeras_tpu.parallel.merge_rules import ADAGMerge
+
+    on_tpu = accel.platform == "tpu"
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    rows_pw, batch = (16384, 128) if on_tpu else (512, 32)
+    out = {}
+    for W in (1, 2, 4, 8):
+        # big enough shards (32 windows/worker/epoch) that the epoch is
+        # compute-bound, not dispatch-bound
+        train, _ = mnist(n_train=rows_pw * W, n_test=64)
+        log(f"[scaling] ADAG/LeNet W={W} (stacked on one {accel.platform})")
+        sps = measure(accel, lenet(dtype=dt), ADAGMerge(), optax.adam(1e-3),
+                      train, ["features", "label"], batch_size=batch, window=4,
+                      num_workers=W, epochs_timed=3 if on_tpu else 1)
+        out[W] = sps
+        log(json.dumps({"scaling_w": W, "samples_per_sec": round(sps, 1)}))
+    base = out[1]
+    for W, sps in out.items():
+        log(f"[scaling] W={W}: {sps:,.0f} samples/sec "
+            f"({sps / base:.2f}× W=1)")
+    return out
+
+
 def main():
-    sys.path.insert(0, ".")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scaling", action="store_true",
+                    help="also run the stacked-worker scaling sweep")
+    ap.add_argument("--skip-proxy", action="store_true",
+                    help="skip the slow CPU-proxy denominator run")
+    args = ap.parse_args()
+
+    import optax
+
+    from distkeras_tpu.datasets import mnist
+    from distkeras_tpu.models import lenet
+    from distkeras_tpu.parallel.merge_rules import ADAGMerge
+
     accel = jax.devices()[0]
     log(f"accelerator: {accel}")
 
-    value = measure_samples_per_sec(accel, rows=16384, batch_size=256, window=8)
+    results = run_all_configs(accel)
+    if args.scaling:
+        run_scaling(accel)
 
-    try:
-        cpu = jax.devices("cpu")[0]
-        # smaller run: the CPU proxy only needs a stable steady-state rate
-        # (this host exposes a single CPU core — documented in BASELINE.md)
-        baseline = measure_samples_per_sec(
-            cpu, rows=768, batch_size=64, window=3, epochs_timed=1
-        )
-    except Exception as e:  # CPU backend unavailable — report raw number only
-        log(f"cpu proxy failed: {e}")
-        baseline = float("nan")
+    north = results["adag_mnist_cnn"]
 
-    vs = value / baseline if baseline == baseline else -1.0
-    print(json.dumps({
+    # CPU-proxy denominator for the north-star ratio: SAME batch/window
+    # (ADVICE.md), one superbatch per epoch, 3 timed epochs post-warmup.
+    vs = None
+    if accel.platform != "cpu" and not args.skip_proxy:
+        try:
+            import jax.numpy as jnp
+
+            log("[proxy] ADAG/LeNet on single-process CPU "
+                "(same batch/window, fewer rows)")
+            cpu = jax.devices("cpu")[0]
+            train, _ = mnist(n_train=2048, n_test=64)
+            baseline = measure(
+                cpu, lenet(dtype=jnp.float32), ADAGMerge(), optax.adam(1e-3),
+                train, ["features", "label"], batch_size=256, window=8,
+            )
+            vs = north["samples_per_sec"] / baseline
+        except Exception as e:  # CPU backend unavailable — omit the ratio
+            log(f"cpu proxy failed: {e}")
+
+    line = {
         "metric": "adag_mnist_cnn_samples_per_sec",
-        "value": round(value, 1),
+        "value": north["samples_per_sec"],
         "unit": "samples/sec",
-        "vs_baseline": round(vs, 2),
-    }))
+    }
+    if vs is not None:
+        line["vs_baseline"] = round(vs, 2)
+    if "mfu" in north:
+        line["mfu"] = north["mfu"]
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -69,8 +69,8 @@ def mnist(n_train: int = 60000, n_test: int = 10000, seed: int = 0):
     p = _find("mnist.npz")
     if p is not None:
         with np.load(p) as z:
-            xtr, ytr = z["x_train"], z["y_train"]
-            xte, yte = z["x_test"], z["y_test"]
+            xtr, ytr = z["x_train"][:n_train], z["y_train"][:n_train]
+            xte, yte = z["x_test"][:n_test], z["y_test"][:n_test]
         xtr = (xtr.astype(np.float32) / 255.0)[..., None]
         xte = (xte.astype(np.float32) / 255.0)[..., None]
         ytr, yte = ytr.astype(np.int32), yte.astype(np.int32)
@@ -88,10 +88,10 @@ def cifar10(n_train: int = 50000, n_test: int = 10000, seed: int = 10):
     p = _find("cifar10.npz")
     if p is not None:
         with np.load(p) as z:
-            xtr = z["x_train"].astype(np.float32) / 255.0
-            xte = z["x_test"].astype(np.float32) / 255.0
-            ytr = z["y_train"].astype(np.int32).reshape(-1)
-            yte = z["y_test"].astype(np.int32).reshape(-1)
+            xtr = z["x_train"][:n_train].astype(np.float32) / 255.0
+            xte = z["x_test"][:n_test].astype(np.float32) / 255.0
+            ytr = z["y_train"][:n_train].astype(np.int32).reshape(-1)
+            yte = z["y_test"][:n_test].astype(np.int32).reshape(-1)
     else:
         xtr, ytr = _class_template_images(
             n_train, 10, (32, 32, 3), seed, noise=0.45, split=0
@@ -114,7 +114,8 @@ def higgs(n_train: int = 100000, n_test: int = 20000, seed: int = 20):
     rng = np.random.default_rng(seed)
     if p is not None:
         with np.load(p) as z:
-            xtr, ytr, xte, yte = z["x_train"], z["y_train"], z["x_test"], z["y_test"]
+            xtr, ytr = z["x_train"][:n_train], z["y_train"][:n_train]
+            xte, yte = z["x_test"][:n_test], z["y_test"][:n_test]
     else:
         # One mixing matrix and mean-shift direction for both splits — train
         # and test must share the decision boundary; only the samples differ.
@@ -155,8 +156,10 @@ def imdb(
     rng = np.random.default_rng(seed)
     if p is not None:
         with np.load(p, allow_pickle=True) as z:
-            seqs_tr, ytr = z["x_train"], z["y_train"].astype(np.int32)
-            seqs_te, yte = z["x_test"], z["y_test"].astype(np.int32)
+            seqs_tr = z["x_train"][:n_train]
+            ytr = z["y_train"][:n_train].astype(np.int32)
+            seqs_te = z["x_test"][:n_test]
+            yte = z["y_test"][:n_test].astype(np.int32)
     else:
         pos_tokens = rng.choice(np.arange(10, vocab), size=200, replace=False)
         neg_tokens = rng.choice(np.arange(10, vocab), size=200, replace=False)

@@ -17,8 +17,11 @@ from distkeras_tpu.parallel.merge_rules import (
     get_merge_rule,
 )
 from distkeras_tpu.parallel.local_sgd import LocalSGDEngine, TrainState
+from distkeras_tpu.parallel.sequence import attention_reference, ring_attention
 
 __all__ = [
+    "attention_reference",
+    "ring_attention",
     "get_mesh",
     "mesh_info",
     "MergeRule",

@@ -1,0 +1,113 @@
+"""Sequence/context parallelism: ring attention over a mesh axis.
+
+The reference predates long-context entirely (SURVEY.md §5.7 — its longest
+sequences were IMDB-LSTM inputs on one replica), so nothing here is a port:
+this is the TPU-native long-context extension. Sequences are sharded along
+their length over a mesh axis; each device holds one Q/K/V block and computes
+exact attention by rotating K/V blocks around the ring with
+``jax.lax.ppermute`` (ICI neighbor exchanges, overlapped by XLA with the
+block computation) while maintaining a numerically stable online softmax —
+the blockwise/ring-attention construction of Liu et al. 2023. Peak memory per
+chip is O(L/N · L/N) for scores instead of O(L²), so context length scales
+linearly with the ring size.
+
+No Python control flow inside: the ring is a ``lax.fori_loop`` with a static
+trip count, shard_map'ed over the mesh — one compiled SPMD program.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG = -1e9  # finite "masked" score: keeps the online softmax NaN-free
+
+
+def attention_reference(q, k, v, causal: bool = False, scale=None):
+    """Plain single-device softmax attention — the correctness oracle.
+
+    Shapes: q/k/v ``[B, L, H, D]`` → ``[B, L, H, D]``.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        Lq, Lk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Lq, Lk), bool))
+        s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _ring_attention_shard(q, k, v, *, axis_name, axis_size, causal, scale):
+    """Per-shard body: my Q block against all K/V blocks via ring rotation."""
+    idx = jax.lax.axis_index(axis_name)
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    qf = q.astype(jnp.float32) * scale
+
+    q_pos = idx * Lq + jnp.arange(Lq)  # global positions of my queries
+
+    def step(i, carry):
+        k_blk, v_blk, m, l, o = carry
+        src = (idx - i) % axis_size  # whose K/V block I currently hold
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            k_pos = src * Lk + jnp.arange(Lk)
+            mask = q_pos[:, None] >= k_pos[None, :]          # [Lq, Lk]
+            s = jnp.where(mask[None, None], s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.exp(m - m_new)                            # [B, H, Lq]
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m_new, l, o
+
+    m0 = jnp.full((B, H, Lq), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, Lq), jnp.float32)
+    o0 = jnp.zeros((B, H, Lq, D), jnp.float32)
+    *_, m, l, o = jax.lax.fori_loop(
+        0, axis_size, step, (k, v, m0, l0, o0)
+    )
+    out = o / jnp.maximum(l, 1e-30)[..., None]               # [B, H, Lq, D]
+    return jnp.moveaxis(out, 1, 2).astype(q.dtype)           # [B, Lq, H, D]
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str | None = None,
+                   causal: bool = False, scale=None):
+    """Exact attention with Q/K/V sharded along sequence length over ``axis``.
+
+    ``q/k/v``: ``[B, L, H, D]`` with ``L % mesh_axis_size == 0``. Returns the
+    attention output with the same sharding. Matches
+    :func:`attention_reference` to f32 tolerance (pinned by the unit tests on
+    an 8-device mesh).
+    """
+    axis = axis or mesh.axis_names[0]
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by mesh axis "
+            f"'{axis}' of size {n}"
+        )
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    spec = P(None, axis, None, None)
+    body = functools.partial(
+        _ring_attention_shard, axis_name=axis, axis_size=n,
+        causal=causal, scale=scale,
+    )
+    shard_fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return jax.jit(shard_fn)(q, k, v)

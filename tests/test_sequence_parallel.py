@@ -1,0 +1,56 @@
+"""Ring attention (sequence parallelism) vs the single-device oracle on the
+8-device CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu.parallel.mesh import get_mesh
+from distkeras_tpu.parallel.sequence import attention_reference, ring_attention
+
+
+def qkv(B=2, L=64, H=2, D=16, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: rng.normal(0, 1, size=(B, L, H, D)).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_oracle_on_mesh(causal):
+    assert len(jax.devices()) == 8
+    mesh = get_mesh(8, axis="sp")
+    q, k, v = qkv()
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    # sharded along the sequence axis over all 8 devices
+    assert len(out.sharding.device_set) == 8
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_causal_actually_masks():
+    mesh = get_mesh(8, axis="sp")
+    q, k, v = qkv(seed=3)
+    causal = np.asarray(ring_attention(q, k, v, mesh, causal=True))
+    full = np.asarray(ring_attention(q, k, v, mesh, causal=False))
+    # first query can only see key 0 under causal; later queries differ
+    assert not np.allclose(causal, full)
+    ref0 = v[:, :1] / 1.0  # softmax over a single key is identity on v
+    np.testing.assert_allclose(causal[:, 0], ref0[:, 0], rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_rejects_indivisible_length():
+    mesh = get_mesh(8, axis="sp")
+    q, k, v = qkv(L=60)
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention(q, k, v, mesh)
+
+
+def test_ring_attention_submesh():
+    """Works on a 4-device submesh too (axis size != device count)."""
+    mesh = get_mesh(4, axis="sp")
+    q, k, v = qkv(L=32, seed=5)
+    out = ring_attention(q, k, v, mesh)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
