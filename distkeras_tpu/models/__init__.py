@@ -7,7 +7,10 @@ five BASELINE configs map to:
   (config 4);
 - :func:`lenet` — MNIST LeNet-style CNN (config 2, the north-star config);
 - :func:`vgg_small` — CIFAR-10 VGG-small (config 3);
-- :func:`lstm_classifier` — IMDB LSTM sentiment (config 5).
+- :func:`lstm_classifier` — IMDB LSTM sentiment (config 5);
+- :func:`transformer_classifier` — beyond-reference long-context family whose
+  attention math is shared with ``parallel.ring_attention`` (sequence
+  parallelism).
 
 All models emit **logits** (pair with the ``softmax_cross_entropy`` family) and
 default to bfloat16 activations with float32 parameters — bf16 keeps matmuls
@@ -18,10 +21,15 @@ exact.
 from distkeras_tpu.models.mlp import MLP, mlp
 from distkeras_tpu.models.cnn import LeNet, VGGSmall, lenet, vgg_small
 from distkeras_tpu.models.lstm import LSTMClassifier, lstm_classifier
+from distkeras_tpu.models.transformer import (
+    TransformerClassifier,
+    transformer_classifier,
+)
 
 __all__ = [
     "MLP", "mlp",
     "LeNet", "lenet",
     "VGGSmall", "vgg_small",
     "LSTMClassifier", "lstm_classifier",
+    "TransformerClassifier", "transformer_classifier",
 ]

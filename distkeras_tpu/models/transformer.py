@@ -1,0 +1,108 @@
+"""Transformer encoder classifier — the long-context model family.
+
+Beyond-reference addition (the Spark-era reference's newest model was an
+LSTM): a pre-norm transformer encoder whose attention runs through the same
+math as :mod:`distkeras_tpu.parallel.sequence` — single-device training uses
+:func:`attention_reference`, and the identical per-head computation can be
+executed sequence-parallel with :func:`ring_attention` on a mesh (equality is
+pinned by tests/test_sequence_parallel.py). bf16 activations keep the QKV/MLP
+matmuls on the MXU; all control flow is static for XLA.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.model import ModelSpec, from_flax
+from distkeras_tpu.parallel.sequence import attention_reference
+
+
+def sincos_positions(maxlen: int, dim: int) -> np.ndarray:
+    """Fixed sinusoidal position table [maxlen, dim] (Vaswani et al. 2017)."""
+    pos = np.arange(maxlen)[:, None]
+    i = np.arange(dim // 2)[None, :]
+    angle = pos / np.power(10000.0, 2 * i / dim)
+    table = np.zeros((maxlen, dim), np.float32)
+    table[:, 0::2] = np.sin(angle)
+    table[:, 1::2] = np.cos(angle)
+    return table
+
+
+class EncoderBlock(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    causal: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask=None, training: bool = False):
+        B, L, _ = x.shape
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        qkv = nn.Dense(3 * self.dim, dtype=self.dtype)(h.astype(self.dtype))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, L, self.heads, self.dim // self.heads)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        att = attention_reference(q, k, v, causal=self.causal, key_mask=mask)
+        att = att.reshape(B, L, self.dim)
+        x = x + nn.Dense(self.dim, dtype=self.dtype)(
+            att.astype(self.dtype)
+        ).astype(jnp.float32)
+
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype)(
+            h.astype(self.dtype)
+        )
+        h = nn.gelu(h)
+        h = nn.Dense(self.dim, dtype=self.dtype)(h)
+        return x + h.astype(jnp.float32)
+
+
+class TransformerClassifier(nn.Module):
+    """Token sequence → class logits (IMDB-style inputs: tokens + mask)."""
+
+    vocab: int = 20000
+    maxlen: int = 200
+    dim: int = 128
+    heads: int = 4
+    depth: int = 2
+    num_classes: int = 2
+    causal: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens, mask=None, training: bool = False):
+        if mask is None:
+            mask = jnp.ones(tokens.shape, jnp.float32)
+        x = nn.Embed(self.vocab, self.dim, dtype=self.dtype)(tokens)
+        x = x.astype(jnp.float32) + jnp.asarray(
+            sincos_positions(self.maxlen, self.dim)
+        )[None, : tokens.shape[1]]
+        for _ in range(self.depth):
+            x = EncoderBlock(
+                dim=self.dim, heads=self.heads, causal=self.causal,
+                dtype=self.dtype,
+            )(x, mask, training)
+        m = mask.astype(jnp.float32)[..., None]
+        pooled = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+        x = nn.LayerNorm(dtype=jnp.float32)(pooled)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype)(
+            x.astype(self.dtype)
+        )
+        return logits.astype(jnp.float32)
+
+
+def transformer_classifier(vocab=20000, maxlen=200, dim=128, heads=4, depth=2,
+                           num_classes=2, causal=False,
+                           dtype=jnp.bfloat16) -> ModelSpec:
+    module = TransformerClassifier(
+        vocab=vocab, maxlen=maxlen, dim=dim, heads=heads, depth=depth,
+        num_classes=num_classes, causal=causal, dtype=dtype,
+    )
+    example = (
+        jnp.zeros((1, maxlen), jnp.int32),
+        jnp.ones((1, maxlen), jnp.float32),
+    )
+    return from_flax(module, example, name="transformer_classifier")

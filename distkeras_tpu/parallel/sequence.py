@@ -26,10 +26,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _NEG = -1e9  # finite "masked" score: keeps the online softmax NaN-free
 
 
-def attention_reference(q, k, v, causal: bool = False, scale=None):
+def attention_reference(q, k, v, causal: bool = False, scale=None,
+                        key_mask=None):
     """Plain single-device softmax attention — the correctness oracle.
 
-    Shapes: q/k/v ``[B, L, H, D]`` → ``[B, L, H, D]``.
+    Shapes: q/k/v ``[B, L, H, D]`` → ``[B, L, H, D]``. ``key_mask`` is an
+    optional ``[B, Lk]`` validity mask (1 = attend, 0 = ignore, e.g. padding).
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
@@ -37,59 +39,84 @@ def attention_reference(q, k, v, causal: bool = False, scale=None):
         Lq, Lk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((Lq, Lk), bool))
         s = jnp.where(mask, s, _NEG)
+    if key_mask is not None:
+        valid = key_mask[:, None, None, :].astype(bool)
+        s = jnp.where(valid, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
+    if key_mask is not None:
+        # fully-masked rows yield zeros (same convention as ring_attention),
+        # not the mean of values a softmax over uniform -1e9 would give
+        p = p * valid
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
 
 
-def _ring_attention_shard(q, k, v, *, axis_name, axis_size, causal, scale):
-    """Per-shard body: my Q block against all K/V blocks via ring rotation."""
+def _ring_attention_shard(q, k, v, key_mask=None, *, axis_name, axis_size,
+                          causal, scale):
+    """Per-shard body: my Q block against all K/V blocks via ring rotation.
+
+    ``key_mask`` presence is static: the no-padding path compiles with no
+    mask rotation or masking ops at all.
+    """
+    has_mask = key_mask is not None
     idx = jax.lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     qf = q.astype(jnp.float32) * scale
 
     q_pos = idx * Lq + jnp.arange(Lq)  # global positions of my queries
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
     def step(i, carry):
-        k_blk, v_blk, m, l, o = carry
+        if has_mask:
+            k_blk, v_blk, km_blk, m, l, o = carry
+        else:
+            k_blk, v_blk, m, l, o = carry
         src = (idx - i) % axis_size  # whose K/V block I currently hold
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+        valid = None                                         # static shape
         if causal:
             k_pos = src * Lk + jnp.arange(Lk)
-            mask = q_pos[:, None] >= k_pos[None, :]          # [Lq, Lk]
-            s = jnp.where(mask[None, None], s, _NEG)
+            tri = q_pos[:, None] >= k_pos[None, :]           # [Lq, Lk]
+            valid = jnp.broadcast_to(tri[None, None], s.shape)
+        if has_mask:
+            km = km_blk.astype(bool)[:, None, None, :]       # [B,1,1,Lk]
+            valid = km if valid is None else (valid & km)
+        if valid is not None:
+            s = jnp.where(valid, s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
-        if causal:
-            p = jnp.where(mask[None, None], p, 0.0)
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
         corr = jnp.exp(m - m_new)                            # [B, H, Lq]
         l = l * corr + jnp.sum(p, axis=-1)
         o = o * corr[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
         )
-        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        if has_mask:
+            km_blk = jax.lax.ppermute(km_blk, axis_name, perm)
+            return k_blk, v_blk, km_blk, m_new, l, o
         return k_blk, v_blk, m_new, l, o
 
     m0 = jnp.full((B, H, Lq), _NEG, jnp.float32)
     l0 = jnp.zeros((B, H, Lq), jnp.float32)
     o0 = jnp.zeros((B, H, Lq, D), jnp.float32)
-    *_, m, l, o = jax.lax.fori_loop(
-        0, axis_size, step, (k, v, m0, l0, o0)
-    )
+    init = (k, v, key_mask, m0, l0, o0) if has_mask else (k, v, m0, l0, o0)
+    *_, m, l, o = jax.lax.fori_loop(0, axis_size, step, init)
     out = o / jnp.maximum(l, 1e-30)[..., None]               # [B, H, Lq, D]
     return jnp.moveaxis(out, 1, 2).astype(q.dtype)           # [B, Lq, H, D]
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str | None = None,
-                   causal: bool = False, scale=None):
+                   causal: bool = False, scale=None, key_mask=None):
     """Exact attention with Q/K/V sharded along sequence length over ``axis``.
 
-    ``q/k/v``: ``[B, L, H, D]`` with ``L % mesh_axis_size == 0``. Returns the
-    attention output with the same sharding. Matches
+    ``q/k/v``: ``[B, L, H, D]`` with ``L % mesh_axis_size == 0``; ``key_mask``
+    an optional ``[B, L]`` validity mask (padding), sharded and rotated with
+    K/V. Returns the attention output with the same sharding. Matches
     :func:`attention_reference` to f32 tolerance (pinned by the unit tests on
-    an 8-device mesh).
+    an 8-device mesh); rows whose keys are ALL masked yield zeros in both.
     """
     axis = axis or mesh.axis_names[0]
     n = mesh.shape[axis]
@@ -99,15 +126,23 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str | None = None,
             f"'{axis}' of size {n}"
         )
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    spec = P(None, axis, None, None)
     body = functools.partial(
         _ring_attention_shard, axis_name=axis, axis_size=n,
         causal=causal, scale=scale,
     )
-    shard_fn = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
-    )
+    spec = P(None, axis, None, None)
     sharding = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
-    return jax.jit(shard_fn)(q, k, v)
+    if key_mask is None:
+        shard_fn = jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        return jax.jit(shard_fn)(q, k, v)
+    mspec = P(None, axis)
+    shard_fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
+        check_vma=False,
+    )
+    key_mask = jax.device_put(key_mask, NamedSharding(mesh, mspec))
+    return jax.jit(shard_fn)(q, k, v, key_mask)

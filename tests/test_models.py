@@ -66,6 +66,22 @@ def test_lstm_classifier_trains_on_mesh():
     assert np.mean(ls[-3:]) < ls[0], ls
 
 
+def test_transformer_classifier_trains_on_mesh():
+    from distkeras_tpu.models import transformer_classifier
+
+    train, _ = imdb(n_train=512, n_test=32, vocab=500, maxlen=32)
+    model = transformer_classifier(vocab=500, maxlen=32, dim=32, heads=2,
+                                   depth=1, dtype=jnp.float32)
+    t = ADAG(model, loss="sparse_softmax_cross_entropy",
+             worker_optimizer="adam", learning_rate=1e-3, num_workers=8,
+             batch_size=8, communication_window=2, num_epoch=2,
+             features_col=["features", "mask"])
+    t.train(train, shuffle=True)
+    ls = losses_of(t)
+    assert np.all(np.isfinite(ls))
+    assert np.mean(ls[-3:]) < ls[0], ls
+
+
 def test_cifar10_loader_shapes_and_split_distribution():
     train, test = cifar10(n_train=2000, n_test=500)
     assert train["features"].shape == (2000, 32, 32, 3)
