@@ -285,6 +285,7 @@ def run_time_to_accuracy(accel, target=0.99, max_epochs=20):
         "metric": "time_to_accuracy",
         "target": target,
         "reached": round(acc, 4),
+        "reached_target": bool(acc >= target),  # unrounded comparison
         "epochs": epoch + 1,
         "train_seconds": round(train_time, 3),
     }
@@ -384,7 +385,7 @@ def main():
         line["vs_baseline"] = round(vs, 2)
     if "mfu" in north:
         line["mfu"] = north["mfu"]
-    if tta is not None and tta["reached"] >= tta["target"]:
+    if tta is not None and tta["reached_target"]:
         line["tta_99_seconds"] = tta["train_seconds"]
     print(json.dumps(line))
 

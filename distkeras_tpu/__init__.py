@@ -38,6 +38,7 @@ from distkeras_tpu.trainers import (  # noqa: E402
     DOWNPOUR,
     DynSGD,
     EAMSGD,
+    MeshTrainer,
     SingleTrainer,
     Trainer,
 )
@@ -48,6 +49,7 @@ __all__ = [
     "DOWNPOUR",
     "DynSGD",
     "EAMSGD",
+    "MeshTrainer",
     "SingleTrainer",
     "Trainer",
     "utils",

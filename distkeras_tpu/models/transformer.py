@@ -40,23 +40,26 @@ class EncoderBlock(nn.Module):
     @nn.compact
     def __call__(self, x, mask=None, training: bool = False):
         B, L, _ = x.shape
-        h = nn.LayerNorm(dtype=jnp.float32)(x)
-        qkv = nn.Dense(3 * self.dim, dtype=self.dtype)(h.astype(self.dtype))
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        # Layer names are load-bearing: parallel.tensor.megatron_specs shards
+        # qkv/mlp_up column-wise and attn_out/mlp_down row-wise over 'tp'.
+        qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(
+            h.astype(self.dtype)
+        )
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (B, L, self.heads, self.dim // self.heads)
         q, k, v = (t.reshape(shape) for t in (q, k, v))
         att = attention_reference(q, k, v, causal=self.causal, key_mask=mask)
         att = att.reshape(B, L, self.dim)
-        x = x + nn.Dense(self.dim, dtype=self.dtype)(
+        x = x + nn.Dense(self.dim, dtype=self.dtype, name="attn_out")(
             att.astype(self.dtype)
         ).astype(jnp.float32)
 
-        h = nn.LayerNorm(dtype=jnp.float32)(x)
-        h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype)(
-            h.astype(self.dtype)
-        )
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype,
+                     name="mlp_up")(h.astype(self.dtype))
         h = nn.gelu(h)
-        h = nn.Dense(self.dim, dtype=self.dtype)(h)
+        h = nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")(h)
         return x + h.astype(jnp.float32)
 
 
@@ -76,19 +79,20 @@ class TransformerClassifier(nn.Module):
     def __call__(self, tokens, mask=None, training: bool = False):
         if mask is None:
             mask = jnp.ones(tokens.shape, jnp.float32)
-        x = nn.Embed(self.vocab, self.dim, dtype=self.dtype)(tokens)
+        x = nn.Embed(self.vocab, self.dim, dtype=self.dtype,
+                     name="embed")(tokens)
         x = x.astype(jnp.float32) + jnp.asarray(
             sincos_positions(self.maxlen, self.dim)
         )[None, : tokens.shape[1]]
-        for _ in range(self.depth):
+        for i in range(self.depth):
             x = EncoderBlock(
                 dim=self.dim, heads=self.heads, causal=self.causal,
-                dtype=self.dtype,
+                dtype=self.dtype, name=f"block_{i}",
             )(x, mask, training)
         m = mask.astype(jnp.float32)[..., None]
         pooled = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
-        x = nn.LayerNorm(dtype=jnp.float32)(pooled)
-        logits = nn.Dense(self.num_classes, dtype=self.dtype)(
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_head")(pooled)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(
             x.astype(self.dtype)
         )
         return logits.astype(jnp.float32)

@@ -18,10 +18,20 @@ from distkeras_tpu.parallel.merge_rules import (
 )
 from distkeras_tpu.parallel.local_sgd import LocalSGDEngine, TrainState
 from distkeras_tpu.parallel.sequence import attention_reference, ring_attention
+from distkeras_tpu.parallel.tensor import (
+    SPMDEngine,
+    get_mesh_nd,
+    megatron_specs,
+    shard_pytree,
+)
 
 __all__ = [
     "attention_reference",
     "ring_attention",
+    "SPMDEngine",
+    "get_mesh_nd",
+    "megatron_specs",
+    "shard_pytree",
     "get_mesh",
     "mesh_info",
     "MergeRule",

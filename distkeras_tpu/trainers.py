@@ -76,6 +76,13 @@ def resolve_optimizer(worker_optimizer, learning_rate: float,
     raise ValueError(f"unknown worker_optimizer {worker_optimizer!r}")
 
 
+def _as_cols(features_col) -> list[str]:
+    """Coerce a feature-column name or list of names to a list."""
+    return (
+        [features_col] if isinstance(features_col, str) else list(features_col)
+    )
+
+
 def _as_spec(model) -> tuple[ModelSpec, Any]:
     """Accept a Keras model or a ModelSpec; return (spec, keras_model|None)."""
     if isinstance(model, ModelSpec):
@@ -180,9 +187,7 @@ class DistributedTrainer(Trainer):
             else int(np.prod(self.mesh.devices.shape))
         )
         self.batch_size = int(batch_size)
-        self.features_col: list[str] = (
-            [features_col] if isinstance(features_col, str) else list(features_col)
-        )
+        self.features_col: list[str] = _as_cols(features_col)
         self.label_col = label_col
         self.num_epoch = int(num_epoch)
         self.communication_window = int(
@@ -512,6 +517,97 @@ class EAMSGD(AEASGD):
         return resolve_optimizer(
             self.worker_optimizer, self.learning_rate,
             momentum=self.momentum, nesterov=True,
+        )
+
+
+class MeshTrainer(Trainer):
+    """Sync SPMD trainer over an N-D mesh — data × tensor parallelism.
+
+    Beyond-reference (SURVEY.md §2b.2 lists TP as "natural extension via
+    jax.sharding"): trains ONE set of parameters with synchronous data
+    parallelism over the ``dp`` mesh axis and Megatron-style tensor
+    parallelism over ``tp`` (column/row-parallel kernels, vocab-parallel
+    embedding — see :mod:`distkeras_tpu.parallel.tensor`). The math equals
+    single-device training on the global batch (pinned by
+    tests/test_tensor_parallel.py), so it is the scale-out path for models
+    whose weights outgrow one chip, while the five reference algorithms
+    remain the local-SGD/PS paths.
+
+    ``mesh_shape`` e.g. ``{"dp": 2, "tp": 4}``; ``param_specs`` overrides the
+    automatic Megatron rules with an explicit PartitionSpec pytree.
+    """
+
+    def __init__(self, keras_model, loss="sparse_softmax_cross_entropy",
+                 worker_optimizer="adam", learning_rate: float = 1e-3,
+                 mesh=None, mesh_shape: dict | None = None, param_specs=None,
+                 batch_size: int = 32, features_col="features",
+                 label_col: str = "label", num_epoch: int = 1, seed: int = 0,
+                 log_metrics: bool = False):
+        from distkeras_tpu.parallel.tensor import get_mesh_nd
+
+        super().__init__(keras_model, loss, worker_optimizer,
+                         learning_rate=learning_rate, seed=seed)
+        if mesh is None:
+            mesh = get_mesh_nd(mesh_shape or {"dp": len(jax.devices())})
+        self.mesh = mesh
+        self.param_specs = param_specs
+        self.batch_size = int(batch_size)
+        self.features_col: list[str] = _as_cols(features_col)
+        self.label_col = label_col
+        self.num_epoch = int(num_epoch)
+        self.log_metrics = bool(log_metrics)
+        self.metrics_: list[dict] = []
+
+    def train(self, dataset, shuffle: bool = False):
+        from distkeras_tpu.parallel.tensor import SPMDEngine
+
+        ds = self._coerce_dataset(dataset)
+        cols = self.features_col + [self.label_col]
+        n_feat = len(self.features_col)
+        spec, loss_fn = self.spec, self.loss_fn
+
+        def loss_step(params, nt, batch):
+            feats, y = batch[:n_feat], batch[n_feat]
+            x = feats[0] if n_feat == 1 else tuple(feats)
+            out, new_nt = spec.apply(params, nt, x, training=True)
+            return loss_fn(y, out), new_nt
+
+        engine = SPMDEngine(
+            spec, loss_step,
+            resolve_optimizer(self.worker_optimizer, self.learning_rate),
+            self.mesh, param_specs=self.param_specs,
+        )
+        params, nt, opt = engine.init_state(*self.spec.init_np(self.seed))
+
+        self.record_training_start()
+        for epoch in range(self.num_epoch):
+            seed = (self.seed + epoch) if shuffle else None
+            t0 = time.perf_counter()
+            n_steps = 0
+            for b in ds.batches(self.batch_size, cols, seed=seed):
+                params, nt, opt, loss = engine.run_step(params, nt, opt, b)
+                self.history.append(loss=loss, epoch=epoch)
+                n_steps += 1
+            if self.log_metrics and n_steps:
+                jax.block_until_ready(loss)
+                elapsed = time.perf_counter() - t0
+                rec = {
+                    "epoch": epoch,
+                    "samples_per_sec": round(
+                        n_steps * self.batch_size / elapsed, 1
+                    ),
+                    "wall_time": round(elapsed, 4),
+                }
+                self.metrics_.append(rec)
+                print(json.dumps({"metric": "epoch", **rec}), flush=True)
+        jax.block_until_ready(jax.tree.leaves(params)[0])
+        self.record_training_end()
+        for rec in self.history.records:
+            if "loss" in rec:
+                rec["loss"] = float(jax.device_get(rec["loss"]))
+        return self._finalize(
+            jax.tree.map(np.asarray, jax.device_get(params)),
+            jax.tree.map(np.asarray, jax.device_get(nt)),
         )
 
 
