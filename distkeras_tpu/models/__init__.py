@@ -23,6 +23,7 @@ from distkeras_tpu.models.cnn import LeNet, VGGSmall, lenet, vgg_small
 from distkeras_tpu.models.lstm import LSTMClassifier, lstm_classifier
 from distkeras_tpu.models.transformer import (
     TransformerClassifier,
+    pipelined_transformer_forward,
     transformer_classifier,
 )
 
@@ -32,4 +33,5 @@ __all__ = [
     "VGGSmall", "vgg_small",
     "LSTMClassifier", "lstm_classifier",
     "TransformerClassifier", "transformer_classifier",
+    "pipelined_transformer_forward",
 ]

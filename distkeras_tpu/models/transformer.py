@@ -64,7 +64,15 @@ class EncoderBlock(nn.Module):
 
 
 class TransformerClassifier(nn.Module):
-    """Token sequence → class logits (IMDB-style inputs: tokens + mask)."""
+    """Token sequence → class logits (IMDB-style inputs: tokens + mask).
+
+    Setup-style so the encoder stack is addressable piecewise: the
+    ``embed_tokens`` / ``head_logits`` methods and the per-block params
+    (``blocks_0 … blocks_{depth-1}``) let
+    :func:`pipelined_transformer_forward` run the homogeneous block stack
+    pipeline-parallel over a ``pp`` mesh axis while embed/head stay
+    replicated.
+    """
 
     vocab: int = 20000
     maxlen: int = 200
@@ -75,27 +83,75 @@ class TransformerClassifier(nn.Module):
     causal: bool = False
     dtype: jnp.dtype = jnp.bfloat16
 
-    @nn.compact
+    def setup(self):
+        self.embed = nn.Embed(self.vocab, self.dim, dtype=self.dtype)
+        self.blocks = [
+            EncoderBlock(dim=self.dim, heads=self.heads, causal=self.causal,
+                         dtype=self.dtype)
+            for _ in range(self.depth)
+        ]
+        self.ln_head = nn.LayerNorm(dtype=jnp.float32)
+        self.head = nn.Dense(self.num_classes, dtype=self.dtype)
+
+    def embed_tokens(self, tokens):
+        x = self.embed(tokens)
+        return x.astype(jnp.float32) + jnp.asarray(
+            sincos_positions(self.maxlen, self.dim)
+        )[None, : tokens.shape[1]]
+
+    def head_logits(self, x, mask):
+        m = mask.astype(jnp.float32)[..., None]
+        pooled = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+        h = self.ln_head(pooled)
+        return self.head(h.astype(self.dtype)).astype(jnp.float32)
+
     def __call__(self, tokens, mask=None, training: bool = False):
         if mask is None:
             mask = jnp.ones(tokens.shape, jnp.float32)
-        x = nn.Embed(self.vocab, self.dim, dtype=self.dtype,
-                     name="embed")(tokens)
-        x = x.astype(jnp.float32) + jnp.asarray(
-            sincos_positions(self.maxlen, self.dim)
-        )[None, : tokens.shape[1]]
-        for i in range(self.depth):
-            x = EncoderBlock(
-                dim=self.dim, heads=self.heads, causal=self.causal,
-                dtype=self.dtype, name=f"block_{i}",
-            )(x, mask, training)
-        m = mask.astype(jnp.float32)[..., None]
-        pooled = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_head")(pooled)
-        logits = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(
-            x.astype(self.dtype)
+        x = self.embed_tokens(tokens)
+        for blk in self.blocks:
+            x = blk(x, mask, training)
+        return self.head_logits(x, mask)
+
+
+def pipelined_transformer_forward(module: TransformerClassifier, params,
+                                  tokens, mask, mesh, axis: str = "pp",
+                                  microbatches: int | None = None):
+    """Transformer forward with the encoder blocks pipelined over ``axis``.
+
+    Embed and head run replicated; the ``depth`` homogeneous blocks are the
+    pipeline stages (``depth == mesh.shape[axis]`` required). Numerically
+    equal to ``module.apply`` (pinned by tests/test_pipeline_parallel.py) and
+    differentiable, so a full training step can run pipeline-parallel.
+    """
+    from distkeras_tpu.parallel.pipeline import (
+        pipeline_apply,
+        stack_stage_params,
+    )
+
+    if module.depth != mesh.shape[axis]:
+        raise ValueError(
+            f"depth {module.depth} != mesh axis '{axis}' size "
+            f"{mesh.shape[axis]}"
         )
-        return logits.astype(jnp.float32)
+    if mask is None:
+        mask = jnp.ones(tokens.shape, jnp.float32)
+    x = module.apply({"params": params}, tokens,
+                     method=TransformerClassifier.embed_tokens)
+    stage_params = stack_stage_params(
+        [params[f"blocks_{i}"] for i in range(module.depth)]
+    )
+    block = EncoderBlock(dim=module.dim, heads=module.heads,
+                         causal=module.causal, dtype=module.dtype)
+
+    def stage(p, act):
+        h, m = act
+        return block.apply({"params": p}, h, m, False), m
+
+    x, _ = pipeline_apply(stage, stage_params, (x, mask), mesh, axis=axis,
+                          microbatches=microbatches)
+    return module.apply({"params": params}, x, mask,
+                        method=TransformerClassifier.head_logits)
 
 
 def transformer_classifier(vocab=20000, maxlen=200, dim=128, heads=4, depth=2,

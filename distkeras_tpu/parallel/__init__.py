@@ -17,6 +17,11 @@ from distkeras_tpu.parallel.merge_rules import (
     get_merge_rule,
 )
 from distkeras_tpu.parallel.local_sgd import LocalSGDEngine, TrainState
+from distkeras_tpu.parallel.pipeline import (
+    pipeline_apply,
+    sequential_apply,
+    stack_stage_params,
+)
 from distkeras_tpu.parallel.sequence import attention_reference, ring_attention
 from distkeras_tpu.parallel.tensor import (
     SPMDEngine,
@@ -28,6 +33,9 @@ from distkeras_tpu.parallel.tensor import (
 __all__ = [
     "attention_reference",
     "ring_attention",
+    "pipeline_apply",
+    "sequential_apply",
+    "stack_stage_params",
     "SPMDEngine",
     "get_mesh_nd",
     "megatron_specs",

@@ -1,0 +1,153 @@
+"""Pipeline parallelism: collective GPipe over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2b.2 — "NO"), so this is
+TPU-native surplus: layer stages are placed one-per-device along a ``pp`` mesh
+axis and microbatches stream through the ring, the SPMD "collective pipelining"
+construction (Huang et al. 2019 GPipe schedule, expressed with
+``jax.lax.ppermute`` neighbor pushes instead of host RPCs).
+
+Mechanics: stage parameters carry a leading ``[S]`` axis sharded over ``pp``
+(each device holds one stage). Inside ``shard_map`` every device runs the same
+program for ``T = M + S - 1`` ticks (a differentiable ``lax.scan``): stage 0
+ingests microbatch ``t``, every device applies its stage to its current
+activation, results rotate one hop around the ring, and the last stage records
+finished microbatches. The bubble fraction is the usual ``(S-1)/T`` — amortize
+with more microbatches. Backward works by ordinary ``jax.grad`` through the
+scan: the transpose of ``ppermute`` is the reverse rotation, so XLA derives
+the reverse pipeline schedule automatically.
+
+Activations may be arbitrary pytrees (e.g. ``(hidden, mask)``) as long as
+every stage preserves their structure and shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _tree_ppermute(tree, axis_name, perm):
+    return jax.tree.map(lambda x: jax.lax.ppermute(x, axis_name, perm), tree)
+
+
+def _pipeline_shard(sparams, x_mb, *, stage_fn, axis_name, n_stages,
+                    n_micro):
+    """Per-device body: run the tick loop; returns [M, …] outputs (nonzero
+    only on the last stage, which the caller psums into a replicated result).
+    """
+    idx = jax.lax.axis_index(axis_name)
+    my_params = jax.tree.map(lambda p: p[0], sparams)  # [1,…] shard → […]
+    perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+    zero_act = jax.tree.map(lambda a: jnp.zeros_like(a[0]), x_mb)
+    outs0 = jax.tree.map(lambda a: jnp.zeros_like(a), x_mb)
+
+    def tick(carry, t):
+        state, outs = carry
+        # stage 0 ingests microbatch t (clip keeps the index static-shaped
+        # during bubble ticks; the value is unused then)
+        t_in = jnp.clip(t, 0, n_micro - 1)
+        x_t = jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, t_in, 0, keepdims=False),
+            x_mb,
+        )
+        inp = jax.tree.map(
+            lambda a, s: jnp.where(idx == 0, a, s), x_t, state
+        )
+        y = stage_fn(my_params, inp)
+        # last stage records microbatch j = t - (S-1) once it exists
+        j = t - (n_stages - 1)
+        j_cl = jnp.clip(j, 0, n_micro - 1)
+        is_last = (idx == n_stages - 1) & (j >= 0)
+
+        def record(o, yv):
+            cur = jax.lax.dynamic_index_in_dim(o, j_cl, 0, keepdims=False)
+            new = jnp.where(is_last, yv, cur)
+            return jax.lax.dynamic_update_index_in_dim(o, new, j_cl, 0)
+
+        outs = jax.tree.map(record, outs, y)
+        state = _tree_ppermute(y, axis_name, perm)
+        return (state, outs), ()
+
+    n_ticks = n_micro + n_stages - 1
+    (_, outs), _ = jax.lax.scan(
+        tick, (zero_act, outs0), jnp.arange(n_ticks)
+    )
+    # only the last stage holds real outputs; psum replicates them everywhere
+    return jax.tree.map(lambda o: jax.lax.psum(o, axis_name), outs)
+
+
+def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh,
+                   axis: str = "pp", microbatches: int | None = None):
+    """Apply ``S`` chained stages to ``x``, pipelined over mesh axis ``axis``.
+
+    - ``stage_fn(params_i, act) -> act`` — one stage; must preserve the
+      activation pytree's structure and shapes (homogeneous stages, e.g.
+      transformer encoder blocks).
+    - ``stage_params`` — pytree whose leaves have leading axis ``[S]`` with
+      ``S == mesh.shape[axis]``; placed/sharded over ``axis`` here.
+    - ``x`` — activation pytree; every leaf ``[B, …]`` with
+      ``B % microbatches == 0``. Default ``microbatches = S``.
+
+    Returns the output pytree ``[B, …]``, numerically equal to the sequential
+    ``for i in range(S): x = stage_fn(params[i], x)`` (pinned by
+    tests/test_pipeline_parallel.py), replicated over the mesh. Differentiable
+    in both ``stage_params`` and ``x``.
+    """
+    S = mesh.shape[axis]
+    M = int(microbatches) if microbatches else S
+    leaves = jax.tree.leaves(x)
+    B = leaves[0].shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    sleaves = jax.tree.leaves(stage_params)
+    if sleaves and sleaves[0].shape[0] != S:
+        raise ValueError(
+            f"stage_params leading axis {sleaves[0].shape[0]} != mesh axis "
+            f"'{axis}' size {S}"
+        )
+
+    mb = B // M
+    x_mb = jax.tree.map(
+        lambda a: a.reshape((M, mb) + a.shape[1:]), x
+    )
+
+    pspec = jax.tree.map(lambda _: P(axis), stage_params)
+    xspec = jax.tree.map(lambda _: P(), x_mb)
+    body = functools.partial(
+        _pipeline_shard, stage_fn=stage_fn, axis_name=axis, n_stages=S,
+        n_micro=M,
+    )
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, xspec),
+        out_specs=jax.tree.map(lambda _: P(), x_mb),
+        check_vma=False,
+    )
+    stage_params = jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        stage_params, pspec,
+    )
+    out_mb = fn(stage_params, x_mb)
+    return jax.tree.map(
+        lambda a: a.reshape((B,) + a.shape[2:]), out_mb
+    )
+
+
+def stack_stage_params(per_stage: list):
+    """Stack per-stage pytrees (e.g. ``params['blocks_0']…``) into the
+    leading-``[S]``-axis layout ``pipeline_apply`` consumes."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def sequential_apply(stage_fn, stage_params, x):
+    """The single-device oracle: chain the stages with a ``lax.scan``."""
+
+    def step(act, params_i):
+        return stage_fn(params_i, act), ()
+
+    out, _ = jax.lax.scan(step, x, stage_params)
+    return out

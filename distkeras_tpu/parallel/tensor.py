@@ -37,6 +37,14 @@ def get_mesh_nd(axes: dict[str, int], devices=None) -> Mesh:
     need = int(np.prod(sizes))
     if need > len(devices):
         raise ValueError(f"mesh {axes} needs {need} devices, have {len(devices)}")
+    if need < len(devices):
+        import warnings
+
+        warnings.warn(
+            f"mesh {axes} uses {need} of {len(devices)} visible devices; "
+            f"the rest stay idle",
+            stacklevel=2,
+        )
     grid = np.asarray(devices[:need]).reshape(sizes)
     return Mesh(grid, tuple(axes.keys()))
 

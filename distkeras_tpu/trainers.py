@@ -83,6 +83,19 @@ def _as_cols(features_col) -> list[str]:
     )
 
 
+def _make_loss_step(spec: ModelSpec, loss_fn: Callable, n_feat: int):
+    """Build ``loss_step(params, nt, batch)`` for a batch laid out as
+    ``(*features, label)`` — shared by all training engines."""
+
+    def loss_step(params, nt, batch):
+        feats, y = batch[:n_feat], batch[n_feat]
+        x = feats[0] if n_feat == 1 else tuple(feats)
+        out, new_nt = spec.apply(params, nt, x, training=True)
+        return loss_fn(y, out), new_nt
+
+    return loss_step
+
+
 def _as_spec(model) -> tuple[ModelSpec, Any]:
     """Accept a Keras model or a ModelSpec; return (spec, keras_model|None)."""
     if isinstance(model, ModelSpec):
@@ -115,6 +128,8 @@ class Trainer:
         self.timer = utils.Timer()
         self.trained_params_ = None
         self.trained_nt_ = None
+        self.log_metrics = False
+        self.metrics_: list[dict] = []
 
     # -- parity bookkeeping API ------------------------------------------
 
@@ -133,6 +148,22 @@ class Trainer:
     def get_averaged_loss(self, last: int = 50) -> float:
         losses = [float(l) for l in self.history.losses()[-last:]]
         return float(np.mean(losses)) if losses else float("nan")
+
+    def _epoch_metrics(self, epoch: int | None, rows: int, updates: int,
+                       elapsed: float, label: str = "epoch"):
+        """Record + optionally stream throughput (per epoch, or whole-run
+        with ``epoch=None`` for the free-running PS backend)."""
+        rec = {
+            "samples_per_sec": round(rows / elapsed, 1),
+            "updates_per_sec": round(updates / elapsed, 2),
+            "wall_time": round(elapsed, 4),
+        }
+        if epoch is not None:
+            rec = {"epoch": epoch, **rec}
+        self.metrics_.append(rec)
+        self.history.append(**rec)
+        if self.log_metrics:
+            print(json.dumps({"metric": label, **rec}), flush=True)
 
     # -- core -------------------------------------------------------------
 
@@ -230,7 +261,6 @@ class DistributedTrainer(Trainer):
         # to stdout and records the same in the history.
         self.profile_dir = profile_dir
         self.log_metrics = bool(log_metrics)
-        self.metrics_: list[dict] = []
 
     # -- seams kept from the reference ------------------------------------
 
@@ -243,16 +273,7 @@ class DistributedTrainer(Trainer):
         return resolve_optimizer(self.worker_optimizer, self.learning_rate)
 
     def _loss_step(self) -> Callable:
-        spec, loss_fn = self.spec, self.loss_fn
-        n_feat = len(self.features_col)
-
-        def loss_step(params, nt, batch):
-            feats, y = batch[:n_feat], batch[n_feat]
-            x = feats[0] if n_feat == 1 else tuple(feats)
-            out, new_nt = spec.apply(params, nt, x, training=True)
-            return loss_fn(y, out), new_nt
-
-        return loss_step
+        return _make_loss_step(self.spec, self.loss_fn, len(self.features_col))
 
     # -- training ----------------------------------------------------------
 
@@ -266,22 +287,6 @@ class DistributedTrainer(Trainer):
             if self.backend == "ps":
                 return self._train_ps(ds, shuffle)
             return self._train_collective(ds, shuffle)
-
-    def _epoch_metrics(self, epoch: int | None, rows: int, updates: int,
-                       elapsed: float, label: str = "epoch"):
-        """Record + optionally stream throughput (per epoch, or whole-run
-        with ``epoch=None`` for the free-running PS backend)."""
-        rec = {
-            "samples_per_sec": round(rows / elapsed, 1),
-            "updates_per_sec": round(updates / elapsed, 2),
-            "wall_time": round(elapsed, 4),
-        }
-        if epoch is not None:
-            rec = {"epoch": epoch, **rec}
-        self.metrics_.append(rec)
-        self.history.append(**rec)
-        if self.log_metrics:
-            print(json.dumps({"metric": label, **rec}), flush=True)
 
     def _train_collective(self, ds: Dataset, shuffle: bool):
         engine = LocalSGDEngine(
@@ -556,24 +561,15 @@ class MeshTrainer(Trainer):
         self.label_col = label_col
         self.num_epoch = int(num_epoch)
         self.log_metrics = bool(log_metrics)
-        self.metrics_: list[dict] = []
 
     def train(self, dataset, shuffle: bool = False):
         from distkeras_tpu.parallel.tensor import SPMDEngine
 
         ds = self._coerce_dataset(dataset)
         cols = self.features_col + [self.label_col]
-        n_feat = len(self.features_col)
-        spec, loss_fn = self.spec, self.loss_fn
-
-        def loss_step(params, nt, batch):
-            feats, y = batch[:n_feat], batch[n_feat]
-            x = feats[0] if n_feat == 1 else tuple(feats)
-            out, new_nt = spec.apply(params, nt, x, training=True)
-            return loss_fn(y, out), new_nt
-
         engine = SPMDEngine(
-            spec, loss_step,
+            self.spec,
+            _make_loss_step(self.spec, self.loss_fn, len(self.features_col)),
             resolve_optimizer(self.worker_optimizer, self.learning_rate),
             self.mesh, param_specs=self.param_specs,
         )
@@ -590,16 +586,10 @@ class MeshTrainer(Trainer):
                 n_steps += 1
             if self.log_metrics and n_steps:
                 jax.block_until_ready(loss)
-                elapsed = time.perf_counter() - t0
-                rec = {
-                    "epoch": epoch,
-                    "samples_per_sec": round(
-                        n_steps * self.batch_size / elapsed, 1
-                    ),
-                    "wall_time": round(elapsed, 4),
-                }
-                self.metrics_.append(rec)
-                print(json.dumps({"metric": "epoch", **rec}), flush=True)
+                self._epoch_metrics(
+                    epoch, n_steps * self.batch_size, n_steps,
+                    time.perf_counter() - t0,
+                )
         jax.block_until_ready(jax.tree.leaves(params)[0])
         self.record_training_end()
         for rec in self.history.records:

@@ -1,0 +1,173 @@
+"""Pipeline parallelism (collective GPipe) vs the sequential oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distkeras_tpu.parallel.pipeline import (
+    pipeline_apply,
+    sequential_apply,
+    stack_stage_params,
+)
+from distkeras_tpu.parallel.tensor import get_mesh_nd
+
+D = 32
+
+
+def stage_fn(p, h):
+    return h + jnp.tanh(h @ p["w"] + p["b"])
+
+
+def make_params(rng, S):
+    return {
+        "w": rng.normal(0, 0.3, size=(S, D, D)).astype(np.float32),
+        "b": rng.normal(0, 0.1, size=(S, D)).astype(np.float32),
+    }
+
+
+def test_forward_matches_sequential(rng):
+    assert len(jax.devices()) == 8
+    mesh = get_mesh_nd({"pp": 8})
+    sp = make_params(rng, 8)
+    x = rng.normal(size=(16, D)).astype(np.float32)
+    out = pipeline_apply(stage_fn, sp, x, mesh)
+    ref = sequential_apply(stage_fn, sp, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_more_microbatches_than_stages(rng):
+    mesh = get_mesh_nd({"pp": 4})
+    sp = make_params(rng, 4)
+    x = rng.normal(size=(24, D)).astype(np.float32)
+    out = pipeline_apply(stage_fn, sp, x, mesh, microbatches=8)
+    ref = sequential_apply(stage_fn, sp, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pytree_activations(rng):
+    """Stages may carry auxiliary state (e.g. a mask) through the ring."""
+    mesh = get_mesh_nd({"pp": 4})
+
+    def masked_stage(p, act):
+        h, m = act
+        return h + jnp.tanh(h @ p["w"] + p["b"]) * m, m
+
+    sp = make_params(rng, 4)
+    x = rng.normal(size=(8, D)).astype(np.float32)
+    m = (rng.random((8, D)) > 0.5).astype(np.float32)
+    out_h, out_m = pipeline_apply(masked_stage, sp, (x, m), mesh)
+    ref_h, ref_m = sequential_apply(masked_stage, sp, (x, m))
+    np.testing.assert_allclose(np.asarray(out_h), np.asarray(ref_h),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out_m), m)
+
+
+def test_gradients_match_sequential(rng):
+    """Backward through the pipeline == backward through the chain."""
+    mesh = get_mesh_nd({"pp": 8})
+    sp = make_params(rng, 8)
+    x = rng.normal(size=(16, D)).astype(np.float32)
+
+    def pipe_loss(sp, x):
+        return jnp.sum(pipeline_apply(stage_fn, sp, x, mesh) ** 2)
+
+    def seq_loss(sp, x):
+        return jnp.sum(sequential_apply(stage_fn, sp, x) ** 2)
+
+    gp, gx = jax.grad(pipe_loss, argnums=(0, 1))(sp, x)
+    rp, rx = jax.grad(seq_loss, argnums=(0, 1))(sp, x)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               rtol=1e-4, atol=1e-4)
+    for g, r in zip(jax.tree.leaves(gp), jax.tree.leaves(rp)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_training_through_pipeline_learns(rng):
+    """A pipelined 4-stage net + linear head trains end-to-end."""
+    mesh = get_mesh_nd({"pp": 4})
+    sp = make_params(rng, 4)
+    head = rng.normal(0, 0.3, size=(D, 2)).astype(np.float32)
+    params = {"stages": sp, "head": head}
+    x = rng.normal(size=(32, D)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+
+    def loss_fn(params, x, y):
+        h = pipeline_apply(stage_fn, params["stages"], x, mesh)
+        logits = h @ params["head"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    tx = optax.adam(5e-2)
+    opt = tx.init(params)
+    losses = []
+    for _ in range(30):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt = tx.update(grads, opt, params)
+        params = optax.apply_updates(params, updates)
+        losses.append(float(loss))
+    assert losses[-1] < 0.3 * losses[0]
+
+
+def test_stack_stage_params_roundtrip(rng):
+    per_stage = [
+        {"w": rng.normal(size=(D, D)).astype(np.float32),
+         "b": rng.normal(size=(D,)).astype(np.float32)}
+        for _ in range(4)
+    ]
+    stacked = stack_stage_params(per_stage)
+    assert stacked["w"].shape == (4, D, D)
+    np.testing.assert_allclose(np.asarray(stacked["b"][2]), per_stage[2]["b"])
+
+
+def test_pipelined_transformer_matches_plain_forward(rng):
+    """The full model family composition: encoder blocks over 'pp'."""
+    from distkeras_tpu.models import transformer_classifier
+    from distkeras_tpu.models.transformer import (
+        TransformerClassifier,
+        pipelined_transformer_forward,
+    )
+
+    mesh = get_mesh_nd({"pp": 4})
+    spec = transformer_classifier(
+        vocab=64, maxlen=16, dim=32, heads=4, depth=4, num_classes=4,
+        dtype=jnp.float32,
+    )
+    params, _ = spec.init_np(0)
+    module = TransformerClassifier(
+        vocab=64, maxlen=16, dim=32, heads=4, depth=4, num_classes=4,
+        dtype=jnp.float32,
+    )
+    toks = rng.integers(0, 64, size=(8, 16)).astype(np.int32)
+    mask = np.ones((8, 16), np.float32)
+    mask[:, 12:] = 0.0
+
+    ref = module.apply({"params": params}, toks, mask, False)
+    out = pipelined_transformer_forward(module, params, toks, mask, mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+    # and it trains: grads flow through the pipelined forward
+    def loss(params):
+        logits = pipelined_transformer_forward(
+            module, params, toks, mask, mesh
+        )
+        return jnp.mean(logits ** 2)
+
+    g = jax.grad(loss)(params)
+    gnorm = sum(float(jnp.sum(x ** 2)) for x in jax.tree.leaves(g))
+    assert np.isfinite(gnorm) and gnorm > 0
+
+
+def test_validation_errors(rng):
+    mesh = get_mesh_nd({"pp": 4})
+    sp = make_params(rng, 4)
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_apply(stage_fn, sp, np.zeros((10, D), np.float32), mesh)
+    with pytest.raises(ValueError, match="leading axis"):
+        pipeline_apply(stage_fn, make_params(rng, 3),
+                       np.zeros((8, D), np.float32), mesh)

@@ -52,7 +52,7 @@ def test_megatron_specs_layout():
     spec = small_spec()
     params, _ = spec.init_np(0)
     specs = megatron_specs(params)
-    blk = specs["block_0"]
+    blk = specs["blocks_0"]
     assert blk["qkv"]["kernel"] == P(None, "tp")
     assert blk["qkv"]["bias"] == P("tp")
     assert blk["mlp_up"]["kernel"] == P(None, "tp")
@@ -127,7 +127,7 @@ def test_params_actually_distributed(rng):
     params, nt = spec.init_np(0)
     engine = SPMDEngine(spec, loss_step(spec), optax.sgd(0.01), mesh)
     p, nt, opt = engine.init_state(params, nt)
-    kern = p["block_0"]["qkv"]["kernel"]
+    kern = p["blocks_0"]["qkv"]["kernel"]
     # each device holds a [DIM, 3*DIM/4] slice
     shard_shapes = {s.data.shape for s in kern.addressable_shards}
     assert shard_shapes == {(DIM, 3 * DIM // 4)}
