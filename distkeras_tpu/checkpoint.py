@@ -10,6 +10,13 @@ Format: one file per checkpoint — ``utils.serialize_weights`` blob (npz +
 treedef) written to a temp name and atomically renamed, plus a small JSON
 sidecar index. No external checkpoint service needed; works on any POSIX
 filesystem (GCS-fuse on pods).
+
+Compatibility note: checkpoints key params by flax module/layer names, so
+they are tied to the model code that wrote them. In particular the
+transformer family's param keys changed when it gained tensor/pipeline
+parallelism (``EncoderBlock_i/Dense_j`` → ``blocks_i/qkv|attn_out|mlp_up|
+mlp_down``); transformer checkpoints written before that rename cannot be
+resumed by current code.
 """
 
 from __future__ import annotations

@@ -21,6 +21,10 @@ exact.
 from distkeras_tpu.models.mlp import MLP, mlp
 from distkeras_tpu.models.cnn import LeNet, VGGSmall, lenet, vgg_small
 from distkeras_tpu.models.lstm import LSTMClassifier, lstm_classifier
+from distkeras_tpu.models.moe import (
+    MoETransformerClassifier,
+    moe_transformer_classifier,
+)
 from distkeras_tpu.models.transformer import (
     TransformerClassifier,
     pipelined_transformer_forward,
@@ -34,4 +38,5 @@ __all__ = [
     "LSTMClassifier", "lstm_classifier",
     "TransformerClassifier", "transformer_classifier",
     "pipelined_transformer_forward",
+    "MoETransformerClassifier", "moe_transformer_classifier",
 ]

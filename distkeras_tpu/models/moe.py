@@ -1,0 +1,156 @@
+"""Mixture-of-experts transformer — the expert-parallel model family.
+
+Beyond-reference (the reference's parallelism is PS data-parallel only,
+SURVEY.md §2b.2): encoder blocks whose feed-forward is the GShard-style MoE
+layer from :mod:`distkeras_tpu.parallel.expert`. With ``mesh=None`` the block
+runs the single-device oracle math; handing it a mesh with an ``ep`` axis
+runs the identical computation expert-parallel (tokens and experts exchanged
+with ``all_to_all`` over ICI) — same values, different placement, pinned by
+tests/test_expert_parallel.py / tests/test_models.py.
+
+The gating auxiliary (load-balancing) loss is sown into the ``moe_aux``
+collection; pass ``mutable=["moe_aux"]`` (or use
+:func:`moe_aux_loss`) to read it for the training objective.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.model import ModelSpec, from_flax
+from distkeras_tpu.models.transformer import (
+    attention_sublayer,
+    sincos_positions,
+)
+from distkeras_tpu.parallel.expert import moe_mlp, moe_mlp_reference
+
+
+class MoEEncoderBlock(nn.Module):
+    dim: int
+    heads: int
+    num_experts: int = 8
+    top_k: int = 2
+    mlp_ratio: int = 4
+    capacity_factor: float = 2.0
+    causal: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+    mesh: object = None          # jax Mesh with an 'ep' axis, or None
+    ep_axis: str = "ep"
+
+    @nn.compact
+    def __call__(self, x, mask=None, training: bool = False):
+        B, L, _ = x.shape
+        x = attention_sublayer(x, mask, dim=self.dim, heads=self.heads,
+                               causal=self.causal, dtype=self.dtype)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_moe")(x)
+        E, D, Hd = self.num_experts, self.dim, self.mlp_ratio * self.dim
+        init = nn.initializers.normal(0.02)
+        zeros = nn.initializers.zeros
+        params = {
+            "gate": self.param("gate", init, (D, E)),
+            "w1": self.param("w1", init, (E, D, Hd)),
+            "b1": self.param("b1", zeros, (E, Hd)),
+            "w2": self.param("w2", init, (E, Hd, D)),
+            "b2": self.param("b2", zeros, (E, D)),
+        }
+        tokens = h.reshape(B * L, D).astype(jnp.float32)
+        if self.mesh is not None:
+            y, aux = moe_mlp(
+                params, tokens, self.mesh, axis=self.ep_axis,
+                top_k=self.top_k, capacity_factor=self.capacity_factor,
+            )
+        else:
+            y, aux = moe_mlp_reference(
+                params, tokens, top_k=self.top_k,
+                capacity_factor=self.capacity_factor,
+            )
+        self.sow("moe_aux", "aux", aux)
+        return x + y.reshape(B, L, D)
+
+
+class MoETransformerClassifier(nn.Module):
+    """Token sequence → class logits with MoE feed-forwards."""
+
+    vocab: int = 20000
+    maxlen: int = 200
+    dim: int = 128
+    heads: int = 4
+    depth: int = 2
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    num_classes: int = 2
+    causal: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+    mesh: object = None
+    ep_axis: str = "ep"
+
+    @nn.compact
+    def __call__(self, tokens, mask=None, training: bool = False):
+        if mask is None:
+            mask = jnp.ones(tokens.shape, jnp.float32)
+        x = nn.Embed(self.vocab, self.dim, dtype=self.dtype,
+                     name="embed")(tokens)
+        x = x.astype(jnp.float32) + jnp.asarray(
+            sincos_positions(self.maxlen, self.dim)
+        )[None, : tokens.shape[1]]
+        for i in range(self.depth):
+            x = MoEEncoderBlock(
+                dim=self.dim, heads=self.heads,
+                num_experts=self.num_experts, top_k=self.top_k,
+                capacity_factor=self.capacity_factor, causal=self.causal,
+                dtype=self.dtype, mesh=self.mesh, ep_axis=self.ep_axis,
+                name=f"block_{i}",
+            )(x, mask, training)
+        m = mask.astype(jnp.float32)[..., None]
+        pooled = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_head")(pooled)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(
+            x.astype(self.dtype)
+        )
+        return logits.astype(jnp.float32)
+
+
+def moe_aux_loss(module: nn.Module, params, inputs, training: bool = True):
+    """Run the model collecting the gating auxiliary loss.
+
+    Returns ``(logits, aux)`` where ``aux`` is the mean of the per-block
+    load-balancing losses — add ``aux_weight * aux`` to the objective.
+    """
+    out, state = module.apply(
+        {"params": params}, *inputs, training=training, mutable=["moe_aux"]
+    )
+    leaves = jnp.stack(
+        [jnp.asarray(v) for v in _collect(state["moe_aux"])]
+    )
+    return out, jnp.mean(leaves)
+
+
+def _collect(tree):
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _collect(v)
+    elif isinstance(tree, (tuple, list)):
+        for v in tree:
+            yield from _collect(v)
+    else:
+        yield tree
+
+
+def moe_transformer_classifier(vocab=20000, maxlen=200, dim=128, heads=4,
+                               depth=2, num_experts=8, top_k=2,
+                               capacity_factor=2.0, num_classes=2,
+                               causal=False, dtype=jnp.bfloat16,
+                               mesh=None, ep_axis="ep") -> ModelSpec:
+    module = MoETransformerClassifier(
+        vocab=vocab, maxlen=maxlen, dim=dim, heads=heads, depth=depth,
+        num_experts=num_experts, top_k=top_k,
+        capacity_factor=capacity_factor, num_classes=num_classes,
+        causal=causal, dtype=dtype, mesh=mesh, ep_axis=ep_axis,
+    )
+    example = (
+        jnp.zeros((1, maxlen), jnp.int32),
+        jnp.ones((1, maxlen), jnp.float32),
+    )
+    return from_flax(module, example, name="moe_transformer_classifier")

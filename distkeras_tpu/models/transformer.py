@@ -30,6 +30,26 @@ def sincos_positions(maxlen: int, dim: int) -> np.ndarray:
     return table
 
 
+def attention_sublayer(x, mask, *, dim, heads, causal, dtype):
+    """Pre-norm self-attention + residual, shared by the dense and MoE
+    encoder blocks (must be called from a compact ``__call__``).
+
+    Layer names are load-bearing: parallel.tensor.megatron_specs shards
+    qkv/mlp_up column-wise and attn_out/mlp_down row-wise over 'tp'.
+    """
+    B, L, _ = x.shape
+    h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+    qkv = nn.Dense(3 * dim, dtype=dtype, name="qkv")(h.astype(dtype))
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (B, L, heads, dim // heads)
+    q, k, v = (t.reshape(shape) for t in (q, k, v))
+    att = attention_reference(q, k, v, causal=causal, key_mask=mask)
+    att = att.reshape(B, L, dim)
+    return x + nn.Dense(dim, dtype=dtype, name="attn_out")(
+        att.astype(dtype)
+    ).astype(jnp.float32)
+
+
 class EncoderBlock(nn.Module):
     dim: int
     heads: int
@@ -39,22 +59,8 @@ class EncoderBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, training: bool = False):
-        B, L, _ = x.shape
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
-        # Layer names are load-bearing: parallel.tensor.megatron_specs shards
-        # qkv/mlp_up column-wise and attn_out/mlp_down row-wise over 'tp'.
-        qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(
-            h.astype(self.dtype)
-        )
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (B, L, self.heads, self.dim // self.heads)
-        q, k, v = (t.reshape(shape) for t in (q, k, v))
-        att = attention_reference(q, k, v, causal=self.causal, key_mask=mask)
-        att = att.reshape(B, L, self.dim)
-        x = x + nn.Dense(self.dim, dtype=self.dtype, name="attn_out")(
-            att.astype(self.dtype)
-        ).astype(jnp.float32)
-
+        x = attention_sublayer(x, mask, dim=self.dim, heads=self.heads,
+                               causal=self.causal, dtype=self.dtype)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
         h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype,
                      name="mlp_up")(h.astype(self.dtype))

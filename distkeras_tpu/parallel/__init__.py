@@ -17,6 +17,11 @@ from distkeras_tpu.parallel.merge_rules import (
     get_merge_rule,
 )
 from distkeras_tpu.parallel.local_sgd import LocalSGDEngine, TrainState
+from distkeras_tpu.parallel.expert import (
+    init_moe_params,
+    moe_mlp,
+    moe_mlp_reference,
+)
 from distkeras_tpu.parallel.pipeline import (
     pipeline_apply,
     sequential_apply,
@@ -36,6 +41,9 @@ __all__ = [
     "pipeline_apply",
     "sequential_apply",
     "stack_stage_params",
+    "init_moe_params",
+    "moe_mlp",
+    "moe_mlp_reference",
     "SPMDEngine",
     "get_mesh_nd",
     "megatron_specs",
