@@ -182,13 +182,26 @@ def run_all_configs(accel):
         "mnist_mlp_single_cpu", sps, mlp_flops((784, 500, 300, 10)), None)
 
     # -- config 2: MNIST LeNet CNN, ADAG (the north-star) -------------------
-    log(f"[config 2] MNIST-CNN / ADAG on {accel.platform}")
+    # Two legs: batch 256 (matched to the CPU proxy for the vs_baseline
+    # ratio) and batch 1024 (the throughput-optimal config — a batch-1024
+    # CPU proxy is impractical: its warm epoch alone takes ~45 min on this
+    # single-process host, measured once for SCALING.md).
+    log(f"[config 2] MNIST-CNN / ADAG on {accel.platform} (ratio leg, b256)")
     train, _ = mnist(n_train=cfg(65536, 768), n_test=64)
     sps = measure(accel, lenet(dtype=dt), ADAGMerge(), optax.adam(1e-3),
                   train, ["features", "label"], batch_size=cfg(256, 64),
                   window=cfg(8, 3), epochs_timed=cfg(3, 1))
     results["adag_mnist_cnn"] = emit(
-        "adag_mnist_cnn", sps, lenet_flops(), peak)
+        "adag_mnist_cnn", sps, lenet_flops(), peak,
+        extra={"batch_size": cfg(256, 64)})
+    if on_tpu:
+        log("[config 2] MNIST-CNN / ADAG peak leg (b1024)")
+        sps = measure(accel, lenet(dtype=dt), ADAGMerge(), optax.adam(1e-3),
+                      train, ["features", "label"], batch_size=1024,
+                      window=8, epochs_timed=3)
+        results["adag_mnist_cnn_peak"] = emit(
+            "adag_mnist_cnn_peak", sps, lenet_flops(), peak,
+            extra={"batch_size": 1024})
 
     # -- config 3: CIFAR-10 VGG-small, DOWNPOUR -----------------------------
     log(f"[config 3] CIFAR10-VGG / DOWNPOUR on {accel.platform}")
@@ -355,7 +368,11 @@ def main():
     if args.scaling:
         run_scaling(accel)
 
-    north = results["adag_mnist_cnn"]
+    # headline value: the throughput-optimal leg when measured, else the
+    # ratio leg; vs_baseline always compares matched configs (b256 both
+    # sides — see the config-2 comment in run_all_configs)
+    north = results.get("adag_mnist_cnn_peak", results["adag_mnist_cnn"])
+    ratio_leg = results["adag_mnist_cnn"]
 
     # CPU-proxy denominator for the north-star ratio: SAME batch/window
     # (ADVICE.md), one superbatch per epoch, 3 timed epochs post-warmup.
@@ -372,7 +389,7 @@ def main():
                 cpu, lenet(dtype=jnp.float32), ADAGMerge(), optax.adam(1e-3),
                 train, ["features", "label"], batch_size=256, window=8,
             )
-            vs = north["samples_per_sec"] / baseline
+            vs = ratio_leg["samples_per_sec"] / baseline
         except Exception as e:  # CPU backend unavailable — omit the ratio
             log(f"cpu proxy failed: {e}")
 
@@ -380,9 +397,13 @@ def main():
         "metric": "adag_mnist_cnn_samples_per_sec",
         "value": north["samples_per_sec"],
         "unit": "samples/sec",
+        "batch_size": north.get("batch_size"),
     }
     if vs is not None:
+        # matched-config ratio: TPU b256/w8 over CPU b256/w8 (see above)
         line["vs_baseline"] = round(vs, 2)
+        if north is not ratio_leg:
+            line["vs_baseline_config"] = "b256_w8_both_sides"
     if "mfu" in north:
         line["mfu"] = north["mfu"]
     if tta is not None and tta["reached_target"]:

@@ -30,12 +30,15 @@ def sincos_positions(maxlen: int, dim: int) -> np.ndarray:
     return table
 
 
-def attention_sublayer(x, mask, *, dim, heads, causal, dtype):
+def attention_sublayer(x, mask, *, dim, heads, causal, dtype,
+                       attn_impl: str = "reference"):
     """Pre-norm self-attention + residual, shared by the dense and MoE
     encoder blocks (must be called from a compact ``__call__``).
 
     Layer names are load-bearing: parallel.tensor.megatron_specs shards
     qkv/mlp_up column-wise and attn_out/mlp_down row-wise over 'tp'.
+    ``attn_impl``: "reference" (XLA einsums), "flash" (the Pallas kernel in
+    ops.flash_attention), or "auto" (kernel when shapes are tile-friendly).
     """
     B, L, _ = x.shape
     h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
@@ -43,7 +46,13 @@ def attention_sublayer(x, mask, *, dim, heads, causal, dtype):
     q, k, v = jnp.split(qkv, 3, axis=-1)
     shape = (B, L, heads, dim // heads)
     q, k, v = (t.reshape(shape) for t in (q, k, v))
-    att = attention_reference(q, k, v, causal=causal, key_mask=mask)
+    if attn_impl == "reference":
+        att = attention_reference(q, k, v, causal=causal, key_mask=mask)
+    else:
+        from distkeras_tpu.ops.flash_attention import attention
+
+        att = attention(q, k, v, causal=causal, key_mask=mask,
+                        impl=attn_impl)
     att = att.reshape(B, L, dim)
     return x + nn.Dense(dim, dtype=dtype, name="attn_out")(
         att.astype(dtype)
@@ -56,11 +65,13 @@ class EncoderBlock(nn.Module):
     mlp_ratio: int = 4
     causal: bool = False
     dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "reference"
 
     @nn.compact
     def __call__(self, x, mask=None, training: bool = False):
         x = attention_sublayer(x, mask, dim=self.dim, heads=self.heads,
-                               causal=self.causal, dtype=self.dtype)
+                               causal=self.causal, dtype=self.dtype,
+                               attn_impl=self.attn_impl)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
         h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype,
                      name="mlp_up")(h.astype(self.dtype))
@@ -88,12 +99,13 @@ class TransformerClassifier(nn.Module):
     num_classes: int = 2
     causal: bool = False
     dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "reference"
 
     def setup(self):
         self.embed = nn.Embed(self.vocab, self.dim, dtype=self.dtype)
         self.blocks = [
             EncoderBlock(dim=self.dim, heads=self.heads, causal=self.causal,
-                         dtype=self.dtype)
+                         dtype=self.dtype, attn_impl=self.attn_impl)
             for _ in range(self.depth)
         ]
         self.ln_head = nn.LayerNorm(dtype=jnp.float32)
@@ -162,10 +174,12 @@ def pipelined_transformer_forward(module: TransformerClassifier, params,
 
 def transformer_classifier(vocab=20000, maxlen=200, dim=128, heads=4, depth=2,
                            num_classes=2, causal=False,
-                           dtype=jnp.bfloat16) -> ModelSpec:
+                           dtype=jnp.bfloat16,
+                           attn_impl="reference") -> ModelSpec:
     module = TransformerClassifier(
         vocab=vocab, maxlen=maxlen, dim=dim, heads=heads, depth=depth,
         num_classes=num_classes, causal=causal, dtype=dtype,
+        attn_impl=attn_impl,
     )
     example = (
         jnp.zeros((1, maxlen), jnp.int32),

@@ -1,0 +1,274 @@
+"""Flash attention — a Pallas TPU kernel for the transformer hot path.
+
+The reference has no attention at all (its newest model was an LSTM —
+SURVEY.md §5.7), so this is TPU-native surplus: the memory-bound softmax
+attention of the transformer/MoE families as a streaming online-softmax
+kernel (Dao et al. 2022 construction, TPU grid edition).
+
+Forward: grid ``(batch·head, q-blocks, k-blocks)`` with the k axis innermost.
+Each step multiplies one ``[block_q, D]`` query tile against one
+``[block_k, D]`` key/value tile on the MXU (f32 accumulation over bf16
+inputs) and folds the result into VMEM scratch accumulators ``(m, l, acc)``
+via the numerically stable online softmax; the last k step normalizes and
+writes the output tile. Peak on-chip memory is ``O(block_q · block_k)`` —
+independent of sequence length — where XLA's fused attention materializes
+the full ``O(L²)`` score tensor per head in HBM (it OOMs at L=16k on a v5e
+where this kernel keeps running). The kernel also emits per-row log-sum-exp,
+which makes the backward pass a textbook recompute: ``p = exp(qk − lse)``,
+no saved probabilities. Backward runs as plain XLA einsums (full-score
+recompute); forward-pass memory is where the win is.
+
+On TPU the kernel compiles natively; elsewhere (the 8-device CPU mesh in CI)
+it runs in Pallas interpret mode, so the SAME code path is oracle-tested
+everywhere (tests/test_flash_attention.py pins it against
+``parallel.sequence.attention_reference``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e9  # matches parallel.sequence: finite mask keeps softmax NaN-free
+
+BLOCK_Q = 128   # q rows per grid step
+BLOCK_K = 512   # k/v rows per inner grid step
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc, *,
+               scale, causal, block_q, block_k, km_ref=None):
+    """One (bh, iq, jk) step: fold a [bq, bk] score tile into the online
+    softmax state; finalize on this q block's last contributing k step."""
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(jk == 0)
+    def _():
+        m_s[:] = jnp.full_like(m_s, _NEG)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc[:] = jnp.zeros_like(acc)
+
+    # under causal masking, k tiles entirely above the diagonal contribute
+    # nothing — skip their MXU work (≈2× at long causal context) and
+    # finalize at the last tile that can contribute
+    if causal:
+        last_k = jnp.minimum(nk - 1, (iq * block_q + block_q - 1) // block_k)
+    else:
+        last_k = nk - 1
+
+    @pl.when(jk <= last_k)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale        # [bq, D]
+        k = k_ref[0].astype(jnp.float32)                # [bk, D]
+        v = v_ref[0].astype(jnp.float32)                # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                # [bq, bk]
+        valid = None
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = jk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            valid = q_pos >= k_pos
+        if km_ref is not None:
+            km = km_ref[0].astype(jnp.float32) > 0.5     # [1, bk]
+            km = jnp.broadcast_to(km, s.shape)
+            valid = km if valid is None else (valid & km)
+        if valid is not None:
+            s = jnp.where(valid, s, _NEG)
+
+        m_prev = m_s[:]                                  # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)                   # [bq, 1]
+        l_s[:] = l_s[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_s[:] = m_new
+
+    @pl.when(jk == last_k)
+    def _():
+        l = jnp.maximum(l_s[:], 1e-30)
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_s[:] + jnp.log(l)
+
+
+def _interpret_default():
+    return jax.default_backend() != "tpu"
+
+
+def _fa_forward(q, k, v, key_mask, *, scale, causal, interpret):
+    """q/k/v [B, L, H, D] (+ key_mask [B, L]) → (out [B, L, H, D], lse)."""
+    B, L, H, D = q.shape
+    bq = min(BLOCK_Q, L)
+    # largest tile-aligned k block that divides L
+    bk = L if L < BLOCK_Q else next(
+        (c for c in (BLOCK_K, 384, 256, 128) if L % c == 0), 0
+    )
+    if not bk or L % bq:
+        raise ValueError(
+            f"sequence length {L} must be a multiple of {BLOCK_Q}"
+        )
+
+    def bh(x):  # [B, L, H, D] → [B·H, L, D]
+        return jnp.moveaxis(x, 2, 1).reshape(B * H, L, D)
+
+    grid = (B * H, L // bq, L // bk)
+    qspec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
+    kvspec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
+    ospec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
+    # lse carries a trailing singleton so its block obeys the (8, 128)
+    # tile rule (last dim equal to the array dim is allowed)
+    lspec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
+    out_shape = [
+        jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
+        jax.ShapeDtypeStruct((B * H, L, 1), jnp.float32),
+    ]
+    scratch = [
+        pltpu.VMEM((bq, 1), jnp.float32),   # running max m
+        pltpu.VMEM((bq, 1), jnp.float32),   # running denom l
+        pltpu.VMEM((bq, D), jnp.float32),   # running numerator acc
+    ]
+    in_specs = [qspec, kvspec, kvspec]
+    args = [bh(q), bh(k), bh(v)]
+    if key_mask is None:
+        kernel = functools.partial(
+            _fa_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        )
+    else:
+        H_ = H
+        # mask ships as [B, 1, L] so its block obeys the (8, 128) tile rule
+        in_specs.append(
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // H_, 0, j))
+        )
+        args.append(key_mask.astype(jnp.float32)[:, None, :])
+
+        def kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
+                   m_s, l_s, acc):
+            _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc,
+                       scale=scale, causal=causal, block_q=bq, block_k=bk,
+                       km_ref=km_ref)
+
+    o, lse = pl.pallas_call(
+        kernel, grid=grid,
+        in_specs=in_specs,
+        out_specs=[ospec, lspec],
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*args)
+    out = jnp.moveaxis(o.reshape(B, H, L, D), 1, 2)
+    return out, lse[..., 0]
+
+
+def _attention_bwd_math(q, k, v, key_mask, lse, g, *, scale, causal):
+    """Recompute-based backward (plain XLA): p from saved lse, then the
+    standard flash-attention gradient identities."""
+    B, L, H, D = q.shape
+    qf = q.astype(jnp.float32) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+    valid = None
+    if causal:
+        tri = jnp.tril(jnp.ones((L, L), bool))
+        valid = jnp.broadcast_to(tri[None, None], s.shape)
+    if key_mask is not None:
+        km = key_mask.astype(bool)[:, None, None, :]
+        valid = km if valid is None else (valid & km)
+    if valid is not None:
+        s = jnp.where(valid, s, _NEG)
+    lse_b = lse.reshape(B, H, L)                       # [B, H, L]
+    p = jnp.exp(s - lse_b[..., None])
+    if valid is not None:
+        p = jnp.where(valid, p, 0.0)
+    gf = g.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vf)
+    # d(softmax): ds = p * (dp - rowsum(dp * p))
+    row = jnp.sum(dp * p, axis=-1, keepdims=True)
+    ds = p * (dp - row)
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32)) * scale
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_core(q, k, v, key_mask, causal, scale, interpret):
+    out, _ = _fa_forward(
+        q, k, v, key_mask, scale=scale, causal=causal, interpret=interpret
+    )
+    return out
+
+
+def _fa_fwd(q, k, v, key_mask, causal, scale, interpret):
+    out, lse = _fa_forward(
+        q, k, v, key_mask, scale=scale, causal=causal, interpret=interpret
+    )
+    return out, (q, k, v, key_mask, lse)
+
+
+def _fa_bwd(causal, scale, interpret, res, g):
+    q, k, v, key_mask, lse = res
+    dq, dk, dv = _attention_bwd_math(
+        q, k, v, key_mask, lse, g, scale=scale, causal=causal
+    )
+    dmask = None if key_mask is None else jnp.zeros_like(key_mask)
+    return dq, dk, dv, dmask
+
+
+_flash_core.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale=None, key_mask=None,
+                    interpret: bool | None = None):
+    """Pallas flash attention; same contract as ``attention_reference``.
+
+    ``q/k/v`` [B, L, H, D] → [B, L, H, D]; optional ``key_mask`` [B, L]
+    (1 = attend). Gradients flow to q/k/v (the mask gets zero cotangent, as
+    with the hard mask in the reference).
+    """
+    return _flash_core(
+        q, k, v, key_mask, bool(causal),
+        float(scale if scale is not None else q.shape[-1] ** -0.5),
+        _interpret_default() if interpret is None else bool(interpret),
+    )
+
+
+def attention(q, k, v, causal: bool = False, scale=None, key_mask=None,
+              impl: str = "auto"):
+    """Dispatch between the Pallas kernel and the XLA reference.
+
+    ``impl``: ``"flash"`` forces the kernel (requires ``L % 128 == 0``),
+    ``"reference"`` the XLA path, ``"auto"`` uses the kernel only when
+    running natively on TPU AND the shapes are tile-friendly — interpret
+    mode off-TPU is for testing, not speed. ``key_mask`` is treated as a
+    static-presence argument (its values are traced, its presence is not).
+    """
+    from distkeras_tpu.parallel.sequence import attention_reference
+
+    if impl not in ("flash", "reference", "auto"):
+        raise ValueError(
+            f"unknown attention impl {impl!r}; use 'flash', 'reference', "
+            f"or 'auto'"
+        )
+    L = q.shape[1]
+    if impl == "reference" or (
+        impl == "auto"
+        and (L % BLOCK_Q or jax.default_backend() != "tpu")
+    ):
+        return attention_reference(q, k, v, causal=causal, scale=scale,
+                                   key_mask=key_mask)
+    return flash_attention(q, k, v, causal, scale, key_mask)

@@ -1,0 +1,24 @@
+"""The runnable examples must actually run (the reference's de-facto test
+strategy was examples-as-integration-tests — SURVEY.md §4)."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_parallelism_example_runs_all_strategies():
+    env = dict(os.environ)
+    # force the virtual CPU mesh even if a TPU plugin is importable
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "parallelism.py")],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for tag in ("[dp]", "[tp]", "[pp]", "[sp]", "[ep]"):
+        assert tag in proc.stdout, (tag, proc.stdout)
