@@ -12,6 +12,7 @@ matmuls on the MXU; all control flow is static for XLA.
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -31,14 +32,18 @@ def sincos_positions(maxlen: int, dim: int) -> np.ndarray:
 
 
 def attention_sublayer(x, mask, *, dim, heads, causal, dtype,
-                       attn_impl: str = "reference"):
+                       attn_impl: str = "reference",
+                       sp_axis: str | None = None, sp_size: int | None = None):
     """Pre-norm self-attention + residual, shared by the dense and MoE
     encoder blocks (must be called from a compact ``__call__``).
 
     Layer names are load-bearing: parallel.tensor.megatron_specs shards
     qkv/mlp_up column-wise and attn_out/mlp_down row-wise over 'tp'.
     ``attn_impl``: "reference" (XLA einsums), "flash" (the Pallas kernel in
-    ops.flash_attention), or "auto" (kernel when shapes are tile-friendly).
+    ops.flash_attention), "auto" (kernel when shapes are tile-friendly), or
+    "ring" (sequence-parallel ring attention — only valid when the caller is
+    already inside ``shard_map`` over mesh axis ``sp_axis`` of size
+    ``sp_size``, with ``x``/``mask`` holding this shard's sequence slice).
     """
     B, L, _ = x.shape
     h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
@@ -46,7 +51,16 @@ def attention_sublayer(x, mask, *, dim, heads, causal, dtype,
     q, k, v = jnp.split(qkv, 3, axis=-1)
     shape = (B, L, heads, dim // heads)
     q, k, v = (t.reshape(shape) for t in (q, k, v))
-    if attn_impl == "reference":
+    if attn_impl == "ring":
+        from distkeras_tpu.parallel.sequence import ring_attention_shard
+
+        att = ring_attention_shard(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), mask,
+            axis_name=sp_axis, axis_size=sp_size, causal=causal,
+            scale=(dim // heads) ** -0.5,
+        )
+    elif attn_impl == "reference":
         att = attention_reference(q, k, v, causal=causal, key_mask=mask)
     else:
         from distkeras_tpu.ops.flash_attention import attention
@@ -66,12 +80,15 @@ class EncoderBlock(nn.Module):
     causal: bool = False
     dtype: jnp.dtype = jnp.bfloat16
     attn_impl: str = "reference"
+    sp_axis: str | None = None   # set (with sp_size) for attn_impl="ring"
+    sp_size: int | None = None
 
     @nn.compact
     def __call__(self, x, mask=None, training: bool = False):
         x = attention_sublayer(x, mask, dim=self.dim, heads=self.heads,
                                causal=self.causal, dtype=self.dtype,
-                               attn_impl=self.attn_impl)
+                               attn_impl=self.attn_impl,
+                               sp_axis=self.sp_axis, sp_size=self.sp_size)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
         h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype,
                      name="mlp_up")(h.astype(self.dtype))
@@ -100,12 +117,15 @@ class TransformerClassifier(nn.Module):
     causal: bool = False
     dtype: jnp.dtype = jnp.bfloat16
     attn_impl: str = "reference"
+    sp_axis: str | None = None   # set (with sp_size) for attn_impl="ring"
+    sp_size: int | None = None
 
     def setup(self):
         self.embed = nn.Embed(self.vocab, self.dim, dtype=self.dtype)
         self.blocks = [
             EncoderBlock(dim=self.dim, heads=self.heads, causal=self.causal,
-                         dtype=self.dtype, attn_impl=self.attn_impl)
+                         dtype=self.dtype, attn_impl=self.attn_impl,
+                         sp_axis=self.sp_axis, sp_size=self.sp_size)
             for _ in range(self.depth)
         ]
         self.ln_head = nn.LayerNorm(dtype=jnp.float32)
@@ -113,13 +133,26 @@ class TransformerClassifier(nn.Module):
 
     def embed_tokens(self, tokens):
         x = self.embed(tokens)
-        return x.astype(jnp.float32) + jnp.asarray(
-            sincos_positions(self.maxlen, self.dim)
-        )[None, : tokens.shape[1]]
+        table = jnp.asarray(sincos_positions(self.maxlen, self.dim))
+        if self.sp_axis is not None:
+            # this shard holds sequence positions [off, off + L_local)
+            off = jax.lax.axis_index(self.sp_axis) * tokens.shape[1]
+            pos = jax.lax.dynamic_slice(
+                table, (off, 0), (tokens.shape[1], self.dim)
+            )
+        else:
+            pos = table[: tokens.shape[1]]
+        return x.astype(jnp.float32) + pos[None]
 
     def head_logits(self, x, mask):
         m = mask.astype(jnp.float32)[..., None]
-        pooled = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+        num = jnp.sum(x * m, axis=1)
+        den = jnp.sum(m, axis=1)
+        if self.sp_axis is not None:
+            # masked mean over the FULL sequence: combine shard partials
+            num = jax.lax.psum(num, self.sp_axis)
+            den = jax.lax.psum(den, self.sp_axis)
+        pooled = num / jnp.maximum(den, 1.0)
         h = self.ln_head(pooled)
         return self.head(h.astype(self.dtype)).astype(jnp.float32)
 
@@ -170,6 +203,48 @@ def pipelined_transformer_forward(module: TransformerClassifier, params,
                           microbatches=microbatches)
     return module.apply({"params": params}, x, mask,
                         method=TransformerClassifier.head_logits)
+
+
+def sequence_parallel_transformer_forward(module: TransformerClassifier,
+                                          params, tokens, mask, mesh,
+                                          axis: str = "sp"):
+    """Full transformer forward with activations sharded along L over ``axis``.
+
+    One ``shard_map`` program: every pointwise layer (embed lookup, layernorm,
+    QKV/MLP matmuls) runs on its shard's sequence slice, attention is the
+    ring-rotation body from :mod:`distkeras_tpu.parallel.sequence`
+    (``ppermute`` K/V/mask exchanges over ICI), position embeddings are
+    offset per shard, and the masked-mean head combines shard partials with
+    ``psum``. Per-chip activation memory is O(L/N) — context length scales
+    linearly with the mesh. Numerically equal to ``module.apply`` on the
+    gathered sequence (pinned by tests/test_sequence_parallel.py) and
+    differentiable, so full training steps run sequence-parallel.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    N = mesh.shape[axis]
+    L = tokens.shape[1]
+    if L % N:
+        raise ValueError(f"sequence length {L} not divisible by mesh axis "
+                         f"'{axis}' of size {N}")
+    if mask is None:
+        mask = jnp.ones(tokens.shape, jnp.float32)
+    smod = module.clone(attn_impl="ring", sp_axis=axis, sp_size=N)
+
+    def body(params, toks_l, mask_l):
+        return smod.apply({"params": params}, toks_l, mask_l, False)
+
+    pspec = jax.tree.map(lambda _: P(), params)
+    shard_fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, P(None, axis), P(None, axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    sh = NamedSharding(mesh, P(None, axis))
+    tokens = jax.device_put(tokens, sh)
+    mask = jax.device_put(mask, sh)
+    return shard_fn(params, tokens, mask)
 
 
 def transformer_classifier(vocab=20000, maxlen=200, dim=128, heads=4, depth=2,

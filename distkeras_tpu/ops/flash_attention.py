@@ -113,15 +113,13 @@ def _interpret_default():
 def _fa_forward(q, k, v, key_mask, *, scale, causal, interpret):
     """q/k/v [B, L, H, D] (+ key_mask [B, L]) → (out [B, L, H, D], lse)."""
     B, L, H, D = q.shape
-    bq = min(BLOCK_Q, L)
-    # largest tile-aligned k block that divides L
-    bk = L if L < BLOCK_Q else next(
-        (c for c in (BLOCK_K, 384, 256, 128) if L % c == 0), 0
-    )
-    if not bk or L % bq:
+    if L % BLOCK_Q:
         raise ValueError(
             f"sequence length {L} must be a multiple of {BLOCK_Q}"
         )
+    bq = BLOCK_Q
+    # largest tile-aligned k block that divides L (128 always does)
+    bk = next(c for c in (BLOCK_K, 384, 256, 128) if L % c == 0)
 
     def bh(x):  # [B, L, H, D] → [B·H, L, D]
         return jnp.moveaxis(x, 2, 1).reshape(B * H, L, D)

@@ -27,7 +27,11 @@ from distkeras_tpu.parallel.pipeline import (
     sequential_apply,
     stack_stage_params,
 )
-from distkeras_tpu.parallel.sequence import attention_reference, ring_attention
+from distkeras_tpu.parallel.sequence import (
+    attention_reference,
+    ring_attention,
+    ring_attention_shard,
+)
 from distkeras_tpu.parallel.tensor import (
     SPMDEngine,
     get_mesh_nd,
@@ -38,6 +42,7 @@ from distkeras_tpu.parallel.tensor import (
 __all__ = [
     "attention_reference",
     "ring_attention",
+    "ring_attention_shard",
     "pipeline_apply",
     "sequential_apply",
     "stack_stage_params",

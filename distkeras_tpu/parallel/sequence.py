@@ -108,6 +108,12 @@ def _ring_attention_shard(q, k, v, key_mask=None, *, axis_name, axis_size,
     return jnp.moveaxis(out, 1, 2).astype(q.dtype)           # [B, Lq, H, D]
 
 
+#: public alias — the per-shard ring body, for composing ring attention into
+#: a larger computation that is ALREADY inside shard_map over the sequence
+#: axis (e.g. models.transformer.sequence_parallel_transformer_forward)
+ring_attention_shard = _ring_attention_shard
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str | None = None,
                    causal: bool = False, scale=None, key_mask=None):
     """Exact attention with Q/K/V sharded along sequence length over ``axis``.

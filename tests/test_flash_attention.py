@@ -11,7 +11,7 @@ from distkeras_tpu.parallel.sequence import attention_reference
 B, L, H, D = 2, 256, 2, 64
 
 
-def qkv(rng, seed_shift=0):
+def qkv(rng, L=L):
     mk = lambda: rng.normal(0, 1, size=(B, L, H, D)).astype(np.float32)
     return mk(), mk(), mk()
 
@@ -81,6 +81,44 @@ def test_masked_gradients_match_reference(rng):
     for name, gg, rr in zip("qkv", g, r):
         np.testing.assert_allclose(np.asarray(gg), np.asarray(rr),
                                    rtol=5e-3, atol=5e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_multi_k_tile_online_softmax(rng, causal, monkeypatch):
+    """Multiple k tiles per q block (nk=2): exercises the cross-tile corr
+    rescaling of (m, l, acc) and the causal last_k early finalization that
+    single-tile shapes never touch. BLOCK_K is shrunk so the multi-tile
+    path runs at CI-friendly sizes."""
+    from distkeras_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa, "BLOCK_K", 128)
+    q, k, v = qkv(rng)                       # L=256 → nk=2
+    mask = np.ones((B, L), np.float32)
+    mask[:, L - 60:] = 0.0
+    out = fa.flash_attention(q, k, v, causal=causal, key_mask=mask)
+    ref = attention_reference(q, k, v, causal=causal, key_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    # and the gradient path across tiles
+    cot = rng.normal(size=(B, L, H, D)).astype(np.float32)
+    g = jax.grad(
+        lambda q: jnp.sum(
+            fa.flash_attention(q, k, v, causal=causal, key_mask=mask) * cot
+        )
+    )(q)
+    r = jax.grad(
+        lambda q: jnp.sum(
+            attention_reference(q, k, v, causal=causal, key_mask=mask) * cot
+        )
+    )(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                               rtol=5e-3, atol=5e-4)
+
+
+def test_length_guard_raises_below_block(rng):
+    mk = lambda: rng.normal(size=(B, 96, H, D)).astype(np.float32)
+    with pytest.raises(ValueError, match="multiple of 128"):
+        flash_attention(mk(), mk(), mk())
 
 
 def test_under_jit_with_traced_mask(rng):

@@ -28,6 +28,77 @@ def test_ring_attention_matches_oracle_on_mesh(causal):
                                rtol=2e-4, atol=2e-5)
 
 
+def test_sequence_parallel_transformer_matches_plain_forward():
+    """Model-level SP: the whole forward sharded along L == plain forward."""
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models import transformer_classifier
+    from distkeras_tpu.models.transformer import (
+        TransformerClassifier,
+        sequence_parallel_transformer_forward,
+    )
+
+    rng = np.random.default_rng(0)
+    mesh = get_mesh(8, axis="sp")
+    kw = dict(vocab=64, maxlen=64, dim=32, heads=4, depth=2, num_classes=4,
+              dtype=jnp.float32)
+    spec = transformer_classifier(**kw)
+    module = TransformerClassifier(**kw)
+    params, _ = spec.init_np(0)
+    toks = rng.integers(0, 64, size=(4, 64)).astype(np.int32)
+    mask = np.ones((4, 64), np.float32)
+    mask[:, 50:] = 0.0  # padding crosses shard boundaries
+
+    ref = module.apply({"params": params}, toks, mask, False)
+    out = sequence_parallel_transformer_forward(
+        module, params, toks, mask, mesh
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_sequence_parallel_transformer_trains():
+    """Gradients flow through the ring; one adam step reduces the loss."""
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu.models.transformer import (
+        TransformerClassifier,
+        sequence_parallel_transformer_forward,
+    )
+
+    rng = np.random.default_rng(1)
+    mesh = get_mesh(8, axis="sp")
+    module = TransformerClassifier(vocab=64, maxlen=64, dim=32, heads=4,
+                                   depth=2, num_classes=4, dtype=jnp.float32)
+    n = 16
+    y = rng.integers(0, 4, size=(n,)).astype(np.int32)
+    toks = (y[:, None] * 16 + rng.integers(0, 16, size=(n, 64))).astype(
+        np.int32
+    )
+    mask = np.ones((n, 64), np.float32)
+    params = module.init(jax.random.PRNGKey(0), toks, mask,
+                         training=False)["params"]
+
+    def loss(params):
+        logits = sequence_parallel_transformer_forward(
+            module, params, toks, mask, mesh
+        )
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+    losses = []
+    for _ in range(10):
+        l, g = jax.value_and_grad(loss)(params)
+        u, opt = tx.update(g, opt, params)
+        params = optax.apply_updates(params, u)
+        losses.append(float(l))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
 def test_ring_attention_causal_actually_masks():
     mesh = get_mesh(8, axis="sp")
     q, k, v = qkv(seed=3)
