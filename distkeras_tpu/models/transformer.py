@@ -11,6 +11,8 @@ matmuls on the MXU; all control flow is static for XLA.
 
 from __future__ import annotations
 
+import functools
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -54,9 +56,10 @@ def attention_sublayer(x, mask, *, dim, heads, causal, dtype,
     if attn_impl == "ring":
         from distkeras_tpu.parallel.sequence import ring_attention_shard
 
+        # no f32 pre-cast: the ring body casts per block internally, and
+        # rotating K/V in bf16 halves the per-step ICI payload
         att = ring_attention_shard(
-            q.astype(jnp.float32), k.astype(jnp.float32),
-            v.astype(jnp.float32), mask,
+            q, k, v, mask,
             axis_name=sp_axis, axis_size=sp_size, causal=causal,
             scale=(dim // heads) ** -0.5,
         )
@@ -227,24 +230,39 @@ def sequence_parallel_transformer_forward(module: TransformerClassifier,
     if L % N:
         raise ValueError(f"sequence length {L} not divisible by mesh axis "
                          f"'{axis}' of size {N}")
+    if L > module.maxlen:
+        raise ValueError(
+            f"sequence length {L} exceeds the model's maxlen "
+            f"{module.maxlen} (the plain forward would fail too)"
+        )
     if mask is None:
         mask = jnp.ones(tokens.shape, jnp.float32)
-    smod = module.clone(attn_impl="ring", sp_axis=axis, sp_size=N)
-
-    def body(params, toks_l, mask_l):
-        return smod.apply({"params": params}, toks_l, mask_l, False)
-
-    pspec = jax.tree.map(lambda _: P(), params)
-    shard_fn = jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(pspec, P(None, axis), P(None, axis)),
-        out_specs=P(),
-        check_vma=False,
+    shard_fn = _sp_forward_fn(
+        module.clone(attn_impl="ring", sp_axis=axis, sp_size=N), mesh, axis
     )
     sh = NamedSharding(mesh, P(None, axis))
     tokens = jax.device_put(tokens, sh)
     mask = jax.device_put(mask, sh)
     return shard_fn(params, tokens, mask)
+
+
+@functools.lru_cache(maxsize=32)
+def _sp_forward_fn(smod, mesh, axis):
+    """Build + jit the shard_map'd SP forward once per (module, mesh, axis);
+    flax modules are frozen dataclasses, so they key the cache by config.
+    Without this every call would rebuild shard_map and recompile."""
+    from jax.sharding import PartitionSpec as P
+
+    def body(params, toks_l, mask_l):
+        return smod.apply({"params": params}, toks_l, mask_l, False)
+
+    # P() is a pytree PREFIX: it broadcasts over the whole params tree
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(None, axis), P(None, axis)),
+        out_specs=P(),
+        check_vma=False,
+    ))
 
 
 def transformer_classifier(vocab=20000, maxlen=200, dim=128, heads=4, depth=2,

@@ -168,6 +168,41 @@ def test_moe_transformer_trains_with_aux_loss(rng):
     assert losses[-1] < 0.6 * losses[0]
 
 
+def test_moe_model_trains_through_trainer_api(rng):
+    """The MoE family is a first-class citizen of the reference trainer API:
+    ADAG over stacked workers vmaps the (single-device-math) MoE blocks."""
+    import jax.numpy as jnp
+
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.models import moe_transformer_classifier
+    from distkeras_tpu.trainers import ADAG
+
+    n, maxlen, classes = 64, 16, 4
+    y = rng.integers(0, classes, size=(n,)).astype(np.int32)
+    toks = (y[:, None] * 16 + rng.integers(0, 16, size=(n, maxlen))).astype(
+        np.int32
+    )
+    ds = Dataset({
+        "features": toks,
+        "mask": np.ones((n, maxlen), np.float32),
+        "label": y,
+    })
+    spec = moe_transformer_classifier(
+        vocab=64, maxlen=maxlen, dim=16, heads=2, depth=1, num_experts=4,
+        top_k=2, num_classes=classes, dtype=jnp.float32,
+    )
+    trainer = ADAG(
+        spec, loss="sparse_softmax_cross_entropy", worker_optimizer="adam",
+        learning_rate=2e-3, num_workers=2, batch_size=8,
+        communication_window=2, num_epoch=8,
+        features_col=["features", "mask"], label_col="label",
+    )
+    trainer.train(ds, shuffle=True)
+    losses = trainer.history.losses()
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
 def _step(loss, tx, params, opt):
     l, g = jax.value_and_grad(loss)(params)
     u, opt = tx.update(g, opt, params)
