@@ -210,7 +210,8 @@ def pipelined_transformer_forward(module: TransformerClassifier, params,
 
 def sequence_parallel_transformer_forward(module: TransformerClassifier,
                                           params, tokens, mask, mesh,
-                                          axis: str = "sp"):
+                                          axis: str = "sp",
+                                          batch_axis: str | None = None):
     """Full transformer forward with activations sharded along L over ``axis``.
 
     One ``shard_map`` program: every pointwise layer (embed lookup, layernorm,
@@ -222,6 +223,12 @@ def sequence_parallel_transformer_forward(module: TransformerClassifier,
     linearly with the mesh. Numerically equal to ``module.apply`` on the
     gathered sequence (pinned by tests/test_sequence_parallel.py) and
     differentiable, so full training steps run sequence-parallel.
+
+    ``batch_axis`` composes data parallelism on a 2-D mesh (e.g.
+    ``get_mesh_nd({"dp": 2, "sp": 4})``): the batch dimension shards over
+    ``batch_axis``, the sequence over ``axis``, and the returned logits are
+    sharded over ``batch_axis`` — a dp×sp training step when differentiated
+    (the batch-mean loss's gradient psum over dp is inserted by GSPMD).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -235,20 +242,27 @@ def sequence_parallel_transformer_forward(module: TransformerClassifier,
             f"sequence length {L} exceeds the model's maxlen "
             f"{module.maxlen} (the plain forward would fail too)"
         )
+    if batch_axis is not None and tokens.shape[0] % mesh.shape[batch_axis]:
+        raise ValueError(
+            f"batch {tokens.shape[0]} not divisible by mesh axis "
+            f"'{batch_axis}' of size {mesh.shape[batch_axis]}"
+        )
     if mask is None:
         mask = jnp.ones(tokens.shape, jnp.float32)
     shard_fn = _sp_forward_fn(
-        module.clone(attn_impl="ring", sp_axis=axis, sp_size=N), mesh, axis
+        module.clone(attn_impl="ring", sp_axis=axis, sp_size=N), mesh, axis,
+        batch_axis,
     )
-    sh = NamedSharding(mesh, P(None, axis))
+    sh = NamedSharding(mesh, P(batch_axis, axis))
     tokens = jax.device_put(tokens, sh)
     mask = jax.device_put(mask, sh)
     return shard_fn(params, tokens, mask)
 
 
 @functools.lru_cache(maxsize=32)
-def _sp_forward_fn(smod, mesh, axis):
-    """Build + jit the shard_map'd SP forward once per (module, mesh, axis);
+def _sp_forward_fn(smod, mesh, axis, batch_axis=None):
+    """Build + jit the shard_map'd SP forward once per
+    (module, mesh, axis, batch_axis);
     flax modules are frozen dataclasses, so they key the cache by config.
     Without this every call would rebuild shard_map and recompile."""
     from jax.sharding import PartitionSpec as P
@@ -256,11 +270,12 @@ def _sp_forward_fn(smod, mesh, axis):
     def body(params, toks_l, mask_l):
         return smod.apply({"params": params}, toks_l, mask_l, False)
 
+    io = P(batch_axis, axis)
     # P() is a pytree PREFIX: it broadcasts over the whole params tree
     return jax.jit(jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(), P(None, axis), P(None, axis)),
-        out_specs=P(),
+        in_specs=(P(), io, io),
+        out_specs=P(batch_axis),
         check_vma=False,
     ))
 

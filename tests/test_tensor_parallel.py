@@ -169,6 +169,34 @@ def test_mesh_trainer_end_to_end(rng):
     assert out.shape == (8, CLASSES)
 
 
+def test_mesh_trainer_accepts_keras_model(rng):
+    """The reference contract (hand a Keras model to a trainer) holds for
+    the beyond-reference trainer too; Keras param lists have no layer names,
+    so the Megatron rules replicate everything — a dp-only mesh run."""
+    import keras
+
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.trainers import MeshTrainer
+
+    model = keras.Sequential([
+        keras.layers.Input((16,)),
+        keras.layers.Dense(32, activation="relu"),
+        keras.layers.Dense(4),
+    ])
+    n = 64
+    x = rng.normal(size=(n, 16)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    ds = Dataset({"features": x, "label": y})
+    trainer = MeshTrainer(
+        model, loss="sparse_softmax_cross_entropy", worker_optimizer="adam",
+        learning_rate=5e-3, mesh_shape={"dp": 8}, batch_size=16, num_epoch=10,
+    )
+    out = trainer.train(ds, shuffle=True)
+    assert out is model  # trained weights written back into the user's model
+    preds = np.argmax(model.predict(x, verbose=0), axis=-1)
+    assert np.mean(preds == y) > 0.8
+
+
 def _plain_step(ls, tx, params, nt, opt, b):
     (loss, new_nt), grads = jax.value_and_grad(ls, has_aux=True)(
         params, nt, b
