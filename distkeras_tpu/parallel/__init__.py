@@ -38,6 +38,7 @@ from distkeras_tpu.parallel.tensor import (
     megatron_specs,
     shard_pytree,
 )
+from distkeras_tpu.parallel.fsdp import FSDPEngine, fsdp_specs
 
 __all__ = [
     "attention_reference",
@@ -50,6 +51,8 @@ __all__ = [
     "moe_mlp",
     "moe_mlp_reference",
     "SPMDEngine",
+    "FSDPEngine",
+    "fsdp_specs",
     "get_mesh_nd",
     "megatron_specs",
     "shard_pytree",

@@ -1,0 +1,108 @@
+"""Fully-sharded data parallelism (FSDP / ZeRO-3) via GSPMD.
+
+The reference has no parameter sharding of any kind (SURVEY.md §2b.2: its only
+strategy is PS-based data parallelism, every worker holding a full replica), so
+nothing here is a port: this is the TPU-native memory-scaling extension for
+models whose parameters + optimizer state outgrow one chip even before
+activations are counted.
+
+The design is the idiomatic XLA lowering of ZeRO stage 3 (Rajbhandari et al.
+2020) — and it is deliberately *tiny*, because on TPU the compiler does the
+heavy lifting that DeepSpeed does by hand:
+
+- every parameter leaf is sharded along ONE of its dimensions over the data
+  axis (``fsdp_specs`` picks the largest divisible dim; small leaves like
+  biases and layernorm scales stay replicated — gathering them costs more
+  latency than their memory is worth);
+- the optimizer state inherits the same shardings by propagation through a
+  jitted ``optimizer.init`` (computation follows data), which is exactly
+  ZeRO-1/2's optimizer+gradient partitioning;
+- the train step itself is the ordinary :class:`SPMDEngine` step: GSPMD sees
+  batch sharded over ``dp`` AND params sharded over ``dp`` and inserts the
+  ``all_gather`` (params, before each layer's matmul) and ``reduce_scatter``
+  (grads, after) on ICI. The math is bit-for-bit the single-device step's —
+  pinned by tests/test_fsdp.py on the 8-device mesh.
+
+Composition: pass ``base_specs=megatron_specs(params)`` and FSDP shards the
+dims tensor parallelism left alone — ZeRO-3 over ``dp`` × Megatron over ``tp``
+on one 2-D mesh, the standard large-model layout.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from distkeras_tpu.parallel.tensor import SPMDEngine, megatron_specs
+
+#: leaves smaller than this stay replicated (biases, norms): the all-gather
+#: latency of a tiny leaf exceeds the HBM it saves. Tests pass 0 to force
+#: sharding of toy models.
+DEFAULT_MIN_SIZE = 2048
+
+
+def fsdp_specs(params, n_shards: int, axis: str = "dp", base_specs=None,
+               min_size: int = DEFAULT_MIN_SIZE):
+    """PartitionSpec pytree sharding each leaf over ``axis`` (ZeRO-3 layout).
+
+    For every leaf: among the dimensions not already claimed by
+    ``base_specs`` (e.g. Megatron ``tp`` rules), shard the largest one whose
+    extent divides ``n_shards``; leaves with no such dimension, or fewer than
+    ``min_size`` elements, keep their base spec (replicated by default).
+    """
+
+    def spec_for(path, leaf):
+        base = P() if base_specs is None else _lookup(base_specs, path)
+        taken = tuple(base) + (None,) * (leaf.ndim - len(base))
+        if leaf.size < min_size:
+            return base
+        best = None
+        for d in range(leaf.ndim):
+            if taken[d] is not None:
+                continue
+            if leaf.shape[d] % n_shards:
+                continue
+            if best is None or leaf.shape[d] > leaf.shape[best]:
+                best = d
+        if best is None:
+            return base
+        new = list(taken)
+        new[best] = axis
+        while new and new[-1] is None:
+            new.pop()
+        return P(*new)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def _lookup(tree, path):
+    for k in path:
+        tree = tree[getattr(k, "key", getattr(k, "idx", None))]
+    return tree
+
+
+class FSDPEngine(SPMDEngine):
+    """:class:`SPMDEngine` whose default parameter layout is ZeRO-3.
+
+    ``tensor_parallel=True`` additionally applies the Megatron rules over
+    ``tp`` first and lets FSDP shard the remaining dims over ``dp``.
+    """
+
+    def __init__(self, spec, loss_step, optimizer, mesh, dp_axis="dp",
+                 tp_axis="tp", tensor_parallel=False,
+                 min_size: int = DEFAULT_MIN_SIZE, param_specs=None):
+        super().__init__(spec, loss_step, optimizer, mesh,
+                         param_specs=param_specs, dp_axis=dp_axis,
+                         tp_axis=tp_axis)
+        self.tensor_parallel = bool(tensor_parallel)
+        self.min_size = int(min_size)
+
+    def init_state(self, params, nt):
+        if self.param_specs is None:
+            base = (megatron_specs(params, self.tp_axis)
+                    if self.tensor_parallel else None)
+            self.param_specs = fsdp_specs(
+                params, self.mesh.shape[self.dp_axis], axis=self.dp_axis,
+                base_specs=base, min_size=self.min_size,
+            )
+        return super().init_state(params, nt)

@@ -136,16 +136,46 @@ class SPMDEngine:
         self._step = None
 
     def init_state(self, params, nt):
-        """Shard params per the specs; opt state inherits by propagation."""
+        """Shard params per the specs; opt state pinned to the same layout."""
         if self.param_specs is None:
             self.param_specs = megatron_specs(params, self.tp_axis)
         params = shard_pytree(params, self.mesh, self.param_specs)
         rep = NamedSharding(self.mesh, P())
         nt = jax.tree.map(lambda x: jax.device_put(x, rep), nt)
-        # jit so mu/nu inherit the params' shardings (computation follows data)
-        opt_state = jax.jit(self.optimizer.init)(params)
+        # moments/accumulators inherit the params' layout (with FSDP specs
+        # this IS ZeRO optimizer-state partitioning); scalars replicate
+        opt_state = jax.jit(
+            self.optimizer.init, out_shardings=self._opt_shardings(params)
+        )(params)
         self._build_step()
         return params, nt, opt_state
+
+    def _opt_shardings(self, params):
+        """Sharding tree for ``optimizer.init``'s output: any params-shaped
+        subtree (adam mu/nu, momentum trace, …) gets ``param_specs``; every
+        other leaf (step counts, schedules) is replicated. Leaves whose shape
+        differs from the matching param (adafactor's factored v_row/v_col)
+        also replicate — their layout is the compiler's to choose."""
+        ptreedef = jax.tree.structure(params)
+        opt_shapes = jax.eval_shape(self.optimizer.init, params)
+
+        def params_like(x):
+            return (not isinstance(x, jax.ShapeDtypeStruct)
+                    and jax.tree.structure(x) == ptreedef)
+
+        def sub_specs(sub):
+            return jax.tree.map(
+                lambda spec, p, o: (spec if tuple(p.shape) == tuple(o.shape)
+                                    else P()),
+                self.param_specs, params, sub,
+            )
+
+        specs = jax.tree.map(
+            lambda sub: (sub_specs(sub) if params_like(sub)
+                         else jax.tree.map(lambda _: P(), sub)),
+            opt_shapes, is_leaf=params_like,
+        )
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs)
 
     def _build_step(self):
         tx, loss_step = self.optimizer, self.loss_step

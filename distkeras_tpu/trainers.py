@@ -540,11 +540,21 @@ class MeshTrainer(Trainer):
 
     ``mesh_shape`` e.g. ``{"dp": 2, "tp": 4}``; ``param_specs`` overrides the
     automatic Megatron rules with an explicit PartitionSpec pytree.
+
+    ``parameter_sharding`` selects the parameter layout:
+
+    - ``"megatron"`` (default) — Megatron column/row rules over ``tp``
+      (replicated when the mesh has no ``tp`` axis);
+    - ``"fsdp"`` — ZeRO-3: every large leaf sharded over ``dp``, optimizer
+      state sharded by propagation (:mod:`distkeras_tpu.parallel.fsdp`);
+    - ``"fsdp+megatron"`` — Megatron over ``tp`` first, FSDP shards the
+      remaining dims over ``dp``.
     """
 
     def __init__(self, keras_model, loss="sparse_softmax_cross_entropy",
                  worker_optimizer="adam", learning_rate: float = 1e-3,
                  mesh=None, mesh_shape: dict | None = None, param_specs=None,
+                 parameter_sharding: str = "megatron",
                  batch_size: int = 32, features_col="features",
                  label_col: str = "label", num_epoch: int = 1, seed: int = 0,
                  log_metrics: bool = False):
@@ -556,6 +566,12 @@ class MeshTrainer(Trainer):
             mesh = get_mesh_nd(mesh_shape or {"dp": len(jax.devices())})
         self.mesh = mesh
         self.param_specs = param_specs
+        if parameter_sharding not in ("megatron", "fsdp", "fsdp+megatron"):
+            raise ValueError(
+                f"parameter_sharding={parameter_sharding!r}: expected "
+                f"'megatron', 'fsdp', or 'fsdp+megatron'"
+            )
+        self.parameter_sharding = parameter_sharding
         self.batch_size = int(batch_size)
         self.features_col: list[str] = _as_cols(features_col)
         self.label_col = label_col
@@ -563,16 +579,26 @@ class MeshTrainer(Trainer):
         self.log_metrics = bool(log_metrics)
 
     def train(self, dataset, shuffle: bool = False):
+        from distkeras_tpu.parallel.fsdp import FSDPEngine
         from distkeras_tpu.parallel.tensor import SPMDEngine
 
         ds = self._coerce_dataset(dataset)
         cols = self.features_col + [self.label_col]
-        engine = SPMDEngine(
-            self.spec,
-            _make_loss_step(self.spec, self.loss_fn, len(self.features_col)),
-            resolve_optimizer(self.worker_optimizer, self.learning_rate),
-            self.mesh, param_specs=self.param_specs,
+        loss_step = _make_loss_step(
+            self.spec, self.loss_fn, len(self.features_col)
         )
+        optimizer = resolve_optimizer(
+            self.worker_optimizer, self.learning_rate
+        )
+        if self.parameter_sharding == "megatron":
+            engine = SPMDEngine(self.spec, loss_step, optimizer, self.mesh,
+                                param_specs=self.param_specs)
+        else:
+            engine = FSDPEngine(
+                self.spec, loss_step, optimizer, self.mesh,
+                tensor_parallel=(self.parameter_sharding == "fsdp+megatron"),
+                param_specs=self.param_specs,
+            )
         params, nt, opt = engine.init_state(*self.spec.init_np(self.seed))
 
         self.record_training_start()

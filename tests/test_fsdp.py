@@ -1,0 +1,217 @@
+"""FSDP/ZeRO-3 parameter sharding vs the single-device oracle.
+
+Like tensor parallelism, FSDP must change layout and collectives only, never
+values: the sharded train step's math is pinned to a plain local step on the
+same data. Beyond-reference (SURVEY.md §2b.2 — the reference replicates full
+weights on every worker).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from distkeras_tpu.models import mlp, transformer_classifier
+from distkeras_tpu.ops.losses import sparse_softmax_cross_entropy
+from distkeras_tpu.parallel.fsdp import FSDPEngine, fsdp_specs
+from distkeras_tpu.parallel.tensor import (
+    assert_param_shardings,
+    get_mesh_nd,
+    megatron_specs,
+)
+
+DIM, HEADS, DEPTH, VOCAB, MAXLEN, CLASSES = 32, 4, 2, 64, 16, 4
+
+
+def small_transformer():
+    return transformer_classifier(
+        vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS, depth=DEPTH,
+        num_classes=CLASSES, dtype=jnp.float32,
+    )
+
+
+def tbatch(rng, B=8):
+    toks = rng.integers(0, VOCAB, size=(B, MAXLEN)).astype(np.int32)
+    mask = np.ones((B, MAXLEN), np.float32)
+    mask[:, MAXLEN - 4:] = 0.0
+    y = rng.integers(0, CLASSES, size=(B,)).astype(np.int32)
+    return toks, mask, y
+
+
+def transformer_loss(spec):
+    def fn(params, nt, b):
+        toks, mask, y = b
+        out, new_nt = spec.apply(params, nt, (toks, mask), training=True)
+        return sparse_softmax_cross_entropy(y, out), new_nt
+
+    return fn
+
+
+def test_fsdp_specs_layout():
+    spec = small_transformer()
+    params, _ = spec.init_np(0)
+    specs = fsdp_specs(params, 8, min_size=0)
+    blk = specs["blocks_0"]
+    # 2-D kernels: one dim sharded over dp — the largest divisible one
+    assert blk["qkv"]["kernel"] == P(None, "dp")          # [32, 96]
+    assert blk["mlp_up"]["kernel"] == P(None, "dp")       # [32, 128]
+    assert blk["mlp_down"]["kernel"] == P("dp")           # [128, 32]
+    assert specs["embed"]["embedding"] == P("dp")         # [64, 32]
+    # 1-D leaves shard too when min_size=0 and divisible
+    assert blk["qkv"]["bias"] == P("dp")                  # [96]
+    # with the default min_size, small leaves stay replicated
+    default = fsdp_specs(params, 8)
+    assert default["blocks_0"]["qkv"]["bias"] == P()
+    assert default["ln_head"]["scale"] == P()
+
+
+def test_fsdp_specs_compose_with_megatron():
+    spec = small_transformer()
+    params, _ = spec.init_np(0)
+    base = megatron_specs(params)
+    specs = fsdp_specs(params, 2, base_specs=base, min_size=0)
+    blk = specs["blocks_0"]
+    # tp claimed the output dim; fsdp takes the input dim
+    assert blk["qkv"]["kernel"] == P("dp", "tp")
+    assert blk["attn_out"]["kernel"] == P("tp", "dp")
+    # embedding: tp on vocab, dp on feature dim
+    assert specs["embed"]["embedding"] == P("tp", "dp")
+
+
+def test_fsdp_specs_indivisible_dims_stay_base():
+    params = {"odd": np.zeros((7, 5), np.float32),
+              "big": np.zeros((16, 24), np.float32)}
+    specs = fsdp_specs(params, 8, min_size=0)
+    assert specs["odd"] == P()
+    assert specs["big"] == P(None, "dp")
+
+
+def test_fsdp_train_matches_single_device(rng):
+    assert len(jax.devices()) == 8
+    mesh = get_mesh_nd({"dp": 8})
+    spec = small_transformer()
+    ls = transformer_loss(spec)
+    tx = optax.sgd(0.05, momentum=0.9)
+
+    params, nt = spec.init_np(0)
+    opt = tx.init(params)
+    oracle = jax.jit(lambda p, n, o, b: _plain_step(ls, tx, p, n, o, b))
+    batches = [tbatch(rng), tbatch(rng)]
+    ref_losses = []
+    for b in batches:
+        params, nt, opt, loss = oracle(params, nt, opt, b)
+        ref_losses.append(float(loss))
+
+    engine = FSDPEngine(spec, ls, tx, mesh, min_size=0)
+    p2, nt2, opt2 = engine.init_state(*spec.init_np(0))
+    got_losses = []
+    for b in batches:
+        p2, nt2, opt2, loss = engine.run_step(p2, nt2, opt2, b)
+        got_losses.append(float(loss))
+
+    np.testing.assert_allclose(got_losses, ref_losses, rtol=1e-5, atol=1e-6)
+    for r, g in zip(jax.tree.leaves(params),
+                    jax.tree.leaves(jax.device_get(p2))):
+        np.testing.assert_allclose(g, r, rtol=3e-4, atol=3e-5)
+    assert_param_shardings(p2, engine.param_specs, mesh)
+
+
+def test_fsdp_memory_actually_sharded(rng):
+    """Params AND adam state shards are 1/8th-size per device (ZeRO-3)."""
+    mesh = get_mesh_nd({"dp": 8})
+    spec = small_transformer()
+    engine = FSDPEngine(spec, transformer_loss(spec), optax.adam(1e-3), mesh,
+                        min_size=0)
+    p, nt, opt = engine.init_state(*spec.init_np(0))
+    kern = p["blocks_0"]["mlp_up"]["kernel"]          # [32, 128]
+    assert {s.data.shape for s in kern.addressable_shards} == {(32, 16)}
+    # optimizer moments inherited the layout: ZeRO optimizer-state sharding
+    mu = opt[0].mu["blocks_0"]["mlp_up"]["kernel"]
+    assert {s.data.shape for s in mu.addressable_shards} == {(32, 16)}
+
+
+def test_fsdp_with_tensor_parallel_train(rng):
+    """ZeRO over dp × Megatron over tp on one 2-D mesh, vs the oracle."""
+    mesh = get_mesh_nd({"dp": 2, "tp": 4})
+    spec = small_transformer()
+    ls = transformer_loss(spec)
+    tx = optax.sgd(0.05, momentum=0.9)
+
+    params, nt = spec.init_np(0)
+    opt = tx.init(params)
+    oracle = jax.jit(lambda p, n, o, b: _plain_step(ls, tx, p, n, o, b))
+    b = tbatch(rng)
+    params, nt, opt, ref_loss = oracle(params, nt, opt, b)
+
+    engine = FSDPEngine(spec, ls, tx, mesh, tensor_parallel=True, min_size=0)
+    p2, nt2, opt2 = engine.init_state(*spec.init_np(0))
+    p2, nt2, opt2, loss = engine.run_step(p2, nt2, opt2, b)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+    # the qkv kernel is split over BOTH axes: all 8 devices hold 1/8th
+    kern = p2["blocks_0"]["qkv"]["kernel"]            # [32, 96]
+    assert {s.data.shape for s in kern.addressable_shards} == {(16, 24)}
+
+
+def test_mesh_trainer_fsdp_end_to_end(rng):
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.trainers import MeshTrainer
+
+    n, CLASSES_ = 64, CLASSES
+    y = rng.integers(0, CLASSES_, size=(n,)).astype(np.int32)
+    toks = (
+        y[:, None] * (VOCAB // CLASSES_)
+        + rng.integers(0, VOCAB // CLASSES_, size=(n, MAXLEN))
+    ).astype(np.int32)
+    mask = np.ones((n, MAXLEN), np.float32)
+    ds = Dataset({"features": toks, "mask": mask, "label": y})
+
+    trainer = MeshTrainer(
+        small_transformer(), loss="sparse_softmax_cross_entropy",
+        worker_optimizer="adam", learning_rate=2e-3,
+        mesh_shape={"dp": 8}, parameter_sharding="fsdp",
+        batch_size=16, num_epoch=12,
+        features_col=["features", "mask"], label_col="label",
+    )
+    params = trainer.train(ds, shuffle=True)
+    losses = [r["loss"] for r in trainer.history.records if "loss" in r]
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < 0.5 * np.mean(losses[:4])
+    # returned params are plain host arrays usable for inference
+    out, _ = small_transformer().apply(
+        params, trainer.trained_nt_, (toks[:8], mask[:8]), False
+    )
+    assert out.shape == (8, CLASSES_)
+
+
+def test_fsdp_shape_changing_opt_state(rng):
+    """Optimizers whose state leaves differ in shape from the params
+    (adafactor's factored v_row/v_col) must init and step, with the
+    mismatched leaves simply replicated (regression: the opt-sharding pin
+    once assumed every params-structured subtree was params-shaped)."""
+    mesh = get_mesh_nd({"dp": 8})
+    spec = small_transformer()
+    engine = FSDPEngine(spec, transformer_loss(spec), optax.adafactor(1e-2),
+                        mesh, min_size=0)
+    p, nt, opt = engine.init_state(*spec.init_np(0))
+    p, nt, opt, loss = engine.run_step(p, nt, opt, tbatch(rng))
+    assert np.isfinite(float(loss))
+
+
+def test_mesh_trainer_rejects_bad_sharding_mode():
+    import pytest
+
+    from distkeras_tpu.trainers import MeshTrainer
+
+    with pytest.raises(ValueError, match="parameter_sharding"):
+        MeshTrainer(mlp(), parameter_sharding="zero99")
+
+
+def _plain_step(ls, tx, params, nt, opt, b):
+    (loss, new_nt), grads = jax.value_and_grad(ls, has_aux=True)(
+        params, nt, b
+    )
+    updates, opt = tx.update(grads, opt, params)
+    return optax.apply_updates(params, updates), new_nt, opt, loss
