@@ -24,17 +24,9 @@ class AccuracyEvaluator:
         self.label_col = label_col
 
     def evaluate(self, ds: Dataset) -> float:
-        pred = ds[self.prediction_col]
-        if pred.ndim > 1 and pred.shape[-1] > 1:
-            pred = np.argmax(pred, axis=-1)
-        else:
-            pred = np.round(pred.reshape(len(ds), -1)[:, 0])
-        label = ds[self.label_col]
-        if label.ndim > 1 and label.shape[-1] > 1:
-            label = np.argmax(label, axis=-1)
-        else:
-            label = label.reshape(len(ds), -1)[:, 0]
-        return float(np.mean(pred.astype(np.int64) == label.astype(np.int64)))
+        pred = _class_indices(ds[self.prediction_col], len(ds))
+        label = _class_indices(ds[self.label_col], len(ds))
+        return float(np.mean(pred == label))
 
 
 class LossEvaluator:
@@ -48,3 +40,102 @@ class LossEvaluator:
 
     def evaluate(self, ds: Dataset) -> float:
         return float(self.loss_fn(ds[self.label_col], ds[self.prediction_col]))
+
+
+def _class_indices(arr, n_rows: int) -> np.ndarray:
+    """Scores [N, C] → argmax; one-hot → argmax; integers pass through."""
+    arr = np.asarray(arr)
+    if arr.ndim > 1 and arr.shape[-1] > 1:
+        return np.argmax(arr, axis=-1).astype(np.int64)
+    return np.round(arr.reshape(n_rows, -1)[:, 0]).astype(np.int64)
+
+
+class FScoreEvaluator:
+    """Precision / recall / F1 (beyond the reference's accuracy-only module).
+
+    ``average="binary"`` scores class ``pos_label`` only; ``"macro"``
+    averages the per-class scores unweighted over the classes present in
+    the labels. Zero-division cases score 0, sklearn-style.
+    """
+
+    def __init__(self, metric: str = "f1", average: str = "binary",
+                 pos_label: int = 1, prediction_col: str = "prediction",
+                 label_col: str = "label"):
+        if metric not in ("f1", "precision", "recall"):
+            raise ValueError(
+                f"metric={metric!r}: expected 'f1', 'precision', or 'recall'"
+            )
+        if average not in ("binary", "macro"):
+            raise ValueError(
+                f"average={average!r}: expected 'binary' or 'macro'"
+            )
+        self.metric = metric
+        self.average = average
+        self.pos_label = int(pos_label)
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def _score_one(self, pred, label, cls: int) -> float:
+        tp = float(np.sum((pred == cls) & (label == cls)))
+        fp = float(np.sum((pred == cls) & (label != cls)))
+        fn = float(np.sum((pred != cls) & (label == cls)))
+        precision = tp / (tp + fp) if tp + fp else 0.0
+        recall = tp / (tp + fn) if tp + fn else 0.0
+        if self.metric == "precision":
+            return precision
+        if self.metric == "recall":
+            return recall
+        if precision + recall == 0.0:
+            return 0.0
+        return 2 * precision * recall / (precision + recall)
+
+    def evaluate(self, ds: Dataset) -> float:
+        pred = _class_indices(ds[self.prediction_col], len(ds))
+        label = _class_indices(ds[self.label_col], len(ds))
+        if self.average == "binary":
+            return self._score_one(pred, label, self.pos_label)
+        classes = np.unique(label)
+        return float(np.mean(
+            [self._score_one(pred, label, int(c)) for c in classes]
+        ))
+
+
+class AUCEvaluator:
+    """Binary ROC AUC from a score column (rank statistic, ties averaged).
+
+    The prediction column may hold a single score per row or ``[N, 2]``
+    class scores (the positive-class column is used).
+    """
+
+    def __init__(self, prediction_col: str = "prediction",
+                 label_col: str = "label", pos_label: int = 1):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+        self.pos_label = int(pos_label)
+
+    def evaluate(self, ds: Dataset) -> float:
+        scores = np.asarray(ds[self.prediction_col], np.float64)
+        if scores.ndim > 1:
+            scores = (scores[:, self.pos_label] if scores.shape[-1] == 2
+                      else scores.reshape(len(ds), -1)[:, 0])
+        label = _class_indices(ds[self.label_col], len(ds))
+        pos = label == self.pos_label
+        n_pos, n_neg = int(pos.sum()), int((~pos).sum())
+        if not n_pos or not n_neg:
+            raise ValueError(
+                f"AUC needs both classes; got {n_pos} positive / "
+                f"{n_neg} negative rows"
+            )
+        # Mann-Whitney U via average ranks (handles ties exactly)
+        order = np.argsort(scores, kind="mergesort")
+        ranks = np.empty(len(scores), np.float64)
+        sorted_scores = scores[order]
+        i = 0
+        while i < len(scores):
+            j = i
+            while j + 1 < len(scores) and sorted_scores[j + 1] == sorted_scores[i]:
+                j += 1
+            ranks[order[i:j + 1]] = 0.5 * (i + j) + 1.0
+            i = j + 1
+        u = ranks[pos].sum() - n_pos * (n_pos + 1) / 2.0
+        return float(u / (n_pos * n_neg))

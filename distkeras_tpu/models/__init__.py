@@ -10,7 +10,10 @@ five BASELINE configs map to:
 - :func:`lstm_classifier` — IMDB LSTM sentiment (config 5);
 - :func:`transformer_classifier` — beyond-reference long-context family whose
   attention math is shared with ``parallel.ring_attention`` (sequence
-  parallelism).
+  parallelism);
+- :func:`resnet_small` — beyond-reference batch-norm family: BatchNorm
+  running stats ride the engines' non-trainable-state path (per-worker
+  stats, the standard data-parallel BN).
 
 All models emit **logits** (pair with the ``softmax_cross_entropy`` family) and
 default to bfloat16 activations with float32 parameters — bf16 keeps matmuls
@@ -25,6 +28,7 @@ from distkeras_tpu.models.moe import (
     MoETransformerClassifier,
     moe_transformer_classifier,
 )
+from distkeras_tpu.models.resnet import ResNetSmall, resnet_small
 from distkeras_tpu.models.transformer import (
     TransformerClassifier,
     pipelined_transformer_forward,
@@ -36,6 +40,7 @@ __all__ = [
     "LeNet", "lenet",
     "VGGSmall", "vgg_small",
     "LSTMClassifier", "lstm_classifier",
+    "ResNetSmall", "resnet_small",
     "TransformerClassifier", "transformer_classifier",
     "pipelined_transformer_forward",
     "MoETransformerClassifier", "moe_transformer_classifier",

@@ -1,0 +1,83 @@
+"""ResNet family — the batch-norm model, exercising non-trainable state.
+
+Beyond-reference addition (the reference zoo stops at 2016-era MLP/CNN/LSTM,
+SURVEY.md §2b #19): a small CIFAR-style residual network whose BatchNorm
+running statistics flow through the frameworks's non-trainable state path —
+per-worker stats are carried in the stacked ``nt`` pytree by the local-SGD
+engine (one independent set per replica, as in standard data-parallel BN),
+and updated through the ``mutable=["batch_stats"]`` seam in
+:func:`distkeras_tpu.model.from_flax`.
+
+TPU notes: convs in bf16 (``use_bias=False`` under BN, the standard fusion),
+BN statistics in f32 for numerical stability; everything static-shaped.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.model import ModelSpec, from_flax
+
+
+class ResidualBlock(nn.Module):
+    filters: int
+    strides: tuple = (1, 1)
+    dtype: jnp.dtype = jnp.bfloat16
+    bn_momentum: float = 0.9
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        bn = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=not training, momentum=self.bn_momentum,
+            dtype=jnp.float32, name=name,
+        )
+        h = nn.Conv(self.filters, (3, 3), strides=self.strides,
+                    padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        h = bn("bn1")(h.astype(jnp.float32))
+        h = nn.relu(h)
+        h = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(h.astype(self.dtype))
+        h = bn("bn2")(h.astype(jnp.float32))
+        if x.shape[-1] != self.filters or self.strides != (1, 1):
+            x = nn.Conv(self.filters, (1, 1), strides=self.strides,
+                        use_bias=False, dtype=self.dtype,
+                        name="proj")(x.astype(self.dtype))
+            x = bn("bn_proj")(x.astype(jnp.float32))
+        return nn.relu(x + h)
+
+
+class ResNetSmall(nn.Module):
+    """ResNet-8-style CIFAR network: stem + 3 stages of residual blocks."""
+
+    num_classes: int = 10
+    widths: tuple = (16, 32, 64)
+    blocks_per_stage: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = nn.Conv(self.widths[0], (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, name="stem")(x.astype(self.dtype))
+        x = nn.BatchNorm(use_running_average=not training, momentum=0.9,
+                         dtype=jnp.float32, name="bn_stem")(
+            x.astype(jnp.float32))
+        x = nn.relu(x)
+        for i, w in enumerate(self.widths):
+            for b in range(self.blocks_per_stage):
+                strides = (2, 2) if (i > 0 and b == 0) else (1, 1)
+                x = ResidualBlock(filters=w, strides=strides,
+                                  dtype=self.dtype,
+                                  name=f"stage{i}_block{b}")(x, training)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x.astype(self.dtype))
+        return x.astype(jnp.float32)
+
+
+def resnet_small(num_classes: int = 10, input_shape=(32, 32, 3),
+                 widths=(16, 32, 64), blocks_per_stage: int = 1,
+                 dtype=jnp.bfloat16) -> ModelSpec:
+    module = ResNetSmall(num_classes=num_classes, widths=tuple(widths),
+                         blocks_per_stage=blocks_per_stage, dtype=dtype)
+    example = jnp.zeros((1,) + tuple(input_shape), jnp.float32)
+    return from_flax(module, example, name="resnet_small")

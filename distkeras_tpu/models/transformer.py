@@ -122,13 +122,22 @@ class TransformerClassifier(nn.Module):
     attn_impl: str = "reference"
     sp_axis: str | None = None   # set (with sp_size) for attn_impl="ring"
     sp_size: int | None = None
+    #: rematerialize each block's activations in the backward pass
+    #: (jax.checkpoint): ~L·dim per block of saved activations traded for
+    #: one extra forward — the standard long-context memory lever
+    remat: bool = False
 
     def setup(self):
         self.embed = nn.Embed(self.vocab, self.dim, dtype=self.dtype)
+        # nn.remat preserves the params tree (blocks_i names unchanged), so
+        # checkpoints/megatron specs/pipelining all work regardless of remat;
+        # training (arg 3, counting self as 0) is a static python bool
+        block_cls = (nn.remat(EncoderBlock, static_argnums=(3,))
+                     if self.remat else EncoderBlock)
         self.blocks = [
-            EncoderBlock(dim=self.dim, heads=self.heads, causal=self.causal,
-                         dtype=self.dtype, attn_impl=self.attn_impl,
-                         sp_axis=self.sp_axis, sp_size=self.sp_size)
+            block_cls(dim=self.dim, heads=self.heads, causal=self.causal,
+                      dtype=self.dtype, attn_impl=self.attn_impl,
+                      sp_axis=self.sp_axis, sp_size=self.sp_size)
             for _ in range(self.depth)
         ]
         self.ln_head = nn.LayerNorm(dtype=jnp.float32)
@@ -283,11 +292,12 @@ def _sp_forward_fn(smod, mesh, axis, batch_axis=None):
 def transformer_classifier(vocab=20000, maxlen=200, dim=128, heads=4, depth=2,
                            num_classes=2, causal=False,
                            dtype=jnp.bfloat16,
-                           attn_impl="reference") -> ModelSpec:
+                           attn_impl="reference",
+                           remat=False) -> ModelSpec:
     module = TransformerClassifier(
         vocab=vocab, maxlen=maxlen, dim=dim, heads=heads, depth=depth,
         num_classes=num_classes, causal=causal, dtype=dtype,
-        attn_impl=attn_impl,
+        attn_impl=attn_impl, remat=remat,
     )
     example = (
         jnp.zeros((1, maxlen), jnp.int32),

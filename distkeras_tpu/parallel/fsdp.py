@@ -90,10 +90,11 @@ class FSDPEngine(SPMDEngine):
 
     def __init__(self, spec, loss_step, optimizer, mesh, dp_axis="dp",
                  tp_axis="tp", tensor_parallel=False,
-                 min_size: int = DEFAULT_MIN_SIZE, param_specs=None):
+                 min_size: int = DEFAULT_MIN_SIZE, param_specs=None,
+                 grad_accum: int = 1):
         super().__init__(spec, loss_step, optimizer, mesh,
                          param_specs=param_specs, dp_axis=dp_axis,
-                         tp_axis=tp_axis)
+                         tp_axis=tp_axis, grad_accum=grad_accum)
         self.tensor_parallel = bool(tensor_parallel)
         self.min_size = int(min_size)
 

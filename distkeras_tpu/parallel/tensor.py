@@ -20,6 +20,7 @@ on an 8-device dp×tp mesh).
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -120,17 +121,27 @@ class SPMDEngine:
     training on the global batch.
 
     ``loss_step(params, nt, batch) -> (loss, new_nt)`` as elsewhere.
+
+    ``grad_accum=A`` splits each global batch into A equal microbatches and
+    accumulates their gradients in a ``lax.scan`` before the single optimizer
+    update — activation memory drops ~A× while the update stays the
+    full-batch one (exactly, for mean losses over equal microbatches; pinned
+    by tests/test_fsdp.py). The scan carry holds one grads-sized buffer, not
+    A of them.
     """
 
     def __init__(self, spec, loss_step, optimizer, mesh: Mesh,
                  param_specs=None, dp_axis: str = "dp",
-                 tp_axis: str = "tp"):
+                 tp_axis: str = "tp", grad_accum: int = 1):
         self.spec = spec
         self.loss_step = loss_step
         self.optimizer = optimizer
         self.mesh = mesh
         self.dp_axis = dp_axis
         self.tp_axis = tp_axis
+        self.grad_accum = int(grad_accum)
+        if self.grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         self.param_specs = param_specs  # resolved at init_state
         self._batch_sharding = batch_sharding(mesh, dp_axis)
         self._step = None
@@ -180,11 +191,39 @@ class SPMDEngine:
     def _build_step(self):
         tx, loss_step = self.optimizer, self.loss_step
         mesh, specs = self.mesh, self.param_specs
+        A, dp_axis = self.grad_accum, self.dp_axis
+
+        def grads_of(params, nt, batch):
+            if A == 1:
+                return jax.value_and_grad(loss_step, has_aux=True)(
+                    params, nt, batch
+                )
+            # [B, …] → [A, B/A, …], microbatch dim sharded over dp
+            mb_sh = NamedSharding(mesh, P(None, dp_axis))
+            mbs = jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x.reshape((A, x.shape[0] // A) + x.shape[1:]), mb_sh
+                ),
+                batch,
+            )
+
+            def micro(carry, mb):
+                nt_c, acc, loss_sum = carry
+                (loss, new_nt), g = jax.value_and_grad(
+                    loss_step, has_aux=True
+                )(params, nt_c, mb)
+                acc = jax.tree.map(jnp.add, acc, g)
+                return (new_nt, acc, loss_sum + loss), None
+
+            zero = jax.tree.map(jnp.zeros_like, params)
+            (nt, acc, loss_sum), _ = jax.lax.scan(
+                micro, (nt, zero, jnp.zeros((), jnp.float32)), mbs
+            )
+            grads = jax.tree.map(lambda g: g / A, acc)
+            return (loss_sum / A, nt), grads
 
         def step(params, nt, opt_state, batch):
-            (loss, new_nt), grads = jax.value_and_grad(
-                loss_step, has_aux=True
-            )(params, nt, batch)
+            (loss, new_nt), grads = grads_of(params, nt, batch)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             # pin the output layout so donation reuses the input buffers
@@ -206,6 +245,11 @@ class SPMDEngine:
             raise ValueError(
                 f"global batch size {B} not divisible by mesh axis "
                 f"'{self.dp_axis}' of size {dp}"
+            )
+        if B % (self.grad_accum * dp):
+            raise ValueError(
+                f"global batch size {B} not divisible by grad_accum "
+                f"{self.grad_accum} × dp {dp} = {self.grad_accum * dp}"
             )
         batch = tuple(
             jax.device_put(a, self._batch_sharding) for a in batch_arrays

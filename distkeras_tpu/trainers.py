@@ -33,6 +33,7 @@ import time
 from typing import Any, Callable, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
 
@@ -307,7 +308,20 @@ class DistributedTrainer(Trainer):
 
             if ckpt.latest_step(self.checkpoint_dir) is not None:
                 payload, step = ckpt.restore_checkpoint(self.checkpoint_dir)
-                state = engine.init_state_from(payload["state"])
+                host_state = payload["state"]
+                w_leaves = jax.tree.leaves(host_state.workers)
+                ckpt_w = w_leaves[0].shape[0] if w_leaves else self.num_workers
+                if ckpt_w == self.num_workers:
+                    state = engine.init_state_from(host_state)
+                else:
+                    # Elastic resume (beyond-reference failure recovery,
+                    # SURVEY.md §5.3): the checkpointed center is the model;
+                    # re-broadcast it into a fresh W-worker state. Worker-
+                    # local divergence and optimizer moments restart — the
+                    # honest semantics when the replica count changes.
+                    nt0 = jax.tree.map(lambda x: x[0], host_state.nt)
+                    state = engine.init_state(host_state.center, nt0)
+                    state = state.replace(step=jnp.asarray(host_state.step))
                 start_epoch = int(payload["epoch"]) + 1
         cols = self.features_col + [self.label_col]
 
@@ -549,12 +563,17 @@ class MeshTrainer(Trainer):
       state sharded by propagation (:mod:`distkeras_tpu.parallel.fsdp`);
     - ``"fsdp+megatron"`` — Megatron over ``tp`` first, FSDP shards the
       remaining dims over ``dp``.
+
+    ``grad_accum=A`` accumulates gradients over A equal microbatches per
+    optimizer update (a ``lax.scan`` inside the jitted step) — ~A× less
+    activation memory at the same effective batch size.
     """
 
     def __init__(self, keras_model, loss="sparse_softmax_cross_entropy",
                  worker_optimizer="adam", learning_rate: float = 1e-3,
                  mesh=None, mesh_shape: dict | None = None, param_specs=None,
                  parameter_sharding: str = "megatron",
+                 grad_accum: int = 1,
                  batch_size: int = 32, features_col="features",
                  label_col: str = "label", num_epoch: int = 1, seed: int = 0,
                  log_metrics: bool = False):
@@ -572,6 +591,7 @@ class MeshTrainer(Trainer):
                 f"'megatron', 'fsdp', or 'fsdp+megatron'"
             )
         self.parameter_sharding = parameter_sharding
+        self.grad_accum = int(grad_accum)
         self.batch_size = int(batch_size)
         self.features_col: list[str] = _as_cols(features_col)
         self.label_col = label_col
@@ -592,12 +612,13 @@ class MeshTrainer(Trainer):
         )
         if self.parameter_sharding == "megatron":
             engine = SPMDEngine(self.spec, loss_step, optimizer, self.mesh,
-                                param_specs=self.param_specs)
+                                param_specs=self.param_specs,
+                                grad_accum=self.grad_accum)
         else:
             engine = FSDPEngine(
                 self.spec, loss_step, optimizer, self.mesh,
                 tensor_parallel=(self.parameter_sharding == "fsdp+megatron"),
-                param_specs=self.param_specs,
+                param_specs=self.param_specs, grad_accum=self.grad_accum,
             )
         params, nt, opt = engine.init_state(*self.spec.init_np(self.seed))
 

@@ -60,3 +60,9 @@ def test_analytic_flop_models():
     assert 10 < bench.vgg_small_flops() / bench.lenet_flops() < 16
     # LSTM: 200 steps × 8·H·(E+H)
     assert bench.lstm_flops() == 3 * (200 * 8 * 128 * 256 + 2 * 128 * 2)
+
+
+def test_transformer_flop_model():
+    d, depth, L = 512, 8, 2048
+    assert bench.transformer_flops_per_token(d, depth, L) == \
+        3 * depth * (24 * d * d + 4 * L * d)

@@ -20,5 +20,5 @@ def test_parallelism_example_runs_all_strategies():
         env=env, capture_output=True, text=True, timeout=900,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
-    for tag in ("[dp]", "[tp]", "[pp]", "[sp]", "[ep]"):
+    for tag in ("[dp]", "[tp]", "[fsdp]", "[pp]", "[sp]", "[ep]"):
         assert tag in proc.stdout, (tag, proc.stdout)

@@ -215,3 +215,44 @@ def _plain_step(ls, tx, params, nt, opt, b):
     )
     updates, opt = tx.update(grads, opt, params)
     return optax.apply_updates(params, updates), new_nt, opt, loss
+
+
+def test_grad_accum_matches_full_batch(rng):
+    """grad_accum=4 must produce the same update as the full-batch step
+    (mean loss over equal microbatches), on both engines."""
+    from distkeras_tpu.parallel.tensor import SPMDEngine
+
+    mesh = get_mesh_nd({"dp": 2, "tp": 4})
+    spec = small_transformer()
+    ls = transformer_loss(spec)
+    tx = optax.sgd(0.05, momentum=0.9)
+    b = tbatch(rng, B=16)
+
+    ref_e = SPMDEngine(spec, ls, tx, mesh)
+    rp, rnt, ropt = ref_e.init_state(*spec.init_np(0))
+    rp, rnt, ropt, ref_loss = ref_e.run_step(rp, rnt, ropt, b)
+
+    acc_e = SPMDEngine(spec, ls, tx, mesh, grad_accum=4)
+    ap, ant, aopt = acc_e.init_state(*spec.init_np(0))
+    ap, ant, aopt, acc_loss = acc_e.run_step(ap, ant, aopt, b)
+
+    np.testing.assert_allclose(float(acc_loss), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+    for r, g in zip(jax.tree.leaves(jax.device_get(rp)),
+                    jax.tree.leaves(jax.device_get(ap))):
+        np.testing.assert_allclose(g, r, rtol=3e-4, atol=3e-5)
+
+    # FSDP engine too
+    f_e = FSDPEngine(spec, ls, tx, mesh, min_size=0, grad_accum=4)
+    fp, fnt, fopt = f_e.init_state(*spec.init_np(0))
+    fp, fnt, fopt, f_loss = f_e.run_step(fp, fnt, fopt, b)
+    np.testing.assert_allclose(float(f_loss), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+
+    # indivisible batch → clear error
+    import pytest
+
+    with pytest.raises(ValueError, match="grad_accum"):
+        bad = SPMDEngine(spec, ls, tx, mesh, grad_accum=3)
+        bp, bnt, bopt = bad.init_state(*spec.init_np(0))
+        bad.run_step(bp, bnt, bopt, b)

@@ -94,3 +94,77 @@ def test_loss_evaluator_mse():
         "label": np.array([0.0, 2.0], np.float32),
     })
     assert LossEvaluator("mse").evaluate(ds) == pytest.approx(0.5)
+
+
+def test_fscore_evaluator_binary_and_macro():
+    from distkeras_tpu.evaluators import FScoreEvaluator
+
+    # pred:  1 1 0 0 1 ; label: 1 0 0 1 1 → tp=2 fp=1 fn=1
+    ds = Dataset({
+        "prediction": np.array([1, 1, 0, 0, 1], np.int64),
+        "label": np.array([1, 0, 0, 1, 1], np.int64),
+    })
+    assert FScoreEvaluator("precision").evaluate(ds) == pytest.approx(2 / 3)
+    assert FScoreEvaluator("recall").evaluate(ds) == pytest.approx(2 / 3)
+    assert FScoreEvaluator("f1").evaluate(ds) == pytest.approx(2 / 3)
+    # class 0: tp=1 fp=1 fn=1 → p=r=f1=1/2; macro = (2/3 + 1/2) / 2
+    assert FScoreEvaluator("f1", average="macro").evaluate(ds) == \
+        pytest.approx((2 / 3 + 0.5) / 2)
+    # score-matrix predictions argmax the same way AccuracyEvaluator does
+    scores = np.zeros((5, 2), np.float32)
+    scores[np.arange(5), [1, 1, 0, 0, 1]] = 1.0
+    ds2 = Dataset({"prediction": scores, "label": ds["label"]})
+    assert FScoreEvaluator("f1").evaluate(ds2) == pytest.approx(2 / 3)
+    with pytest.raises(ValueError, match="metric"):
+        FScoreEvaluator("jaccard")
+
+
+def test_auc_evaluator():
+    from distkeras_tpu.evaluators import AUCEvaluator
+
+    # perfect ranking → AUC 1; anti-ranking → 0; random-ish hand case
+    ds = Dataset({
+        "prediction": np.array([0.9, 0.8, 0.2, 0.1], np.float32),
+        "label": np.array([1, 1, 0, 0], np.int64),
+    })
+    assert AUCEvaluator().evaluate(ds) == pytest.approx(1.0)
+    ds_rev = Dataset({
+        "prediction": np.array([0.1, 0.2, 0.8, 0.9], np.float32),
+        "label": np.array([1, 1, 0, 0], np.int64),
+    })
+    assert AUCEvaluator().evaluate(ds_rev) == pytest.approx(0.0)
+    # one discordant pair of 4: AUC = 3/4; ties average to 0.5
+    ds_mid = Dataset({
+        "prediction": np.array([0.9, 0.3, 0.5, 0.1], np.float32),
+        "label": np.array([1, 1, 0, 0], np.int64),
+    })
+    assert AUCEvaluator().evaluate(ds_mid) == pytest.approx(0.75)
+    ds_tie = Dataset({
+        "prediction": np.array([0.5, 0.5], np.float32),
+        "label": np.array([1, 0], np.int64),
+    })
+    assert AUCEvaluator().evaluate(ds_tie) == pytest.approx(0.5)
+    # [N, 2] score matrices use the positive column
+    ds_mat = Dataset({
+        "prediction": np.array([[0.1, 0.9], [0.8, 0.2]], np.float32),
+        "label": np.array([1, 0], np.int64),
+    })
+    assert AUCEvaluator().evaluate(ds_mat) == pytest.approx(1.0)
+    with pytest.raises(ValueError, match="both classes"):
+        AUCEvaluator().evaluate(Dataset({
+            "prediction": np.array([0.5, 0.6], np.float32),
+            "label": np.array([1, 1], np.int64),
+        }))
+
+
+def test_auc_evaluator_pos_label_zero():
+    """Regression: with [N, 2] score matrices the pos_label column must be
+    used — a perfect class-0 classifier scores AUC 1, not 0."""
+    from distkeras_tpu.evaluators import AUCEvaluator
+
+    ds = Dataset({
+        "prediction": np.array([[0.9, 0.1], [0.2, 0.8]], np.float32),
+        "label": np.array([0, 1], np.int64),
+    })
+    assert AUCEvaluator(pos_label=0).evaluate(ds) == pytest.approx(1.0)
+    assert AUCEvaluator(pos_label=1).evaluate(ds) == pytest.approx(1.0)
