@@ -72,13 +72,30 @@ def lstm_flops(maxlen=200, embed=128, hidden=128):
     return 3 * fwd
 
 
+#: bf16 peak FLOP/s by device-kind substring (first match wins; order puts
+#: the more specific names first). Override with DISTKERAS_PEAK_TFLOPS.
+_PEAK_BF16 = (
+    ("v6e", 918e12),      # Trillium
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),  # v5e reports device_kind "TPU v5 lite"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
 def peak_flops(device) -> float | None:
     if device.platform != "tpu":
         return None
     env = os.environ.get("DISTKERAS_PEAK_TFLOPS")
     if env:
         return float(env) * 1e12
-    return 197e12  # TPU v5e bf16 peak
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_BF16:
+        if key in kind:
+            return val
+    return 197e12  # unknown TPU: assume v5e-class
 
 
 # ---------------------------------------------------------------------------

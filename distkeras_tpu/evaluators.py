@@ -101,10 +101,11 @@ class FScoreEvaluator:
 
 
 class AUCEvaluator:
-    """Binary ROC AUC from a score column (rank statistic, ties averaged).
+    """ROC AUC from a score column (rank statistic, ties averaged).
 
-    The prediction column may hold a single score per row or ``[N, 2]``
-    class scores (the positive-class column is used).
+    The prediction column may hold a single score per row or ``[N, C]``
+    class scores — the ``pos_label`` column is the score and rows with
+    ``label == pos_label`` are the positives (one-vs-rest for C > 2).
     """
 
     def __init__(self, prediction_col: str = "prediction",
@@ -115,9 +116,15 @@ class AUCEvaluator:
 
     def evaluate(self, ds: Dataset) -> float:
         scores = np.asarray(ds[self.prediction_col], np.float64)
-        if scores.ndim > 1:
-            scores = (scores[:, self.pos_label] if scores.shape[-1] == 2
-                      else scores.reshape(len(ds), -1)[:, 0])
+        if scores.ndim > 1 and scores.shape[-1] > 1:
+            if self.pos_label >= scores.shape[-1]:
+                raise ValueError(
+                    f"pos_label {self.pos_label} out of range for "
+                    f"[N, {scores.shape[-1]}] score matrix"
+                )
+            scores = scores[:, self.pos_label]
+        else:
+            scores = scores.reshape(len(ds))
         label = _class_indices(ds[self.label_col], len(ds))
         pos = label == self.pos_label
         n_pos, n_neg = int(pos.sum()), int((~pos).sum())
@@ -126,16 +133,14 @@ class AUCEvaluator:
                 f"AUC needs both classes; got {n_pos} positive / "
                 f"{n_neg} negative rows"
             )
-        # Mann-Whitney U via average ranks (handles ties exactly)
+        # Mann-Whitney U via tie-averaged ranks, fully vectorized: each tie
+        # group gets rank first_index + (count-1)/2 + 1
         order = np.argsort(scores, kind="mergesort")
-        ranks = np.empty(len(scores), np.float64)
-        sorted_scores = scores[order]
-        i = 0
-        while i < len(scores):
-            j = i
-            while j + 1 < len(scores) and sorted_scores[j + 1] == sorted_scores[i]:
-                j += 1
-            ranks[order[i:j + 1]] = 0.5 * (i + j) + 1.0
-            i = j + 1
+        s = scores[order]
+        uniq_first = np.flatnonzero(np.r_[True, s[1:] != s[:-1]])
+        counts = np.diff(np.append(uniq_first, len(s)))
+        group_rank = uniq_first + (counts - 1) / 2.0 + 1.0
+        ranks = np.empty(len(s), np.float64)
+        ranks[order] = np.repeat(group_rank, counts)
         u = ranks[pos].sum() - n_pos * (n_pos + 1) / 2.0
         return float(u / (n_pos * n_neg))

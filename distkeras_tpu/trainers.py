@@ -210,7 +210,8 @@ class DistributedTrainer(Trainer):
                  ps_transport: str = "inprocess", ps_port: int = 0,
                  checkpoint_dir=None, checkpoint_every: int = 1,
                  resume: bool = False, profile_dir=None,
-                 log_metrics: bool = False):
+                 log_metrics: bool = False,
+                 tolerate_worker_failures: bool = False):
         super().__init__(keras_model, loss, worker_optimizer,
                          learning_rate=learning_rate, seed=seed)
         self.mesh = mesh if mesh is not None else get_mesh(num_workers)
@@ -262,6 +263,12 @@ class DistributedTrainer(Trainer):
         # to stdout and records the same in the history.
         self.profile_dir = profile_dir
         self.log_metrics = bool(log_metrics)
+        # Failure tolerance (beyond-reference, SURVEY.md §5.3 — the reference
+        # delegated retry wholesale to Spark): on the PS backend, True lets
+        # surviving hogwild workers finish the run when a peer dies (the run
+        # still fails if every worker dies). The collective backend is one
+        # SPMD program, so partial failure doesn't apply there.
+        self.tolerate_worker_failures = bool(tolerate_worker_failures)
 
     # -- seams kept from the reference ------------------------------------
 

@@ -69,7 +69,8 @@ class AsyncWorker:
                  rule, window: int, batch_size: int, nt, history, lock,
                  barrier: threading.Barrier | None = None,
                  ckpt_pred=None,
-                 restore: dict | None = None, start_epoch: int = 0):
+                 restore: dict | None = None, start_epoch: int = 0,
+                 tolerant: bool = False):
         self.worker_id = worker_id
         self.device = device
         self.window_fn = window_fn
@@ -91,6 +92,7 @@ class AsyncWorker:
         self.ckpt_pred = ckpt_pred
         self.restore = restore
         self.start_epoch = int(start_epoch)
+        self.tolerant = bool(tolerant)
         self.snapshot: dict | None = None
         self.error: BaseException | None = None
 
@@ -182,7 +184,15 @@ class AsyncWorker:
                     # would bloat every checkpoint by W unused model copies
                     self.snapshot["params"] = utils.tree_to_numpy(params)
                 self._epoch_done = epoch
-                self.barrier.wait()  # one thread runs the checkpoint action
+                try:
+                    self.barrier.wait()  # one thread runs the ckpt action
+                except threading.BrokenBarrierError:
+                    if not self.tolerant:
+                        raise  # fail fast: the driver will raise anyway
+                    # a tolerated peer death aborted the rendezvous: keep
+                    # training without further checkpoints rather than
+                    # dying with it
+                    self.barrier = None
         self.final_nt = utils.tree_to_numpy(nt)
 
 
@@ -210,13 +220,12 @@ def run_async_training(trainer, ds, shuffle: bool):
         if ckpt.latest_step(ckpt_dir) is not None:
             payload, step = ckpt.restore_checkpoint(ckpt_dir)
             saved_workers = payload["workers"]
-            if len(saved_workers) != W:
-                raise ValueError(
-                    f"checkpoint has {len(saved_workers)} workers, trainer "
-                    f"expects {W}"
-                )
             params = payload["center"]
-            restores = list(saved_workers)
+            if len(saved_workers) == W:
+                restores = list(saved_workers)
+            # else: elastic resume (same semantics as the collective
+            # backend's) — the checkpointed center is the model; the new
+            # worker count starts with fresh per-worker state from it
             restored_updates = int(payload.get("num_updates", 0))
             start_epoch = int(payload["epoch"]) + 1
 
@@ -285,6 +294,7 @@ def run_async_training(trainer, ds, shuffle: bool):
             trainer.batch_size, nt, history, hlock,
             barrier=barrier, ckpt_pred=ckpt_pred,
             restore=restores[i], start_epoch=start_epoch,
+            tolerant=getattr(trainer, "tolerate_worker_failures", False),
         )
         for i in range(W)
     ]
@@ -317,9 +327,23 @@ def run_async_training(trainer, ds, shuffle: bool):
         # a BrokenBarrierError is a symptom of a peer's failure — surface the
         # root cause first
         errors.sort(key=lambda e: isinstance(e, threading.BrokenBarrierError))
-        raise errors[0]
+        survivors = sum(1 for w in workers if w.error is None)
+        if not getattr(trainer, "tolerate_worker_failures", False):
+            raise errors[0]
+        if survivors == 0:
+            raise errors[0]  # tolerating failures, but nobody survived
+        import warnings
 
-    final_nt = getattr(workers[0], "final_nt", nt)
+        warnings.warn(
+            f"{len(errors)} of {W} PS workers failed "
+            f"({type(errors[0]).__name__}: {errors[0]}); center trained by "
+            f"the {survivors} survivors",
+            stacklevel=2,
+        )
+
+    final_nt = next(
+        (w.final_nt for w in workers if hasattr(w, "final_nt")), nt
+    )
     return ps.get_model(), final_nt, history
 
 

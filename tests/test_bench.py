@@ -66,3 +66,22 @@ def test_transformer_flop_model():
     d, depth, L = 512, 8, 2048
     assert bench.transformer_flops_per_token(d, depth, L) == \
         3 * depth * (24 * d * d + 4 * L * d)
+
+
+def test_peak_flops_by_device_kind():
+    class Fake:
+        platform = "tpu"
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    assert bench.peak_flops(Fake("TPU v5 lite")) == 197e12
+    assert bench.peak_flops(Fake("TPU v5p")) == 459e12
+    assert bench.peak_flops(Fake("TPU v6e")) == 918e12
+    assert bench.peak_flops(Fake("TPU v4")) == 275e12
+    assert bench.peak_flops(Fake("TPU vNext")) == 197e12  # unknown default
+
+    class Cpu:
+        platform = "cpu"
+        device_kind = "cpu"
+
+    assert bench.peak_flops(Cpu()) is None

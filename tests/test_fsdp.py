@@ -256,3 +256,33 @@ def test_grad_accum_matches_full_batch(rng):
         bad = SPMDEngine(spec, ls, tx, mesh, grad_accum=3)
         bp, bnt, bopt = bad.init_state(*spec.init_np(0))
         bad.run_step(bp, bnt, bopt, b)
+
+
+def test_kitchen_sink_composition(rng):
+    """Everything at once: ZeRO-3 over dp × Megatron over tp, grad_accum=2,
+    remat=True — still exactly the single-device full-batch step."""
+    mesh = get_mesh_nd({"dp": 2, "tp": 4})
+    kw = dict(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS, depth=DEPTH,
+              num_classes=CLASSES, dtype=jnp.float32)
+    plain = transformer_classifier(**kw)
+    fancy = transformer_classifier(**kw, remat=True)
+    tx = optax.sgd(0.05, momentum=0.9)
+    b = tbatch(rng, B=16)
+
+    params, nt = plain.init_np(0)
+    opt = tx.init(params)
+    ls_plain = transformer_loss(plain)
+    params, nt, opt, ref_loss = jax.jit(
+        lambda p, n, o, bb: _plain_step(ls_plain, tx, p, n, o, bb)
+    )(params, nt, opt, b)
+
+    engine = FSDPEngine(fancy, transformer_loss(fancy), tx, mesh,
+                        tensor_parallel=True, grad_accum=2, min_size=0)
+    p2, nt2, opt2 = engine.init_state(*fancy.init_np(0))
+    p2, nt2, opt2, loss = engine.run_step(p2, nt2, opt2, b)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+    for r, g in zip(jax.tree.leaves(jax.device_get(params)),
+                    jax.tree.leaves(jax.device_get(p2))):
+        np.testing.assert_allclose(g, r, rtol=3e-4, atol=3e-5)

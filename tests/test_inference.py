@@ -168,3 +168,37 @@ def test_auc_evaluator_pos_label_zero():
     })
     assert AUCEvaluator(pos_label=0).evaluate(ds) == pytest.approx(1.0)
     assert AUCEvaluator(pos_label=1).evaluate(ds) == pytest.approx(1.0)
+
+
+def test_auc_evaluator_multiclass_one_vs_rest():
+    from distkeras_tpu.evaluators import AUCEvaluator
+
+    # 3-class scores; class 2's score perfectly separates label==2
+    scores = np.array([
+        [0.5, 0.3, 0.9],
+        [0.5, 0.3, 0.8],
+        [0.5, 0.3, 0.2],
+        [0.5, 0.3, 0.1],
+    ], np.float32)
+    labels = np.array([2, 2, 0, 1], np.int64)
+    ds = Dataset({"prediction": scores, "label": labels})
+    assert AUCEvaluator(pos_label=2).evaluate(ds) == pytest.approx(1.0)
+    with pytest.raises(ValueError, match="out of range"):
+        AUCEvaluator(pos_label=5).evaluate(ds)
+
+
+def test_auc_evaluator_large_n_vectorized():
+    from distkeras_tpu.evaluators import AUCEvaluator
+
+    rng = np.random.default_rng(0)
+    n = 200_000
+    label = (rng.random(n) < 0.5).astype(np.int64)
+    # noisy but informative scores, heavy ties via rounding
+    score = np.round(label * 0.3 + rng.random(n), 2).astype(np.float32)
+    ds = Dataset({"prediction": score, "label": label})
+    import time
+    t0 = time.perf_counter()
+    auc = AUCEvaluator().evaluate(ds)
+    dt = time.perf_counter() - t0
+    assert 0.7 < auc < 0.9
+    assert dt < 2.0, f"AUC took {dt:.2f}s for {n} rows"

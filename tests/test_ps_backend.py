@@ -147,3 +147,85 @@ def test_ps_backend_socket_transport_end_to_end():
              backend="ps", ps_transport="socket")
     t.train(ds, shuffle=True)
     assert final_loss(t) < 0.6
+
+
+def test_worker_failure_tolerated_when_opted_in(monkeypatch):
+    """tolerate_worker_failures=True: a dying hogwild worker is logged and
+    the survivors finish the run; default (False) re-raises the failure."""
+    from distkeras_tpu import DOWNPOUR
+    from distkeras_tpu import workers as workers_mod
+
+    orig = workers_mod.AsyncWorker._train
+
+    def dying(self, index, shard_cols, num_epoch, shuffle, seed):
+        if self.worker_id == 1:
+            raise RuntimeError("injected worker death")
+        return orig(self, index, shard_cols, num_epoch, shuffle, seed)
+
+    monkeypatch.setattr(workers_mod.AsyncWorker, "_train", dying)
+
+    ds = blobs_dataset(n=512)
+    kw = dict(loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+              learning_rate=0.05, num_workers=4, batch_size=16,
+              communication_window=2, num_epoch=2, backend="ps")
+
+    with pytest.raises(RuntimeError, match="injected worker death"):
+        DOWNPOUR(model_spec(), **kw).train(ds)
+
+    t = DOWNPOUR(model_spec(), tolerate_worker_failures=True, **kw)
+    with pytest.warns(UserWarning, match="1 of 4 PS workers failed"):
+        params = t.train(ds)
+    # survivors trained the center: loss decreased and params are usable
+    losses = [r["loss"] for r in t.get_history() if "loss" in r]
+    assert np.mean(losses[-5:]) < losses[0]
+    # no record from the dead worker after its injection point
+    assert all(r.get("worker") != 1 for r in t.get_history() if "loss" in r)
+    assert np.all(np.isfinite(np.concatenate(
+        [np.ravel(l) for l in __import__("jax").tree.leaves(params)])))
+
+
+def test_worker_failure_with_checkpointing_keeps_survivors(
+        monkeypatch, tmp_path):
+    """A death that breaks the checkpoint barrier must not deadlock or kill
+    the surviving workers when failures are tolerated."""
+    from distkeras_tpu import DOWNPOUR
+    from distkeras_tpu import workers as workers_mod
+
+    orig = workers_mod.AsyncWorker._train
+
+    def dying(self, index, shard_cols, num_epoch, shuffle, seed):
+        if self.worker_id == 0:
+            raise RuntimeError("early death")
+        return orig(self, index, shard_cols, num_epoch, shuffle, seed)
+
+    monkeypatch.setattr(workers_mod.AsyncWorker, "_train", dying)
+
+    ds = blobs_dataset(n=512)
+    t = DOWNPOUR(model_spec(), loss="sparse_softmax_cross_entropy",
+                 worker_optimizer="sgd", learning_rate=0.05, num_workers=4,
+                 batch_size=16, communication_window=2, num_epoch=3,
+                 backend="ps", checkpoint_dir=tmp_path / "ck",
+                 tolerate_worker_failures=True)
+    with pytest.warns(UserWarning, match="1 of 4 PS workers failed"):
+        t.train(ds)
+    losses = [r["loss"] for r in t.get_history() if "loss" in r]
+    assert len(losses) > 0 and np.all(np.isfinite(losses))
+
+
+def test_ps_backend_elastic_resume(tmp_path):
+    """A PS-backend checkpoint written at W=2 resumes at W=4 from the
+    center (same semantics as the collective backend's elastic resume)."""
+    from distkeras_tpu import DOWNPOUR
+
+    ds = blobs_dataset(n=512)
+    kw = dict(loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+              learning_rate=0.05, batch_size=16, communication_window=2,
+              backend="ps", checkpoint_dir=tmp_path / "ck")
+    t1 = DOWNPOUR(model_spec(), num_workers=2, num_epoch=2, **kw)
+    t1.train(ds)
+    t2 = DOWNPOUR(model_spec(), num_workers=4, num_epoch=4, resume=True,
+                  **kw)
+    t2.train(ds)
+    hist = [r for r in t2.get_history() if "loss" in r]
+    assert {r["epoch"] for r in hist} == {2, 3}  # epochs 0-1 from checkpoint
+    assert np.all(np.isfinite([r["loss"] for r in hist]))
