@@ -35,6 +35,10 @@ class ModelSpec:
     init: Callable[[jax.Array], tuple[Pytree, Pytree]]
     apply: Callable[[Pytree, Pytree, Any, bool], tuple[Any, Pytree]]
     name: str = "model"
+    #: the training-mode apply runs collectives over the collective backend's
+    #: stacked-worker vmap axis (e.g. sync BatchNorm) and therefore cannot
+    #: run on the PS backend's independent host threads
+    requires_worker_axis: bool = False
 
     def init_np(self, seed: int = 0) -> tuple[Pytree, Pytree]:
         """Host-side init convenience returning NumPy pytrees."""

@@ -25,12 +25,13 @@ class ResidualBlock(nn.Module):
     strides: tuple = (1, 1)
     dtype: jnp.dtype = jnp.bfloat16
     bn_momentum: float = 0.9
+    bn_axis_name: str | None = None  # set for cross-replica (sync) BN
 
     @nn.compact
     def __call__(self, x, training: bool = False):
         bn = lambda name: nn.BatchNorm(  # noqa: E731
             use_running_average=not training, momentum=self.bn_momentum,
-            dtype=jnp.float32, name=name,
+            dtype=jnp.float32, name=name, axis_name=self.bn_axis_name,
         )
         h = nn.Conv(self.filters, (3, 3), strides=self.strides,
                     padding="SAME", use_bias=False, dtype=self.dtype)(x)
@@ -54,13 +55,17 @@ class ResNetSmall(nn.Module):
     widths: tuple = (16, 32, 64)
     blocks_per_stage: int = 1
     dtype: jnp.dtype = jnp.bfloat16
+    #: e.g. parallel.local_sgd.WORKER_AXIS for sync BN across the stacked
+    #: workers of the collective backend (global-batch statistics)
+    bn_axis_name: str | None = None
 
     @nn.compact
     def __call__(self, x, training: bool = False):
         x = nn.Conv(self.widths[0], (3, 3), padding="SAME", use_bias=False,
                     dtype=self.dtype, name="stem")(x.astype(self.dtype))
         x = nn.BatchNorm(use_running_average=not training, momentum=0.9,
-                         dtype=jnp.float32, name="bn_stem")(
+                         dtype=jnp.float32, name="bn_stem",
+                         axis_name=self.bn_axis_name)(
             x.astype(jnp.float32))
         x = nn.relu(x)
         for i, w in enumerate(self.widths):
@@ -68,6 +73,7 @@ class ResNetSmall(nn.Module):
                 strides = (2, 2) if (i > 0 and b == 0) else (1, 1)
                 x = ResidualBlock(filters=w, strides=strides,
                                   dtype=self.dtype,
+                                  bn_axis_name=self.bn_axis_name,
                                   name=f"stage{i}_block{b}")(x, training)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.num_classes, dtype=self.dtype)(x.astype(self.dtype))
@@ -76,8 +82,17 @@ class ResNetSmall(nn.Module):
 
 def resnet_small(num_classes: int = 10, input_shape=(32, 32, 3),
                  widths=(16, 32, 64), blocks_per_stage: int = 1,
-                 dtype=jnp.bfloat16) -> ModelSpec:
+                 dtype=jnp.bfloat16, sync_bn: bool = False) -> ModelSpec:
+    """``sync_bn=True`` pmeans BN statistics over the collective backend's
+    stacked-worker axis (global-batch BN); collective backend only — the PS
+    backend's hogwild threads have no such axis to reduce over."""
+    from distkeras_tpu.parallel.local_sgd import WORKER_AXIS
+
     module = ResNetSmall(num_classes=num_classes, widths=tuple(widths),
-                         blocks_per_stage=blocks_per_stage, dtype=dtype)
+                         blocks_per_stage=blocks_per_stage, dtype=dtype,
+                         bn_axis_name=WORKER_AXIS if sync_bn else None)
     example = jnp.zeros((1,) + tuple(input_shape), jnp.float32)
-    return from_flax(module, example, name="resnet_small")
+    import dataclasses
+
+    spec = from_flax(module, example, name="resnet_small")
+    return dataclasses.replace(spec, requires_worker_axis=bool(sync_bn))

@@ -179,7 +179,8 @@ class TransformerClassifier(nn.Module):
 
 def pipelined_transformer_forward(module: TransformerClassifier, params,
                                   tokens, mask, mesh, axis: str = "pp",
-                                  microbatches: int | None = None):
+                                  microbatches: int | None = None,
+                                  batch_axis: str | None = None):
     """Transformer forward with the encoder blocks pipelined over ``axis``.
 
     Embed and head run replicated; the ``depth`` homogeneous blocks are the
@@ -212,7 +213,7 @@ def pipelined_transformer_forward(module: TransformerClassifier, params,
         return block.apply({"params": p}, h, m, False), m
 
     x, _ = pipeline_apply(stage, stage_params, (x, mask), mesh, axis=axis,
-                          microbatches=microbatches)
+                          microbatches=microbatches, batch_axis=batch_axis)
     return module.apply({"params": params}, x, mask,
                         method=TransformerClassifier.head_logits)
 

@@ -35,6 +35,13 @@ from distkeras_tpu.parallel.mesh import replicated_sharding, worker_sharding
 Pytree = Any
 LossStep = Callable[[Pytree, Pytree, tuple], tuple[jnp.ndarray, Pytree]]
 
+#: named axis bound to the stacked-worker vmap inside the window step.
+#: Models may run collectives over it — e.g. synchronized BatchNorm
+#: (``resnet_small(sync_bn=True)``) pmeans batch statistics across all
+#: workers, turning per-replica BN into global-batch BN. Collective-backend
+#: only (PS workers run in independent host threads with no such axis).
+WORKER_AXIS = "workers"
+
 
 @flax.struct.dataclass
 class TrainState:
@@ -163,9 +170,9 @@ class LocalSGDEngine:
             )
             return wparams, nt, opt, jnp.mean(losses)
 
-        workers, nt, opt, losses = jax.vmap(worker_window)(
-            state.workers, state.nt, state.opt_state, batch
-        )
+        workers, nt, opt, losses = jax.vmap(
+            worker_window, axis_name=WORKER_AXIS
+        )(state.workers, state.nt, state.opt_state, batch)
         center, workers = rule.merge(state.center, workers)
         new_state = TrainState(
             center=center,

@@ -81,7 +81,8 @@ def _pipeline_shard(sparams, x_mb, *, stage_fn, axis_name, n_stages,
 
 
 def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh,
-                   axis: str = "pp", microbatches: int | None = None):
+                   axis: str = "pp", microbatches: int | None = None,
+                   batch_axis: str | None = None):
     """Apply ``S`` chained stages to ``x``, pipelined over mesh axis ``axis``.
 
     - ``stage_fn(params_i, act) -> act`` — one stage; must preserve the
@@ -91,11 +92,17 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh,
       ``S == mesh.shape[axis]``; placed/sharded over ``axis`` here.
     - ``x`` — activation pytree; every leaf ``[B, …]`` with
       ``B % microbatches == 0``. Default ``microbatches = S``.
+    - ``batch_axis`` composes data parallelism on a 2-D mesh (e.g.
+      ``get_mesh_nd({"dp": 2, "pp": 4})``): each microbatch's rows shard
+      over ``batch_axis`` — every dp row runs the same pipeline on its
+      batch slice, stage params replicated over dp (their gradient psum
+      over dp comes from the shard_map transpose).
 
     Returns the output pytree ``[B, …]``, numerically equal to the sequential
     ``for i in range(S): x = stage_fn(params[i], x)`` (pinned by
-    tests/test_pipeline_parallel.py), replicated over the mesh. Differentiable
-    in both ``stage_params`` and ``x``.
+    tests/test_pipeline_parallel.py), replicated over ``axis`` (sharded over
+    ``batch_axis`` when given). Differentiable in both ``stage_params`` and
+    ``x``.
     """
     S = mesh.shape[axis]
     M = int(microbatches) if microbatches else S
@@ -111,12 +118,22 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh,
         )
 
     mb = B // M
+    if batch_axis is not None and batch_axis not in mesh.shape:
+        raise ValueError(
+            f"batch_axis {batch_axis!r} not in mesh axes "
+            f"{tuple(mesh.shape.keys())}"
+        )
+    if batch_axis is not None and mb % mesh.shape[batch_axis]:
+        raise ValueError(
+            f"microbatch rows {mb} not divisible by mesh axis "
+            f"'{batch_axis}' of size {mesh.shape[batch_axis]}"
+        )
     x_mb = jax.tree.map(
         lambda a: a.reshape((M, mb) + a.shape[1:]), x
     )
 
     pspec = jax.tree.map(lambda _: P(axis), stage_params)
-    xspec = jax.tree.map(lambda _: P(), x_mb)
+    xspec = jax.tree.map(lambda _: P(None, batch_axis), x_mb)
     body = functools.partial(
         _pipeline_shard, stage_fn=stage_fn, axis_name=axis, n_stages=S,
         n_micro=M,
@@ -124,7 +141,7 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh,
     fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=(pspec, xspec),
-        out_specs=jax.tree.map(lambda _: P(), x_mb),
+        out_specs=xspec,
         check_vma=False,
     )
     stage_params = jax.tree.map(

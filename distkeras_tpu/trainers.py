@@ -287,6 +287,15 @@ class DistributedTrainer(Trainer):
 
     def train(self, dataset, shuffle: bool = False):
         ds = self._coerce_dataset(dataset)
+        if self.backend == "ps" and getattr(self.spec,
+                                            "requires_worker_axis", False):
+            raise ValueError(
+                f"model '{self.spec.name}' runs collectives over the "
+                f"stacked-worker axis (e.g. sync_bn=True) and cannot train "
+                f"on backend='ps' — its hogwild workers are independent "
+                f"host threads; use backend='collective' or a per-worker "
+                f"variant of the model"
+            )
         ctx = (
             jax.profiler.trace(str(self.profile_dir))
             if self.profile_dir else contextlib.nullcontext()
@@ -325,7 +334,16 @@ class DistributedTrainer(Trainer):
                     # SURVEY.md §5.3): the checkpointed center is the model;
                     # re-broadcast it into a fresh W-worker state. Worker-
                     # local divergence and optimizer moments restart — the
-                    # honest semantics when the replica count changes.
+                    # honest semantics when the replica count changes. Warn
+                    # in case the count change was accidental.
+                    import warnings
+
+                    warnings.warn(
+                        f"elastic resume: checkpoint has {ckpt_w} workers, "
+                        f"trainer has {self.num_workers}; resuming from the "
+                        f"center with fresh per-worker optimizer state",
+                        stacklevel=2,
+                    )
                     nt0 = jax.tree.map(lambda x: x[0], host_state.nt)
                     state = engine.init_state(host_state.center, nt0)
                     state = state.replace(step=jnp.asarray(host_state.step))

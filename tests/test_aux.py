@@ -114,7 +114,9 @@ def test_ps_backend_resume_multiworker_smoke(tmp_path):
     assert np.all(np.isfinite(losses))
 
 
-def test_ps_backend_resume_worker_count_mismatch_raises(tmp_path):
+def test_ps_backend_resume_worker_count_mismatch_goes_elastic(tmp_path):
+    """A worker-count mismatch on PS resume is no longer fatal: it warns and
+    resumes elastically from the center (exact-resume state dropped)."""
     from distkeras_tpu import DOWNPOUR
 
     ds = blobs_dataset(n=512)
@@ -124,9 +126,12 @@ def test_ps_backend_resume_worker_count_mismatch_raises(tmp_path):
     d = tmp_path / "ck"
     DOWNPOUR(model_spec(), num_epoch=1, num_workers=2, checkpoint_dir=d,
              **common).train(ds)
-    with pytest.raises(ValueError, match="workers"):
-        DOWNPOUR(model_spec(), num_epoch=2, num_workers=4, checkpoint_dir=d,
-                 resume=True, **common).train(ds)
+    t = DOWNPOUR(model_spec(), num_epoch=2, num_workers=4, checkpoint_dir=d,
+                 resume=True, **common)
+    with pytest.warns(UserWarning, match="elastic resume"):
+        t.train(ds)
+    hist = [r for r in t.get_history() if "loss" in r]
+    assert {r["epoch"] for r in hist} == {1}
 
 
 def test_profiler_and_metrics_stream(tmp_path, capsys):
@@ -259,7 +264,9 @@ def test_trainer_elastic_resume_changes_worker_count(tmp_path):
 
     t2 = ADAG(model_spec(), num_epoch=4, num_workers=8, checkpoint_dir=d,
               resume=True, **common)
-    p = t2.train(ds)
+    import pytest
+    with pytest.warns(UserWarning, match="elastic resume"):
+        p = t2.train(ds)
     hist = [r for r in t2.get_history() if "loss" in r]
     losses = [r["loss"] for r in hist]
     assert np.all(np.isfinite(losses))

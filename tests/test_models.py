@@ -183,3 +183,74 @@ def test_resnet_batchnorm_trains_on_mesh():
     out, _ = model.apply(params, t.trained_nt_, x, False)
     assert out.shape == (16, 10)
     assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_sync_batchnorm_equals_global_batch():
+    """sync_bn=True: W stacked workers normalizing with pmean over the
+    worker axis produce exactly the statistics of the concatenated global
+    batch — and the engine's window step accepts the model."""
+    import jax
+
+    from distkeras_tpu.models import resnet_small
+    from distkeras_tpu.parallel.local_sgd import WORKER_AXIS
+
+    rng = np.random.default_rng(0)
+    W, B = 4, 8
+    x = rng.normal(size=(W, B, 8, 8, 3)).astype(np.float32)
+
+    spec = resnet_small(widths=(8,), blocks_per_stage=1, dtype=jnp.float32,
+                        sync_bn=True)
+    params, nt = spec.init_np(0)
+
+    # vmapped-with-axis-name (the engine's layout) vs one flat batch
+    out_w, nt_w = jax.vmap(
+        lambda xs: spec.apply(params, nt, xs, True),
+        axis_name=WORKER_AXIS,
+    )(x)
+    flat_spec = resnet_small(widths=(8,), blocks_per_stage=1,
+                             dtype=jnp.float32)
+    out_flat, nt_flat = flat_spec.apply(params, nt,
+                                        x.reshape(W * B, 8, 8, 3), True)
+    np.testing.assert_allclose(
+        np.asarray(out_w).reshape(W * B, -1), np.asarray(out_flat),
+        rtol=2e-4, atol=2e-5,
+    )
+    # every worker carries identical (global) running stats
+    means = np.asarray(nt_w["batch_stats"]["bn_stem"]["mean"])
+    assert np.allclose(means, means[0:1], atol=1e-6)
+    np.testing.assert_allclose(
+        means[0], np.asarray(nt_flat["batch_stats"]["bn_stem"]["mean"]),
+        rtol=1e-5, atol=1e-6,
+    )
+
+    # end-to-end through a trainer window on the mesh
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.datasets import cifar10
+
+    train, _ = cifar10(n_train=256, n_test=16)
+    t = ADAG(resnet_small(widths=(8,), dtype=jnp.float32, sync_bn=True),
+             loss="sparse_softmax_cross_entropy", worker_optimizer="adam",
+             learning_rate=1e-3, num_workers=8, batch_size=8,
+             communication_window=2, num_epoch=1)
+    t.train(train, shuffle=True)
+    assert np.all(np.isfinite([r["loss"] for r in t.get_history()
+                               if "loss" in r]))
+
+
+def test_sync_bn_rejected_on_ps_backend():
+    """sync_bn models need the collective backend's worker axis; the PS
+    backend must refuse them with a clear error, not a JAX trace error."""
+    import pytest
+
+    from distkeras_tpu import DOWNPOUR
+    from distkeras_tpu.datasets import cifar10
+    from distkeras_tpu.models import resnet_small
+
+    train, _ = cifar10(n_train=64, n_test=16)
+    t = DOWNPOUR(resnet_small(widths=(8,), sync_bn=True),
+                 loss="sparse_softmax_cross_entropy",
+                 worker_optimizer="sgd", learning_rate=0.01, num_workers=2,
+                 batch_size=8, communication_window=2, num_epoch=1,
+                 backend="ps")
+    with pytest.raises(ValueError, match="stacked-worker axis"):
+        t.train(train)

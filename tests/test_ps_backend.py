@@ -225,7 +225,8 @@ def test_ps_backend_elastic_resume(tmp_path):
     t1.train(ds)
     t2 = DOWNPOUR(model_spec(), num_workers=4, num_epoch=4, resume=True,
                   **kw)
-    t2.train(ds)
+    with pytest.warns(UserWarning, match="elastic resume"):
+        t2.train(ds)
     hist = [r for r in t2.get_history() if "loss" in r]
     assert {r["epoch"] for r in hist} == {2, 3}  # epochs 0-1 from checkpoint
     assert np.all(np.isfinite([r["loss"] for r in hist]))
