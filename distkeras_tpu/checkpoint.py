@@ -15,8 +15,9 @@ Compatibility note: checkpoints key params by flax module/layer names, so
 they are tied to the model code that wrote them. In particular the
 transformer family's param keys changed when it gained tensor/pipeline
 parallelism (``EncoderBlock_i/Dense_j`` → ``blocks_i/qkv|attn_out|mlp_up|
-mlp_down``); transformer checkpoints written before that rename cannot be
-resumed by current code.
+mlp_down``), and the LSTM's changed when its input projection was hoisted
+out of the scan (``RNN_0/OptimizedLSTMCell_0/*`` → ``wx/wh``); checkpoints
+written before those renames cannot be resumed by current code.
 """
 
 from __future__ import annotations
@@ -34,6 +35,19 @@ Pytree = Any
 
 _PREFIX = "ckpt_"
 _SUFFIX = ".dkc"
+
+
+def warn_elastic_resume(ckpt_workers: int, trainer_workers: int) -> None:
+    """Shared by both backends' resume paths: elastic resume engaged — the
+    center carries over, per-worker optimizer state restarts."""
+    import warnings
+
+    warnings.warn(
+        f"elastic resume: checkpoint has {ckpt_workers} workers, trainer "
+        f"has {trainer_workers}; resuming from the center with fresh "
+        f"per-worker optimizer state",
+        stacklevel=3,
+    )
 
 
 def should_checkpoint(epoch: int, every: int, num_epoch: int) -> bool:

@@ -74,7 +74,26 @@ def resolve_optimizer(worker_optimizer, learning_rate: float,
         return optax.rmsprop(learning_rate)
     if name == "adadelta":
         return optax.adadelta(learning_rate)
+    if name == "adamw":
+        return optax.adamw(learning_rate)
+    if name == "adamax":
+        return optax.adamax(learning_rate)
+    if name == "nadam":
+        return optax.nadam(learning_rate)
     raise ValueError(f"unknown worker_optimizer {worker_optimizer!r}")
+
+
+def _reject_worker_axis_model(spec, where: str) -> None:
+    """Engines without the stacked-worker vmap axis must refuse models whose
+    training-mode apply runs collectives over it (sync BatchNorm) — a clear
+    error instead of JAX's 'unbound axis name' trace failure."""
+    if getattr(spec, "requires_worker_axis", False):
+        raise ValueError(
+            f"model '{spec.name}' runs collectives over the stacked-worker "
+            f"axis (e.g. sync_bn=True) and cannot train on {where}; use the "
+            f"collective backend of the six distributed trainers, or a "
+            f"per-worker variant of the model"
+        )
 
 
 def _as_cols(features_col) -> list[str]:
@@ -287,14 +306,9 @@ class DistributedTrainer(Trainer):
 
     def train(self, dataset, shuffle: bool = False):
         ds = self._coerce_dataset(dataset)
-        if self.backend == "ps" and getattr(self.spec,
-                                            "requires_worker_axis", False):
-            raise ValueError(
-                f"model '{self.spec.name}' runs collectives over the "
-                f"stacked-worker axis (e.g. sync_bn=True) and cannot train "
-                f"on backend='ps' — its hogwild workers are independent "
-                f"host threads; use backend='collective' or a per-worker "
-                f"variant of the model"
+        if self.backend == "ps":
+            _reject_worker_axis_model(
+                self.spec, "backend='ps' (independent hogwild host threads)"
             )
         ctx = (
             jax.profiler.trace(str(self.profile_dir))
@@ -334,16 +348,8 @@ class DistributedTrainer(Trainer):
                     # SURVEY.md §5.3): the checkpointed center is the model;
                     # re-broadcast it into a fresh W-worker state. Worker-
                     # local divergence and optimizer moments restart — the
-                    # honest semantics when the replica count changes. Warn
-                    # in case the count change was accidental.
-                    import warnings
-
-                    warnings.warn(
-                        f"elastic resume: checkpoint has {ckpt_w} workers, "
-                        f"trainer has {self.num_workers}; resuming from the "
-                        f"center with fresh per-worker optimizer state",
-                        stacklevel=2,
-                    )
+                    # honest semantics when the replica count changes.
+                    ckpt.warn_elastic_resume(ckpt_w, self.num_workers)
                     nt0 = jax.tree.map(lambda x: x[0], host_state.nt)
                     state = engine.init_state(host_state.center, nt0)
                     state = state.replace(step=jnp.asarray(host_state.step))
@@ -627,6 +633,9 @@ class MeshTrainer(Trainer):
         from distkeras_tpu.parallel.fsdp import FSDPEngine
         from distkeras_tpu.parallel.tensor import SPMDEngine
 
+        _reject_worker_axis_model(
+            self.spec, "MeshTrainer (single-model GSPMD, no worker axis)"
+        )
         ds = self._coerce_dataset(dataset)
         cols = self.features_col + [self.label_col]
         loss_step = _make_loss_step(

@@ -226,17 +226,8 @@ def run_async_training(trainer, ds, shuffle: bool):
             else:
                 # elastic resume (same semantics as the collective
                 # backend's): the checkpointed center is the model; the new
-                # worker count starts with fresh per-worker state from it.
-                # Warn — if the count change was accidental, the user loses
-                # the exact resume (optimizer moments restart).
-                import warnings
-
-                warnings.warn(
-                    f"elastic resume: checkpoint has {len(saved_workers)} "
-                    f"workers, trainer has {W}; resuming from the center "
-                    f"with fresh per-worker optimizer state",
-                    stacklevel=2,
-                )
+                # worker count starts with fresh per-worker state from it
+                ckpt.warn_elastic_resume(len(saved_workers), W)
             restored_updates = int(payload.get("num_updates", 0))
             start_epoch = int(payload["epoch"]) + 1
 

@@ -286,3 +286,18 @@ def test_kitchen_sink_composition(rng):
     for r, g in zip(jax.tree.leaves(jax.device_get(params)),
                     jax.tree.leaves(jax.device_get(p2))):
         np.testing.assert_allclose(g, r, rtol=3e-4, atol=3e-5)
+
+
+def test_mesh_trainer_rejects_sync_bn_model():
+    import pytest
+
+    from distkeras_tpu.models import resnet_small
+    from distkeras_tpu.trainers import MeshTrainer
+    from distkeras_tpu.data import Dataset
+
+    ds = Dataset({"features": np.zeros((16, 8, 8, 3), np.float32),
+                  "label": np.zeros((16,), np.int32)})
+    t = MeshTrainer(resnet_small(widths=(8,), sync_bn=True),
+                    mesh_shape={"dp": 8}, batch_size=8, num_epoch=1)
+    with pytest.raises(ValueError, match="stacked-worker axis"):
+        t.train(ds)

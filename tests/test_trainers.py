@@ -180,3 +180,20 @@ def test_resident_shuffle_changes_order_but_still_learns():
              device_data=True)
     t.train(ds, shuffle=True)
     assert final_loss(t) < 0.4
+
+
+def test_resolve_optimizer_names():
+    import optax
+
+    from distkeras_tpu.trainers import resolve_optimizer
+
+    for name in ("sgd", "adam", "adagrad", "rmsprop", "adadelta", "adamw",
+                 "adamax", "nadam", "fused_adam"):
+        tx = resolve_optimizer(name, 1e-3)
+        assert isinstance(tx, optax.GradientTransformation), name
+    # optax transforms pass through; unknown names raise
+    assert resolve_optimizer(optax.sgd(0.1), 1e-3) is not None
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown worker_optimizer"):
+        resolve_optimizer("madgrad", 1e-3)
