@@ -27,7 +27,7 @@ class ModelPredictor:
 
     def __init__(self, model, params=None, state=None,
                  features_col="features", output_col: str = "prediction",
-                 batch_size: int = 512):
+                 batch_size: int = 512, mesh=None, dp_axis: str = "dp"):
         if isinstance(model, ModelSpec):
             if params is None:
                 raise ValueError("ModelSpec predictor needs explicit params")
@@ -42,6 +42,28 @@ class ModelPredictor:
         )
         self.output_col = output_col
         self.batch_size = int(batch_size)
+        # data-parallel inference (the reference mapped prediction over the
+        # Spark cluster — SURVEY.md §3.4): rows shard over `dp_axis`, params
+        # replicate, one jitted apply per chunk as before
+        self._x_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            if dp_axis not in mesh.shape:
+                raise ValueError(
+                    f"dp_axis {dp_axis!r} not in mesh axes "
+                    f"{tuple(mesh.shape.keys())}"
+                )
+            dp = mesh.shape[dp_axis]
+            if self.batch_size % dp:
+                raise ValueError(
+                    f"batch_size {self.batch_size} not divisible by mesh "
+                    f"axis '{dp_axis}' of size {dp}"
+                )
+            self._x_sharding = NamedSharding(mesh, P(dp_axis))
+            rep = NamedSharding(mesh, P())
+            self.params = jax.device_put(self.params, rep)
+            self.state = jax.device_put(self.state, rep)
         spec = self.spec
 
         def fwd(params, state, x):
@@ -62,6 +84,8 @@ class ModelPredictor:
                 chunk = [
                     np.concatenate([c, np.repeat(c[-1:], pad, axis=0)]) for c in chunk
                 ]
+            if self._x_sharding is not None:
+                chunk = [jax.device_put(c, self._x_sharding) for c in chunk]
             x = chunk[0] if len(chunk) == 1 else tuple(chunk)
             out = np.asarray(self._fwd(self.params, self.state, x))
             outs.append(out[: bs - pad] if pad else out)

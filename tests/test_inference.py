@@ -202,3 +202,34 @@ def test_auc_evaluator_large_n_vectorized():
     dt = time.perf_counter() - t0
     assert 0.7 < auc < 0.9
     assert dt < 2.0, f"AUC took {dt:.2f}s for {n} rows"
+
+
+def test_model_predictor_on_mesh_matches_single_device():
+    """Mesh-sharded (data-parallel) inference: same predictions, rows
+    sharded over dp, including the pad-and-trim path."""
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models import mlp
+    from distkeras_tpu.parallel.tensor import get_mesh_nd
+    from distkeras_tpu.predictors import ModelPredictor
+
+    assert len(jax.devices()) == 8
+    mesh = get_mesh_nd({"dp": 8})
+    spec = mlp(input_shape=(16,), hidden=(32,), num_classes=4,
+               dtype=jnp.float32)
+    params, nt = spec.init_np(0)
+    rng = np.random.default_rng(0)
+    # 37 rows: not divisible by batch 16 → exercises padding
+    ds = Dataset({"features": rng.normal(size=(37, 16)).astype(np.float32)})
+
+    single = ModelPredictor(spec, params, nt, batch_size=16).predict(ds)
+    sharded = ModelPredictor(spec, params, nt, batch_size=16,
+                             mesh=mesh).predict(ds)
+    np.testing.assert_allclose(sharded["prediction"], single["prediction"],
+                               rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError, match="not divisible"):
+        ModelPredictor(spec, params, nt, batch_size=12, mesh=mesh)
+    with pytest.raises(ValueError, match="not in mesh axes"):
+        ModelPredictor(spec, params, nt, batch_size=16, mesh=mesh,
+                       dp_axis="data")
