@@ -9,16 +9,28 @@ import sys
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
-def test_parallelism_example_runs_all_strategies():
+def run_example(script: str, *args):
+    """Run an example on the forced virtual 8-CPU mesh (even if a TPU
+    plugin is importable); shared by every example test."""
     env = dict(os.environ)
-    # force the virtual CPU mesh even if a TPU plugin is importable
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = str(REPO)
-    proc = subprocess.run(
-        [sys.executable, str(REPO / "examples" / "parallelism.py")],
+    return subprocess.run(
+        [sys.executable, str(REPO / "examples" / script), *args],
         env=env, capture_output=True, text=True, timeout=900,
     )
+
+
+def test_parallelism_example_runs_all_strategies():
+    proc = run_example("parallelism.py")
     assert proc.returncode == 0, proc.stderr[-2000:]
     for tag in ("[dp]", "[tp]", "[fsdp]", "[pp]", "[sp]", "[ep]"):
         assert tag in proc.stdout, (tag, proc.stdout)
+
+
+def test_longcontext_example_runs_quick():
+    proc = run_example("longcontext.py", "--quick")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "[flash+remat]" in proc.stdout
+    assert "[sp]" in proc.stdout

@@ -1,5 +1,6 @@
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from distkeras_tpu.ops import losses, metrics
 
@@ -66,3 +67,26 @@ def test_accuracy_onehot_and_int(rng):
     onehot = np.eye(2, dtype=np.float32)[labels_int]
     assert np.isclose(float(metrics.accuracy(labels_int, logits)), 2 / 3)
     assert np.isclose(float(metrics.accuracy(onehot, logits)), 2 / 3)
+
+
+def test_metrics_one_dim_predictions_and_jit():
+    # 1-D (already-integer) predictions round; pure-JAX metric jits
+    import jax
+
+    scores = jnp.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    y_int = jnp.array([1, 0, 0])
+    assert float(metrics.accuracy(y_int, jnp.array([1.0, 1.0, 0.0]))) == \
+        pytest.approx(2 / 3)
+    assert float(jax.jit(metrics.accuracy)(y_int, scores)) == \
+        pytest.approx(2 / 3)
+
+
+def test_metrics_top_k_accuracy():
+    scores = jnp.array([
+        [0.5, 0.3, 0.1, 0.1],   # true 1: in top-2 (classes 0,1)
+        [0.1, 0.2, 0.3, 0.4],   # true 0: not in top-2 (classes 2,3)
+        [0.4, 0.1, 0.3, 0.2],   # true 2: in top-2 (classes 0,2)
+    ])
+    y = jnp.array([1, 0, 2])
+    assert float(metrics.top_k_accuracy(y, scores, k=2)) == pytest.approx(2 / 3)
+    assert float(metrics.top_k_accuracy(y, scores, k=4)) == pytest.approx(1.0)
