@@ -92,6 +92,14 @@ def from_keras(model, *, name: str | None = None) -> ModelSpec:
     """
     import keras
 
+    if keras.backend.backend() != "jax":
+        raise ValueError(
+            f"Keras is running the {keras.backend.backend()!r} backend; "
+            f"this framework needs KERAS_BACKEND=jax (set the env var "
+            f"before importing keras, or import distkeras_tpu first — "
+            f"otherwise stateless_call fails with a cryptic "
+            f"TracerArrayConversionError inside jit)"
+        )
     if not model.built:
         raise ValueError("Keras model must be built (call it once or set input shape)")
 

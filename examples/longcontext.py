@@ -47,9 +47,7 @@ def train_step_fn(spec):
 
         (loss, nt2), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         updates, opt = tx.update(grads, opt, params)
-        import optax as _o
-
-        return _o.apply_updates(params, updates), opt, nt2, loss
+        return optax.apply_updates(params, updates), opt, nt2, loss
 
     return tx, jax.jit(step, donate_argnums=(0, 1))
 
@@ -69,8 +67,6 @@ def demo_flash_and_remat(quick: bool):
         dtype=jnp.bfloat16 if on_tpu else jnp.float32, remat=True, **dims)
     params, nt = spec.init_np(0)
     tx, step = train_step_fn(spec)
-    import optax
-
     opt = tx.init(params)
     rng = np.random.default_rng(0)
     toks = rng.integers(0, 1000, size=(B, L)).astype(np.int32)

@@ -94,3 +94,34 @@ def test_distkeras_alias_hasattr_contract():
     assert getattr(distkeras, "definitely_not_a_module", None) is None
     # real late-bound module still resolves
     assert hasattr(distkeras, "networking")
+
+
+def test_keras_batchnorm_model_trains_and_stats_move():
+    """The reference contract covers stateful Keras models too: BatchNorm
+    moving statistics ride the non-trainable state path and are written
+    back into the live model after training."""
+    import keras
+
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.data import Dataset
+
+    model = keras.Sequential([
+        keras.layers.Input((16,)),
+        keras.layers.Dense(32, activation="relu"),
+        keras.layers.BatchNormalization(),
+        keras.layers.Dense(4),
+    ])
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    ds = Dataset({"features": x, "label": y})
+    t = ADAG(model, loss="sparse_softmax_cross_entropy",
+             worker_optimizer="adam", learning_rate=5e-3, num_workers=4,
+             batch_size=16, communication_window=2, num_epoch=8)
+    out = t.train(ds, shuffle=True)
+    assert out is model
+    bn = model.layers[1]
+    assert np.any(np.abs(np.asarray(bn.moving_mean)) > 1e-3)
+    assert np.any(np.abs(np.asarray(bn.moving_variance) - 1.0) > 1e-3)
+    preds = np.argmax(model.predict(x, verbose=0), axis=-1)
+    assert np.mean(preds == y) > 0.7
