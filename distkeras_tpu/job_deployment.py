@@ -21,6 +21,9 @@ from __future__ import annotations
 import json
 import os
 import shlex
+import subprocess
+import tempfile
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Sequence
@@ -114,6 +117,67 @@ class Job:
             for host, cmd in self.commands:
                 self.runner(host, cmd)
         return self.commands
+
+
+class LocalRunner:
+    """Execute rendered commands as local subprocesses — the single-host
+    fan-out (and the CI stand-in for an SSH runner): every host in the
+    Punchcard maps to one local process, which is exactly how a multi-process
+    `jax.distributed` CPU/GPU cluster is brought up on one machine.
+    End-to-end launch is pinned by tests/test_aux.py (2-process cluster,
+    cross-process allgather).
+    """
+
+    def __init__(self):
+        self.procs: list = []
+
+    def __call__(self, host: str, command: str) -> None:
+        if host not in ("localhost", "127.0.0.1"):
+            raise ValueError(
+                f"LocalRunner only launches on localhost, got {host!r}; "
+                f"use an SSH runner for remote hosts"
+            )
+        # temp files, not pipes: cluster processes block on each other at
+        # collectives, so a sequential pipe drain could deadlock against a
+        # full pipe buffer
+        out = tempfile.TemporaryFile(mode="w+")
+        err = tempfile.TemporaryFile(mode="w+")
+        p = subprocess.Popen(command, shell=True, stdout=out, stderr=err,
+                             text=True)
+        p._out_file, p._err_file = out, err
+        self.procs.append(p)
+
+    def wait(self, timeout: float | None = None) -> list[int]:
+        """Wait for every launched process (one overall deadline, not
+        per-process); returns their return codes. On timeout every child is
+        killed before TimeoutExpired propagates — a hung cluster must not
+        leak processes holding the coordinator port."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        try:
+            for p in self.procs:
+                left = (None if deadline is None
+                        else max(0.0, deadline - time.monotonic()))
+                p.wait(timeout=left)
+        except subprocess.TimeoutExpired:
+            for p in self.procs:
+                if p.poll() is None:
+                    p.kill()
+            for p in self.procs:
+                p.wait()
+            self._capture_outputs()
+            raise
+        self._capture_outputs()
+        return [p.returncode for p in self.procs]
+
+    def _capture_outputs(self) -> None:
+        for p in self.procs:
+            if hasattr(p, "captured_stdout"):
+                continue
+            for attr, f in (("captured_stdout", p._out_file),
+                            ("captured_stderr", p._err_file)):
+                f.seek(0)
+                setattr(p, attr, f.read())
+                f.close()
 
 
 def cluster_args_from_env() -> dict:

@@ -1,9 +1,13 @@
 """Aux subsystems: checkpoint/resume, job deployment, parity aliases."""
 
+import pathlib
+
 import numpy as np
 import pytest
 
 from tests.test_trainers import blobs_dataset, model_spec
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
 def test_checkpoint_roundtrip(tmp_path):
@@ -264,7 +268,6 @@ def test_trainer_elastic_resume_changes_worker_count(tmp_path):
 
     t2 = ADAG(model_spec(), num_epoch=4, num_workers=8, checkpoint_dir=d,
               resume=True, **common)
-    import pytest
     with pytest.warns(UserWarning, match="elastic resume"):
         p = t2.train(ds)
     hist = [r for r in t2.get_history() if "loss" in r]
@@ -280,3 +283,57 @@ def test_trainer_elastic_resume_changes_worker_count(tmp_path):
     assert losses[0] < 0.5 * fresh_first
     assert losses[-1] <= loss_before * 1.5  # keeps training sanely
     assert jax.tree.leaves(p)[0] is not None
+
+
+def test_job_local_runner_launches_real_cluster(tmp_path):
+    """End-to-end launch: Punchcard → Job → LocalRunner actually starts a
+    2-process `jax.distributed` cluster on localhost; both processes see
+    process_count=2 and agree on a cross-process allgather."""
+    import json
+    import socket
+    import textwrap
+
+    from distkeras_tpu.job_deployment import Job, LocalRunner, Punchcard
+
+    with socket.socket() as s:  # free coordinator port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(f"""
+        import json, sys
+        sys.path.insert(0, {str(REPO)!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from distkeras_tpu.job_deployment import (
+            cluster_args_from_env, initialize_cluster)
+        info = initialize_cluster(**cluster_args_from_env())
+        from jax.experimental import multihost_utils
+        import jax.numpy as jnp
+        got = multihost_utils.process_allgather(
+            jnp.array([jax.process_index() + 1]))
+        out = {{"info": info, "allgather": got.ravel().tolist()}}
+        with open({str(tmp_path)!r} + f"/out_{{jax.process_index()}}.json",
+                  "w") as f:
+            json.dump(out, f)
+    """))
+
+    pc = Punchcard(script=str(worker), hosts=["localhost", "localhost"],
+                   coordinator_port=port)
+    runner = LocalRunner()
+    job = Job(pc, runner=runner)
+    cmds = job.run()
+    assert len(cmds) == 2
+    codes = runner.wait(timeout=240)
+    assert codes == [0, 0], [p.captured_stderr[-500:] for p in runner.procs]
+    for i in range(2):
+        rec = json.loads((tmp_path / f"out_{i}.json").read_text())
+        assert rec["info"]["process_count"] == 2
+        # each process contributes its local devices (8 virtual CPUs under
+        # the CI flags) to the global view
+        assert rec["info"]["global_devices"] == \
+            2 * rec["info"]["local_devices"]
+        assert sorted(rec["allgather"]) == [1, 2]
+    # non-local hosts are refused
+    with pytest.raises(ValueError, match="localhost"):
+        LocalRunner()("tpu-host-7", "echo hi")
