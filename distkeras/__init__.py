@@ -5,46 +5,34 @@ Reference users wrote ``from distkeras.trainers import ADAG`` etc.
 verbatim against the TPU-native rebuild.
 """
 
+import importlib as _importlib
+import pkgutil as _pkgutil
 import sys
 
 import distkeras_tpu
 from distkeras_tpu import *  # noqa: F401,F403
-from distkeras_tpu import (
-    data,
-    datasets,
-    model,
-    models,
-    ops,
-    parallel,
-    trainers,
-    transformers,
-    utils,
-)
 
 __version__ = distkeras_tpu.__version__
 
-# Register submodules so `import distkeras.trainers` / `from distkeras.utils
-# import serialize_keras_model` resolve exactly like the reference layout.
-for _name in (
-    "trainers", "utils", "data", "datasets", "model", "models", "ops",
-    "parallel", "transformers",
-):
-    sys.modules[f"distkeras.{_name}"] = getattr(distkeras_tpu, _name)
+# Register EVERY submodule so `from distkeras.evaluators import
+# AccuracyEvaluator` — the reference's exact import form — resolves like the
+# reference layout. Registration must be eager: Python's submodule import
+# (`from pkg.sub import X`) consults sys.modules and pkg.__path__ only, never
+# the package-level __getattr__ (PEP 562 covers attribute access, not
+# submodule import). The list is derived from the real package, so modules
+# added to distkeras_tpu later alias automatically.
+for _m in _pkgutil.iter_modules(distkeras_tpu.__path__):
+    sys.modules[f"distkeras.{_m.name}"] = _importlib.import_module(
+        f"distkeras_tpu.{_m.name}"
+    )
 
 
 def __getattr__(name):
-    # Late-bound modules (predictors, evaluators, workers, parameter_servers,
-    # networking, job_deployment) resolve on first access. Unknown names must
-    # raise AttributeError so hasattr()/getattr(..., default) behave normally.
-    import importlib
-
+    # Unknown names raise AttributeError so hasattr()/getattr(..., default)
+    # behave normally (everything real is eagerly registered above).
     try:
-        mod = importlib.import_module(f"distkeras_tpu.{name}")
-    except ModuleNotFoundError as e:
-        if e.name != f"distkeras_tpu.{name}":
-            raise  # a real submodule broke on ITS dependency — surface that
+        return sys.modules[f"distkeras.{name}"]
+    except KeyError:
         raise AttributeError(
             f"module 'distkeras' has no attribute {name!r}"
-        ) from e
-    sys.modules[f"distkeras.{name}"] = mod
-    return mod
+        ) from None

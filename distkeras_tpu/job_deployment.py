@@ -114,6 +114,13 @@ class Job:
     def run(self) -> list[tuple[str, str]]:
         self.commands = self.render_commands()
         if self.runner is not None:
+            # validate the whole host list BEFORE launching anything: a
+            # rejection mid-launch would leak already-started cluster
+            # processes blocking in jax.distributed.initialize
+            validate = getattr(self.runner, "validate", None)
+            if validate is not None:
+                for host, _ in self.commands:
+                    validate(host)
             for host, cmd in self.commands:
                 self.runner(host, cmd)
         return self.commands
@@ -131,19 +138,24 @@ class LocalRunner:
     def __init__(self):
         self.procs: list = []
 
-    def __call__(self, host: str, command: str) -> None:
+    def validate(self, host: str) -> None:
+        """Called by :meth:`Job.run` for every host before any launch."""
         if host not in ("localhost", "127.0.0.1"):
             raise ValueError(
                 f"LocalRunner only launches on localhost, got {host!r}; "
                 f"use an SSH runner for remote hosts"
             )
+
+    def __call__(self, host: str, command: str) -> None:
+        self.validate(host)
         # temp files, not pipes: cluster processes block on each other at
         # collectives, so a sequential pipe drain could deadlock against a
-        # full pipe buffer
+        # full pipe buffer. New session so a timeout can kill the whole
+        # process GROUP (the `sh -c` shell plus anything it spawned).
         out = tempfile.TemporaryFile(mode="w+")
         err = tempfile.TemporaryFile(mode="w+")
         p = subprocess.Popen(command, shell=True, stdout=out, stderr=err,
-                             text=True)
+                             text=True, start_new_session=True)
         p._out_file, p._err_file = out, err
         self.procs.append(p)
 
@@ -159,9 +171,14 @@ class LocalRunner:
                         else max(0.0, deadline - time.monotonic()))
                 p.wait(timeout=left)
         except subprocess.TimeoutExpired:
+            import signal
+
             for p in self.procs:
                 if p.poll() is None:
-                    p.kill()
+                    try:  # whole group: the shell AND its descendants
+                        os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        p.kill()
             for p in self.procs:
                 p.wait()
             self._capture_outputs()

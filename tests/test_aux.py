@@ -334,6 +334,13 @@ def test_job_local_runner_launches_real_cluster(tmp_path):
         assert rec["info"]["global_devices"] == \
             2 * rec["info"]["local_devices"]
         assert sorted(rec["allgather"]) == [1, 2]
-    # non-local hosts are refused
+    # non-local hosts are refused — and a mixed host list is rejected
+    # BEFORE anything launches (no leaked half-cluster)
     with pytest.raises(ValueError, match="localhost"):
         LocalRunner()("tpu-host-7", "echo hi")
+    bad = Punchcard(script=str(worker), hosts=["localhost", "tpu-host-7"],
+                    coordinator_port=port)
+    r2 = LocalRunner()
+    with pytest.raises(ValueError, match="localhost"):
+        Job(bad, runner=r2).run()
+    assert r2.procs == []

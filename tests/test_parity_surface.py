@@ -95,3 +95,31 @@ def test_ps_backend_available_and_trains():
              batch_size=16, communication_window=2, backend="ps")
     t.train(ds)
     assert len(t.get_history()) > 0
+
+
+def test_reference_from_import_form_for_every_module():
+    """`from distkeras.<module> import <Name>` — the reference's exact
+    import style — must work for EVERY module, including the ones that used
+    to be lazily bound (submodule import never consults module __getattr__,
+    so registration must be eager)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "from distkeras.evaluators import AccuracyEvaluator;"
+        "from distkeras.predictors import ModelPredictor;"
+        "from distkeras.workers import AsyncWorker;"
+        "from distkeras.parameter_servers import SocketParameterServer;"
+        "from distkeras.networking import determine_host_address;"
+        "from distkeras.job_deployment import Job, LocalRunner;"
+        "from distkeras.checkpoint import save_checkpoint;"
+        "import distkeras;"
+        "assert not hasattr(distkeras, 'nope');"
+        "print('ok')"
+    )
+    # a fresh interpreter proves it works without any prior attribute access
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert proc.stdout.strip().endswith("ok")
