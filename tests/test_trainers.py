@@ -197,3 +197,23 @@ def test_resolve_optimizer_names():
 
     with pytest.raises(ValueError, match="unknown worker_optimizer"):
         resolve_optimizer("madgrad", 1e-3)
+
+
+def test_learning_rate_accepts_optax_schedule():
+    """The reference exposed Keras optimizer configs; here `learning_rate`
+    may be an optax schedule (callable step -> lr) for any named optimizer —
+    warmup/decay without custom optimizer objects."""
+    import optax
+
+    from distkeras_tpu import ADAG
+
+    ds = blobs_dataset(n=512)
+    sched = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=0.05, warmup_steps=4, decay_steps=64)
+    t = ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+             worker_optimizer="sgd", learning_rate=sched, num_workers=4,
+             batch_size=16, communication_window=2, num_epoch=3)
+    t.train(ds, shuffle=True)
+    losses = [float(l) for l in t.get_history().losses()]
+    assert np.all(np.isfinite(losses))
+    assert np.mean(losses[-3:]) < losses[0]
