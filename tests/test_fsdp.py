@@ -301,3 +301,31 @@ def test_mesh_trainer_rejects_sync_bn_model():
                     mesh_shape={"dp": 8}, batch_size=8, num_epoch=1)
     with pytest.raises(ValueError, match="stacked-worker axis"):
         t.train(ds)
+
+
+def test_mesh_trainer_fsdp_megatron_end_to_end(rng):
+    """The combined mode through the user API: ZeRO over dp × Megatron over
+    tp on one 2-D mesh, training the transformer to a falling loss."""
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.trainers import MeshTrainer
+
+    n = 64
+    y = rng.integers(0, CLASSES, size=(n,)).astype(np.int32)
+    toks = (
+        y[:, None] * (VOCAB // CLASSES)
+        + rng.integers(0, VOCAB // CLASSES, size=(n, MAXLEN))
+    ).astype(np.int32)
+    ds = Dataset({"features": toks,
+                  "mask": np.ones((n, MAXLEN), np.float32), "label": y})
+    trainer = MeshTrainer(
+        small_transformer(), loss="sparse_softmax_cross_entropy",
+        worker_optimizer="adam", learning_rate=2e-3,
+        mesh_shape={"dp": 2, "tp": 4},
+        parameter_sharding="fsdp+megatron", grad_accum=2,
+        batch_size=16, num_epoch=12,
+        features_col=["features", "mask"], label_col="label",
+    )
+    trainer.train(ds, shuffle=True)
+    losses = [r["loss"] for r in trainer.history.records if "loss" in r]
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < 0.5 * np.mean(losses[:4])
