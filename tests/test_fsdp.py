@@ -48,6 +48,19 @@ def transformer_loss(spec):
     return fn
 
 
+def learnable_token_dataset(rng, n=64):
+    """Tokens whose high bits encode the class — learnable in a few epochs."""
+    from distkeras_tpu.data import Dataset
+
+    y = rng.integers(0, CLASSES, size=(n,)).astype(np.int32)
+    toks = (
+        y[:, None] * (VOCAB // CLASSES)
+        + rng.integers(0, VOCAB // CLASSES, size=(n, MAXLEN))
+    ).astype(np.int32)
+    mask = np.ones((n, MAXLEN), np.float32)
+    return Dataset({"features": toks, "mask": mask, "label": y}), toks, mask, y
+
+
 def test_fsdp_specs_layout():
     spec = small_transformer()
     params, _ = spec.init_np(0)
@@ -156,17 +169,9 @@ def test_fsdp_with_tensor_parallel_train(rng):
 
 
 def test_mesh_trainer_fsdp_end_to_end(rng):
-    from distkeras_tpu.data import Dataset
     from distkeras_tpu.trainers import MeshTrainer
 
-    n, CLASSES_ = 64, CLASSES
-    y = rng.integers(0, CLASSES_, size=(n,)).astype(np.int32)
-    toks = (
-        y[:, None] * (VOCAB // CLASSES_)
-        + rng.integers(0, VOCAB // CLASSES_, size=(n, MAXLEN))
-    ).astype(np.int32)
-    mask = np.ones((n, MAXLEN), np.float32)
-    ds = Dataset({"features": toks, "mask": mask, "label": y})
+    ds, toks, mask, y = learnable_token_dataset(rng)
 
     trainer = MeshTrainer(
         small_transformer(), loss="sparse_softmax_cross_entropy",
@@ -183,7 +188,7 @@ def test_mesh_trainer_fsdp_end_to_end(rng):
     out, _ = small_transformer().apply(
         params, trainer.trained_nt_, (toks[:8], mask[:8]), False
     )
-    assert out.shape == (8, CLASSES_)
+    assert out.shape == (8, CLASSES)
 
 
 def test_fsdp_shape_changing_opt_state(rng):
@@ -306,17 +311,9 @@ def test_mesh_trainer_rejects_sync_bn_model():
 def test_mesh_trainer_fsdp_megatron_end_to_end(rng):
     """The combined mode through the user API: ZeRO over dp × Megatron over
     tp on one 2-D mesh, training the transformer to a falling loss."""
-    from distkeras_tpu.data import Dataset
     from distkeras_tpu.trainers import MeshTrainer
 
-    n = 64
-    y = rng.integers(0, CLASSES, size=(n,)).astype(np.int32)
-    toks = (
-        y[:, None] * (VOCAB // CLASSES)
-        + rng.integers(0, VOCAB // CLASSES, size=(n, MAXLEN))
-    ).astype(np.int32)
-    ds = Dataset({"features": toks,
-                  "mask": np.ones((n, MAXLEN), np.float32), "label": y})
+    ds, toks, mask, y = learnable_token_dataset(rng)
     trainer = MeshTrainer(
         small_transformer(), loss="sparse_softmax_cross_entropy",
         worker_optimizer="adam", learning_rate=2e-3,
@@ -325,7 +322,12 @@ def test_mesh_trainer_fsdp_megatron_end_to_end(rng):
         batch_size=16, num_epoch=12,
         features_col=["features", "mask"], label_col="label",
     )
-    trainer.train(ds, shuffle=True)
+    params = trainer.train(ds, shuffle=True)
     losses = [r["loss"] for r in trainer.history.records if "loss" in r]
     assert np.isfinite(losses).all()
     assert np.mean(losses[-4:]) < 0.5 * np.mean(losses[:4])
+    # returned params materialized to host arrays from the dp×tp layout
+    out, _ = small_transformer().apply(
+        params, trainer.trained_nt_, (toks[:8], mask[:8]), False
+    )
+    assert out.shape == (8, CLASSES)
