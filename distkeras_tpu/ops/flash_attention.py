@@ -15,8 +15,19 @@ independent of sequence length — where XLA's fused attention materializes
 the full ``O(L²)`` score tensor per head in HBM (it OOMs at L=16k on a v5e
 where this kernel keeps running). The kernel also emits per-row log-sum-exp,
 which makes the backward pass a textbook recompute: ``p = exp(qk − lse)``,
-no saved probabilities. Backward runs as plain XLA einsums (full-score
-recompute); forward-pass memory is where the win is.
+no saved probabilities.
+
+Backward: two Pallas kernels with the same tile-streaming structure, so
+training memory is also ``O(block_q · block_k)`` per core instead of the
+``O(L²)`` score/probability tensors a plain-XLA backward materializes.
+``delta = rowsum(dO · O)`` is precomputed in XLA (one elementwise pass),
+then a dq kernel (grid ``(batch·head, q-blocks, k-blocks)``, k innermost,
+``dq += ds @ k``) and a dk/dv kernel (grid ``(batch·head, k-blocks,
+q-blocks)``, q innermost, ``dk += dsᵀ @ q``, ``dv += pᵀ @ dO``) each
+rebuild their probability tile from the saved lse and fold into VMEM
+accumulators. Causal tiles that cannot contribute are skipped on both
+sides of the diagonal (dq skips above, dk/dv below). ``_attention_bwd_math``
+keeps the plain-XLA gradient identities as the small-shape oracle.
 
 On TPU the kernel compiles natively; elsewhere (the 8-device CPU mesh in CI)
 it runs in Pallas interpret mode, so the SAME code path is oracle-tested
@@ -110,6 +121,11 @@ def _interpret_default():
     return jax.default_backend() != "tpu"
 
 
+def _pick_block_k(L):
+    """Largest tile-aligned k block that divides L (128 always does)."""
+    return next(c for c in (BLOCK_K, 384, 256, 128) if L % c == 0)
+
+
 def _fa_forward(q, k, v, key_mask, *, scale, causal, interpret):
     """q/k/v [B, L, H, D] (+ key_mask [B, L]) → (out [B, L, H, D], lse)."""
     B, L, H, D = q.shape
@@ -118,8 +134,7 @@ def _fa_forward(q, k, v, key_mask, *, scale, causal, interpret):
             f"sequence length {L} must be a multiple of {BLOCK_Q}"
         )
     bq = BLOCK_Q
-    # largest tile-aligned k block that divides L (128 always does)
-    bk = next(c for c in (BLOCK_K, 384, 256, 128) if L % c == 0)
+    bk = _pick_block_k(L)
 
     def bh(x):  # [B, L, H, D] → [B·H, L, D]
         return jnp.moveaxis(x, 2, 1).reshape(B * H, L, D)
@@ -172,6 +187,215 @@ def _fa_forward(q, k, v, key_mask, *, scale, causal, interpret):
     return out, lse[..., 0]
 
 
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, *rest,
+                      scale, causal, block_q, block_k):
+    """One (bh, iq, jk) step: rebuild the [bq, bk] probability tile from the
+    saved lse and fold ``ds @ k`` into the dq accumulator; write on this q
+    block's last contributing k step."""
+    if len(rest) == 3:
+        km_ref, dq_ref, acc = rest
+    else:
+        km_ref, (dq_ref, acc) = None, rest
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(jk == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+
+    if causal:
+        last_k = jnp.minimum(nk - 1, (iq * block_q + block_q - 1) // block_k)
+    else:
+        last_k = nk - 1
+
+    @pl.when(jk <= last_k)
+    def _():
+        qs = q_ref[0].astype(jnp.float32) * scale       # [bq, D]
+        kk = k_ref[0].astype(jnp.float32)               # [bk, D]
+        vv = v_ref[0].astype(jnp.float32)               # [bk, D]
+        gg = g_ref[0].astype(jnp.float32)               # [bq, D]
+        s = jax.lax.dot_general(
+            qs, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                # [bq, bk]
+        valid = None
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = jk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            valid = q_pos >= k_pos
+        if km_ref is not None:
+            km = km_ref[0].astype(jnp.float32) > 0.5     # [1, bk]
+            km = jnp.broadcast_to(km, s.shape)
+            valid = km if valid is None else (valid & km)
+        if valid is not None:
+            s = jnp.where(valid, s, _NEG)
+        p = jnp.exp(s - lse_ref[0])                      # lse [bq, 1]
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
+        dp = jax.lax.dot_general(
+            gg, vv, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                # [bq, bk]
+        ds = p * (dp - d_ref[0])                         # delta [bq, 1]
+        acc[:] += jax.lax.dot_general(
+            ds, kk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(jk == last_k)
+    def _():
+        dq_ref[0] = acc[:].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, *rest,
+                       scale, causal, block_q, block_k):
+    """One (bh, jk, iq) step: rebuild the transposed [bk, bq] probability
+    tile and fold ``pᵀ @ dO`` / ``dsᵀ @ q`` into the dv/dk accumulators;
+    write on the last q step (the last q block always contributes)."""
+    if len(rest) == 5:
+        km_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        km_ref = None
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
+    jk = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    if causal:
+        # q blocks strictly above this k block's diagonal see nothing
+        first_q = (jk * block_k) // block_q
+    else:
+        first_q = 0
+
+    @pl.when(iq >= first_q)
+    def _():
+        qs = q_ref[0].astype(jnp.float32) * scale       # [bq, D]
+        kk = k_ref[0].astype(jnp.float32)               # [bk, D]
+        vv = v_ref[0].astype(jnp.float32)               # [bk, D]
+        gg = g_ref[0].astype(jnp.float32)               # [bq, D]
+        st = jax.lax.dot_general(
+            kk, qs, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                # [bk, bq]
+        valid = None
+        if causal:
+            k_pos = jk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0
+            )
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1
+            )
+            valid = q_pos >= k_pos
+        if km_ref is not None:
+            km = km_ref[0].astype(jnp.float32) > 0.5     # [bk, 1]
+            km = jnp.broadcast_to(km, st.shape)
+            valid = km if valid is None else (valid & km)
+        if valid is not None:
+            st = jnp.where(valid, st, _NEG)
+        pt = jnp.exp(st - lse_ref[0])                    # lse [1, bq]
+        if valid is not None:
+            pt = jnp.where(valid, pt, 0.0)
+        dv_acc[:] += jax.lax.dot_general(
+            pt, gg, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dpt = jax.lax.dot_general(
+            vv, gg, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                # [bk, bq]
+        dst = pt * (dpt - d_ref[0])                      # delta [1, bq]
+        dk_acc[:] += jax.lax.dot_general(
+            dst, qs, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _fa_backward(q, k, v, key_mask, out, lse, g, *, scale, causal,
+                 interpret):
+    """Blockwise flash-attention backward: (dq, dk, dv) via two Pallas
+    kernels, ``O(block_q · block_k)`` on-chip — no [B, H, L, L] tensors."""
+    B, L, H, D = q.shape
+    bq = BLOCK_Q
+    bk = _pick_block_k(L)  # same ladder as the forward — keep in lockstep
+
+    def bh(x):  # [B, L, H, D] → [B·H, L, D]
+        return jnp.moveaxis(x, 2, 1).reshape(B * H, L, D)
+
+    qb, kb, vb, gb = bh(q), bh(k), bh(v), bh(g)
+    # delta = rowsum(dO · O): one elementwise pass, [B·H, L]
+    delta = jnp.sum(gb.astype(jnp.float32) * bh(out).astype(jnp.float32),
+                    axis=-1)
+    lse_col, d_col = lse[..., None], delta[..., None]      # [B·H, L, 1]
+    lse_row, d_row = lse[:, None, :], delta[:, None, :]    # [B·H, 1, L]
+    H_ = H
+
+    qspec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
+    kvspec_q = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
+    colspec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
+
+    dq_specs = [qspec, kvspec_q, kvspec_q, qspec, colspec, colspec]
+    dq_args = [qb, kb, vb, gb, lse_col, d_col]
+    if key_mask is not None:
+        dq_specs.append(
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // H_, 0, j))
+        )
+        dq_args.append(key_mask.astype(jnp.float32)[:, None, :])
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(B * H, L // bq, L // bk),
+        in_specs=dq_specs,
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(*dq_args)
+
+    # dk/dv: k blocks on the parallel axis, q innermost
+    kvspec = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
+    qspec2 = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
+    rowspec = pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i))
+    dkv_specs = [qspec2, kvspec, kvspec, qspec2, rowspec, rowspec]
+    dkv_args = [qb, kb, vb, gb, lse_row, d_row]
+    if key_mask is not None:
+        dkv_specs.append(
+            pl.BlockSpec((1, bk, 1), lambda b, j, i: (b // H_, j, 0))
+        )
+        dkv_args.append(key_mask.astype(jnp.float32)[..., None])
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(B * H, L // bk, L // bq),
+        in_specs=dkv_specs,
+        out_specs=[kvspec, kvspec],
+        out_shape=[jax.ShapeDtypeStruct((B * H, L, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, L, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=interpret,
+    )(*dkv_args)
+
+    def unbh(x):  # [B·H, L, D] → [B, L, H, D]
+        return jnp.moveaxis(x.reshape(B, H, L, D), 1, 2)
+
+    return unbh(dq), unbh(dk), unbh(dv)
+
+
 def _attention_bwd_math(q, k, v, key_mask, lse, g, *, scale, causal):
     """Recompute-based backward (plain XLA): p from saved lse, then the
     standard flash-attention gradient identities."""
@@ -215,13 +439,15 @@ def _fa_fwd(q, k, v, key_mask, causal, scale, interpret):
     out, lse = _fa_forward(
         q, k, v, key_mask, scale=scale, causal=causal, interpret=interpret
     )
-    return out, (q, k, v, key_mask, lse)
+    # saving `out` adds no memory under jit: it aliases the primal output
+    return out, (q, k, v, key_mask, out, lse)
 
 
 def _fa_bwd(causal, scale, interpret, res, g):
-    q, k, v, key_mask, lse = res
-    dq, dk, dv = _attention_bwd_math(
-        q, k, v, key_mask, lse, g, scale=scale, causal=causal
+    q, k, v, key_mask, out, lse = res
+    dq, dk, dv = _fa_backward(
+        q, k, v, key_mask, out, lse, g,
+        scale=scale, causal=causal, interpret=interpret,
     )
     dmask = None if key_mask is None else jnp.zeros_like(key_mask)
     return dq, dk, dv, dmask

@@ -115,6 +115,105 @@ def test_multi_k_tile_online_softmax(rng, causal, monkeypatch):
                                rtol=5e-3, atol=5e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_bwd_kernel_matches_bwd_math(rng, causal):
+    """Pin the Pallas backward kernels directly against the plain-XLA
+    gradient identities (same saved lse), causal x key_mask."""
+    from distkeras_tpu.ops import flash_attention as fa
+
+    q, k, v = qkv(rng)
+    mask = np.ones((B, L), np.float32)
+    mask[:, L - 48:] = 0.0
+    scale = D ** -0.5
+    out, lse = fa._fa_forward(q, k, v, mask, scale=scale, causal=causal,
+                              interpret=True)
+    g = rng.normal(size=(B, L, H, D)).astype(np.float32)
+    dq, dk, dv = fa._fa_backward(q, k, v, mask, out, lse, g,
+                                 scale=scale, causal=causal, interpret=True)
+    rq, rk, rv = fa._attention_bwd_math(q, k, v, mask, lse, g,
+                                        scale=scale, causal=causal)
+    for name, got, want in (("dq", dq, rq), ("dk", dk, rk), ("dv", dv, rv)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_multi_tile_bwd_all_grads(rng, causal, monkeypatch):
+    """Grads wrt q AND k AND v with 2 k tiles per q block: exercises the
+    dkv kernel's cross-q accumulation and the causal first_q skip."""
+    from distkeras_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa, "BLOCK_K", 128)
+    q, k, v = qkv(rng)                       # L=256 → 2 tiles each way
+    mask = np.ones((B, L), np.float32)
+    mask[:, L - 60:] = 0.0
+    cot = rng.normal(size=(B, L, H, D)).astype(np.float32)
+    g = jax.grad(
+        lambda q, k, v: jnp.sum(
+            fa.flash_attention(q, k, v, causal=causal, key_mask=mask) * cot
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    r = jax.grad(
+        lambda q, k, v: jnp.sum(
+            attention_reference(q, k, v, causal=causal, key_mask=mask) * cot
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for name, gg, rr in zip("qkv", g, r):
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(rr),
+                                   rtol=5e-3, atol=5e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_asymmetric_tiles_bwd(rng, causal, monkeypatch):
+    """Production tiling has block_k > block_q (512 vs 128); exercise the
+    asymmetric causal skip bounds (last_k/first_q stride by bk/bq = 2 here)
+    that the symmetric-tile tests never reach."""
+    from distkeras_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa, "BLOCK_K", 256)
+    L2 = 512                                  # 4 q blocks x 2 k blocks
+    q, k, v = qkv(rng, L=L2)
+    mask = np.ones((B, L2), np.float32)
+    mask[:, L2 - 50:] = 0.0
+    cot = rng.normal(size=(B, L2, H, D)).astype(np.float32)
+    g = jax.grad(
+        lambda q, k, v: jnp.sum(
+            fa.flash_attention(q, k, v, causal=causal, key_mask=mask) * cot
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    r = jax.grad(
+        lambda q, k, v: jnp.sum(
+            attention_reference(q, k, v, causal=causal, key_mask=mask) * cot
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for name, gg, rr in zip("qkv", g, r):
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(rr),
+                                   rtol=5e-3, atol=5e-4, err_msg=name)
+
+
+def test_fully_masked_rows_zero_grads(rng):
+    """All-masked rows must give finite (zero) dq and contribute nothing
+    to dk/dv — the exp(s - lse) recompute must not NaN."""
+    q, k, v = qkv(rng)
+    mask = np.zeros((B, L), np.float32)
+    cot = np.ones((B, L, H, D), np.float32)
+    g = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, key_mask=mask) * cot
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for name, gg in zip("qkv", g):
+        arr = np.asarray(gg)
+        assert np.isfinite(arr).all(), name
+        np.testing.assert_allclose(arr, np.zeros_like(arr), atol=1e-6,
+                                   err_msg=name)
+
+
 def test_length_guard_raises_below_block(rng):
     mk = lambda: rng.normal(size=(B, 96, H, D)).astype(np.float32)
     with pytest.raises(ValueError, match="multiple of 128"):
