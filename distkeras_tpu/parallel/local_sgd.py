@@ -30,7 +30,11 @@ import optax
 
 from distkeras_tpu.model import ModelSpec
 from distkeras_tpu.parallel.merge_rules import MergeRule
-from distkeras_tpu.parallel.mesh import replicated_sharding, worker_sharding
+from distkeras_tpu.parallel.mesh import (
+    put_global,
+    replicated_sharding,
+    worker_sharding,
+)
 
 Pytree = Any
 LossStep = Callable[[Pytree, Pytree, tuple], tuple[jnp.ndarray, Pytree]]
@@ -85,6 +89,7 @@ class LocalSGDEngine:
         self._window_step = None  # built lazily once state structure is known
         self._resident_step = None
         self._abstract_state = None
+        self._take_worker = None
 
     # -- sharding layout -----------------------------------------------------
 
@@ -143,7 +148,7 @@ class LocalSGDEngine:
             )
         self._abstract_state = jax.eval_shape(lambda s: s, host_state)
         shardings = self._state_shardings(self._abstract_state)
-        state = jax.device_put(host_state, _as_tree(shardings))
+        state = jax.tree.map(put_global, host_state, _as_tree(shardings))
         self._build_window_step(state)
         return state
 
@@ -197,7 +202,7 @@ class LocalSGDEngine:
     def run_window(self, state: TrainState, batch_arrays: tuple):
         """Run one communication window. ``batch_arrays``: [W, window, B, …]."""
         batch = tuple(
-            jax.device_put(a, self._batch_sharding) for a in batch_arrays
+            put_global(a, self._batch_sharding) for a in batch_arrays
         )
         return self._window_step(state, batch)
 
@@ -211,7 +216,7 @@ class LocalSGDEngine:
         were likewise assigned once and iterated every epoch). Epoch shuffles
         happen on device — zero host↔device traffic after this call.
         """
-        return tuple(jax.device_put(a, self._shard) for a in worker_arrays)
+        return tuple(put_global(a, self._shard) for a in worker_arrays)
 
     def run_epoch_resident(self, state: TrainState, staged: tuple,
                            shuffle_seed: int | None):
@@ -261,7 +266,14 @@ class LocalSGDEngine:
         return jax.tree.map(lambda x: jax.device_get(x), state.center)
 
     def worker_nt(self, state: TrainState, i: int = 0) -> Pytree:
-        return jax.tree.map(lambda x: jax.device_get(x[i]), state.nt)
+        # replicate the slice before device_get: under jax.distributed the
+        # worker-sharded leaves are not addressable from every process
+        if self._take_worker is None:
+            self._take_worker = jax.jit(
+                lambda nt, i: jax.tree.map(lambda x: x[i], nt),
+                out_shardings=self._rep,
+            )
+        return jax.tree.map(jax.device_get, self._take_worker(state.nt, i))
 
 
 def _as_tree(state_shardings: TrainState):

@@ -55,6 +55,28 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def put_global(array, sharding: NamedSharding):
+    """Place one host array onto a (possibly multi-host) sharding.
+
+    Single-process: plain ``jax.device_put``. Under ``jax.distributed``
+    (multi-controller SPMD — every process runs the same host loop over the
+    same deterministic data plane), ``device_put`` cannot build an array that
+    spans non-addressable devices, so each process materializes only its own
+    addressable shards via ``jax.make_array_from_callback``; the callback
+    slices the full host value, which every process holds.
+
+    This is the multi-host seam the reference covered with Spark partition
+    shipping (reference ``distkeras/workers.py :: Worker.train`` ran against
+    rows Spark had already moved to the executor; SURVEY.md §3.1 boundary #1).
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(array, sharding)
+    array = np.asarray(array)
+    return jax.make_array_from_callback(
+        array.shape, sharding, lambda idx: array[idx]
+    )
+
+
 def mesh_info(mesh: Mesh) -> dict:
     devs = mesh.devices.flatten()
     return {

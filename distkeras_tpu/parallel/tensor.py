@@ -25,6 +25,8 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distkeras_tpu.parallel.mesh import put_global
+
 
 def get_mesh_nd(axes: dict[str, int], devices=None) -> Mesh:
     """Build an N-D mesh, e.g. ``get_mesh_nd({'dp': 2, 'tp': 4})``.
@@ -95,7 +97,7 @@ def megatron_specs(params, tp_axis: str = "tp"):
 def shard_pytree(tree, mesh: Mesh, specs):
     """Place a host pytree onto the mesh per a PartitionSpec pytree."""
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+        lambda x, s: put_global(x, NamedSharding(mesh, s)), tree, specs
     )
 
 
@@ -152,7 +154,7 @@ class SPMDEngine:
             self.param_specs = megatron_specs(params, self.tp_axis)
         params = shard_pytree(params, self.mesh, self.param_specs)
         rep = NamedSharding(self.mesh, P())
-        nt = jax.tree.map(lambda x: jax.device_put(x, rep), nt)
+        nt = jax.tree.map(lambda x: put_global(x, rep), nt)
         # moments/accumulators inherit the params' layout (with FSDP specs
         # this IS ZeRO optimizer-state partitioning); scalars replicate
         opt_state = jax.jit(
@@ -252,7 +254,7 @@ class SPMDEngine:
                 f"{self.grad_accum} × dp {dp} = {self.grad_accum * dp}"
             )
         batch = tuple(
-            jax.device_put(a, self._batch_sharding) for a in batch_arrays
+            put_global(a, self._batch_sharding) for a in batch_arrays
         )
         return self._step(params, nt, opt_state, batch)
 

@@ -306,6 +306,15 @@ class DistributedTrainer(Trainer):
 
     def train(self, dataset, shuffle: bool = False):
         ds = self._coerce_dataset(dataset)
+        if self.checkpoint_dir and jax.process_count() > 1:
+            # fail fast — a first-save failure after a trained epoch (or a
+            # clean restore followed by a crashing save) would waste the run
+            raise NotImplementedError(
+                "checkpointing under multi-process jax.distributed is not "
+                "supported yet (the snapshot would device_get worker shards "
+                "this process cannot address); checkpoint from a "
+                "single-process mesh"
+            )
         if self.backend == "ps":
             _reject_worker_axis_model(
                 self.spec, "backend='ps' (independent hogwild host threads)"

@@ -1,0 +1,101 @@
+"""Multi-host training end-to-end: a LocalRunner-launched 2-process
+`jax.distributed` cluster actually TRAINS (not just allgathers), and the
+result equals the single-process run.
+
+Parity: the reference really trained across machines (reference
+``distkeras/workers.py :: Worker.train`` ran on remote Spark executors;
+``distkeras/job_deployment.py :: Job`` submitted to a live cluster —
+SURVEY.md §3.1 boundaries #1/#2). Here the same ADAG window program runs
+multi-controller SPMD: every process feeds `put_global` the same
+deterministic superbatches and XLA runs one global program over the
+2-host mesh.
+"""
+
+import json
+import os
+import socket
+import textwrap
+
+import jax
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one shared recipe so oracle and cluster cannot drift apart
+TRAIN_SNIPPET = """
+from distkeras_tpu import ADAG
+from distkeras_tpu.datasets import higgs
+from distkeras_tpu.models import mlp
+import jax.numpy as jnp
+
+def run_training():
+    train, _ = higgs(n_train=2048, n_test=64)
+    t = ADAG(mlp(input_shape=(28,), hidden=(32, 16), num_classes=2,
+                 dtype=jnp.float32),
+             loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+             learning_rate=0.05, num_workers=8, batch_size=16,
+             communication_window=2, num_epoch=2, seed=7,
+             device_data=False)
+    params = t.train(train, shuffle=True)
+    losses = [float(l) for l in t.get_history().losses()]
+    return params, losses
+"""
+
+
+@pytest.mark.slow
+def test_two_process_adag_matches_single_process(tmp_path):
+    from distkeras_tpu.job_deployment import Job, LocalRunner, Punchcard
+
+    with socket.socket() as s:  # free coordinator port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(f"""
+        import json, os, sys
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        sys.path.insert(0, {str(REPO)!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from distkeras_tpu.job_deployment import (
+            cluster_args_from_env, initialize_cluster)
+        info = initialize_cluster(**cluster_args_from_env())
+        assert info["process_count"] == 2, info
+        assert len(jax.devices()) == 8, jax.devices()
+    """) + TRAIN_SNIPPET + textwrap.dedent(f"""
+        import numpy as np
+        params, losses = run_training()
+        if jax.process_index() == 0:
+            leaves = jax.tree.leaves(params)
+            np.savez({str(tmp_path)!r} + "/params.npz",
+                     **{{str(i): np.asarray(l) for i, l in enumerate(leaves)}})
+            with open({str(tmp_path)!r} + "/losses.json", "w") as f:
+                json.dump(losses, f)
+    """))
+
+    pc = Punchcard(script=str(worker), hosts=["localhost", "localhost"],
+                   coordinator_port=port)
+    runner = LocalRunner()
+    Job(pc, runner=runner).run()
+    codes = runner.wait(timeout=420)
+    assert codes == [0, 0], [p.captured_stderr[-2000:] for p in runner.procs]
+
+    # the single-process oracle: same recipe on this process's 8-device mesh
+    ns = {}
+    exec(TRAIN_SNIPPET, ns)
+    oracle_params, oracle_losses = ns["run_training"]()
+    oracle_leaves = jax.tree.leaves(oracle_params)
+
+    got = np.load(tmp_path / "params.npz")
+    assert len(got.files) == len(oracle_leaves)
+    for i, leaf in enumerate(oracle_leaves):
+        np.testing.assert_allclose(
+            got[str(i)], np.asarray(leaf), rtol=1e-5, atol=1e-6,
+            err_msg=f"leaf {i} diverged between 1-process and 2-process runs",
+        )
+
+    cluster_losses = json.loads((tmp_path / "losses.json").read_text())
+    np.testing.assert_allclose(cluster_losses, oracle_losses,
+                               rtol=1e-4, atol=1e-5)
+    assert cluster_losses[-1] < cluster_losses[0]  # it actually learned
