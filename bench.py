@@ -450,7 +450,7 @@ def main():
     results = run_all_configs(accel)
     tta = None
     if accel.platform == "tpu":
-        run_transformer_config(accel)
+        results["transformer_bf16_L2048"] = run_transformer_config(accel)
         log("[time-to-accuracy] ADAG/LeNet to 0.99 test accuracy")
         tta = run_time_to_accuracy(accel)
     if args.scaling:

@@ -54,8 +54,10 @@ class FScoreEvaluator:
     """Precision / recall / F1 (beyond the reference's accuracy-only module).
 
     ``average="binary"`` scores class ``pos_label`` only; ``"macro"``
-    averages the per-class scores unweighted over the classes present in
-    the labels. Zero-division cases score 0, sklearn-style.
+    averages the per-class scores unweighted over the union of classes
+    present in the labels or the predictions (sklearn semantics — a class
+    predicted but absent from the eval split still counts, as 0).
+    Zero-division cases score 0, sklearn-style.
     """
 
     def __init__(self, metric: str = "f1", average: str = "binary",
@@ -94,7 +96,7 @@ class FScoreEvaluator:
         label = _class_indices(ds[self.label_col], len(ds))
         if self.average == "binary":
             return self._score_one(pred, label, self.pos_label)
-        classes = np.unique(label)
+        classes = np.union1d(np.unique(label), np.unique(pred))
         return float(np.mean(
             [self._score_one(pred, label, int(c)) for c in classes]
         ))
@@ -106,6 +108,9 @@ class AUCEvaluator:
     The prediction column may hold a single score per row or ``[N, C]``
     class scores — the ``pos_label`` column is the score and rows with
     ``label == pos_label`` are the positives (one-vs-rest for C > 2).
+    A single score column is the score FOR class ``pos_label``: with
+    ``pos_label == 0`` the 1-D scores are negated so "higher score" still
+    means "more positive" (mirroring the column-select of the [N, C] path).
     """
 
     def __init__(self, prediction_col: str = "prediction",
@@ -125,6 +130,13 @@ class AUCEvaluator:
             scores = scores[:, self.pos_label]
         else:
             scores = scores.reshape(len(ds))
+            if self.pos_label == 0:
+                scores = -scores
+            elif self.pos_label != 1:
+                raise ValueError(
+                    f"pos_label {self.pos_label} needs [N, C] class scores; "
+                    "a single score column only identifies class 0 vs 1"
+                )
         label = _class_indices(ds[self.label_col], len(ds))
         pos = label == self.pos_label
         n_pos, n_neg = int(pos.sum()), int((~pos).sum())

@@ -127,9 +127,12 @@ class SPMDEngine:
     ``grad_accum=A`` splits each global batch into A equal microbatches and
     accumulates their gradients in a ``lax.scan`` before the single optimizer
     update — activation memory drops ~A× while the update stays the
-    full-batch one (exactly, for mean losses over equal microbatches; pinned
-    by tests/test_fsdp.py). The scan carry holds one grads-sized buffer, not
-    A of them.
+    full-batch one (exactly for loss/gradients over equal-size mean-loss
+    microbatches; pinned by tests/test_fsdp.py). Non-trainable state ``nt``
+    (e.g. BatchNorm running stats) is threaded through the scan and updated
+    once per microbatch, so it follows standard grad-accum semantics rather
+    than matching a single full-batch step. The scan carry holds one
+    grads-sized buffer, not A of them.
     """
 
     def __init__(self, spec, loss_step, optimizer, mesh: Mesh,
@@ -147,11 +150,16 @@ class SPMDEngine:
         self.param_specs = param_specs  # resolved at init_state
         self._batch_sharding = batch_sharding(mesh, dp_axis)
         self._step = None
+        self._step_fn = None
+        self._resident = None
+
+    def _resolve_specs(self, params):
+        if self.param_specs is None:
+            self.param_specs = megatron_specs(params, self.tp_axis)
 
     def init_state(self, params, nt):
         """Shard params per the specs; opt state pinned to the same layout."""
-        if self.param_specs is None:
-            self.param_specs = megatron_specs(params, self.tp_axis)
+        self._resolve_specs(params)
         params = shard_pytree(params, self.mesh, self.param_specs)
         rep = NamedSharding(self.mesh, P())
         nt = jax.tree.map(lambda x: put_global(x, rep), nt)
@@ -160,6 +168,18 @@ class SPMDEngine:
         opt_state = jax.jit(
             self.optimizer.init, out_shardings=self._opt_shardings(params)
         )(params)
+        self._build_step()
+        return params, nt, opt_state
+
+    def place_state(self, params, nt, opt_state):
+        """Place restored host state onto the mesh (the resume path): params
+        per the specs, optimizer state back into its ZeRO/Megatron layout."""
+        self._resolve_specs(params)
+        params = shard_pytree(params, self.mesh, self.param_specs)
+        rep = NamedSharding(self.mesh, P())
+        nt = jax.tree.map(lambda x: put_global(x, rep), nt)
+        opt_state = jax.tree.map(put_global, opt_state,
+                                 self._opt_shardings(params))
         self._build_step()
         return params, nt, opt_state
 
@@ -237,12 +257,12 @@ class SPMDEngine:
             )
             return params, new_nt, opt_state, loss
 
+        self._step_fn = step
         self._step = jax.jit(step, donate_argnums=(0, 2))
+        self._resident = None
 
-    def run_step(self, params, nt, opt_state, batch_arrays: tuple):
-        """One global-batch step; ``batch_arrays`` host arrays ``[B, …]``."""
+    def _check_batch(self, B: int):
         dp = self.mesh.shape.get(self.dp_axis, 1)
-        B = batch_arrays[0].shape[0]
         if B % dp:
             raise ValueError(
                 f"global batch size {B} not divisible by mesh axis "
@@ -253,10 +273,74 @@ class SPMDEngine:
                 f"global batch size {B} not divisible by grad_accum "
                 f"{self.grad_accum} × dp {dp} = {self.grad_accum * dp}"
             )
+
+    def run_step(self, params, nt, opt_state, batch_arrays: tuple):
+        """One global-batch step; ``batch_arrays`` host arrays ``[B, …]``."""
+        self._check_batch(batch_arrays[0].shape[0])
         batch = tuple(
             put_global(a, self._batch_sharding) for a in batch_arrays
         )
         return self._step(params, nt, opt_state, batch)
+
+    # -- device-resident epoch (upload once, whole epoch in one dispatch) ----
+
+    def stage_epoch(self, col_arrays: tuple):
+        """Upload full data columns ``[N, …]`` once, rows sharded over dp.
+
+        The resident counterpart of the per-step host feed: after this, an
+        epoch is ONE dispatch with zero host↔device traffic (mirrors
+        ``LocalSGDEngine.stage_dataset`` — the rebuilt ``rdd.repartition``).
+        """
+        return tuple(put_global(a, self._batch_sharding) for a in col_arrays)
+
+    def run_epoch_resident(self, params, nt, opt_state, staged: tuple,
+                           batch_size: int, shuffle_seed: int | None):
+        """One epoch over staged columns in one jitted scan.
+
+        Shuffles on device when ``shuffle_seed`` is given (a global
+        permutation — rows migrate across dp shards through XLA collectives).
+        Rows beyond the last full batch are dropped, matching the streaming
+        path's ``Dataset.batches``. Returns ``(params, nt, opt_state,
+        losses[S])``.
+        """
+        if self._resident is None:
+            self._build_resident()
+        self._check_batch(int(batch_size))
+        key = jax.random.PRNGKey(0 if shuffle_seed is None else shuffle_seed)
+        return self._resident(params, nt, opt_state, staged, key,
+                              shuffle_seed is not None, int(batch_size))
+
+    def _build_resident(self):
+        mesh, dp_axis = self.mesh, self.dp_axis
+        step = self._step_fn
+
+        def resident_fn(params, nt, opt_state, staged, key, do_shuffle, B):
+            rows = staged[0].shape[0]
+            S = rows // B
+            if do_shuffle:
+                perm = jax.random.permutation(key, rows)
+                staged = tuple(jnp.take(c, perm, axis=0) for c in staged)
+            mb_sh = NamedSharding(mesh, P(None, dp_axis))
+            data = tuple(
+                jax.lax.with_sharding_constraint(
+                    c[: S * B].reshape((S, B) + c.shape[1:]), mb_sh
+                )
+                for c in staged
+            )
+
+            def body(carry, b):
+                p, n, o = carry
+                p, n, o, loss = step(p, n, o, b)
+                return (p, n, o), loss
+
+            (params, nt, opt_state), losses = jax.lax.scan(
+                body, (params, nt, opt_state), data
+            )
+            return params, nt, opt_state, losses
+
+        self._resident = jax.jit(
+            resident_fn, donate_argnums=(0, 2), static_argnums=(5, 6)
+        )
 
 
 def assert_param_shardings(params, specs, mesh: Mesh):

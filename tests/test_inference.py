@@ -170,6 +170,38 @@ def test_auc_evaluator_pos_label_zero():
     assert AUCEvaluator(pos_label=1).evaluate(ds) == pytest.approx(1.0)
 
 
+def test_auc_evaluator_pos_label_zero_single_column():
+    """Regression (ADVICE r2): 1-D scores with pos_label=0 must negate the
+    scores, so a perfect class-0 classifier scores 1.0, not 0.0."""
+    from distkeras_tpu.evaluators import AUCEvaluator
+
+    # high score = class 1; class-0 rows sit at the bottom — perfect for 0
+    ds = Dataset({
+        "prediction": np.array([0.1, 0.2, 0.8, 0.9], np.float32),
+        "label": np.array([0, 0, 1, 1], np.int64),
+    })
+    assert AUCEvaluator(pos_label=0).evaluate(ds) == pytest.approx(1.0)
+    assert AUCEvaluator(pos_label=1).evaluate(ds) == pytest.approx(1.0)
+    with pytest.raises(ValueError, match="single score column"):
+        AUCEvaluator(pos_label=2).evaluate(ds)
+
+
+def test_fscore_macro_counts_predicted_only_classes():
+    """Regression (ADVICE r2): macro averages over the union of label and
+    prediction classes — a class predicted but absent from labels drags the
+    macro down (sklearn semantics) instead of being skipped."""
+    from distkeras_tpu.evaluators import FScoreEvaluator
+
+    # class 2 never appears in labels but is predicted once: p=0, r=0, f1=0
+    ds = Dataset({
+        "prediction": np.array([1, 1, 0, 2], np.int64),
+        "label": np.array([1, 1, 0, 0], np.int64),
+    })
+    # class 0: tp=1 fp=0 fn=1 → f1=2/3; class 1: tp=2 → f1=1; class 2: 0
+    assert FScoreEvaluator("f1", average="macro").evaluate(ds) == \
+        pytest.approx((2 / 3 + 1.0 + 0.0) / 3)
+
+
 def test_auc_evaluator_multiclass_one_vs_rest():
     from distkeras_tpu.evaluators import AUCEvaluator
 
