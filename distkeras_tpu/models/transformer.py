@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu.model import ModelSpec, from_flax
+from distkeras_tpu.parallel.mesh import put_global
 from distkeras_tpu.parallel.sequence import attention_reference
 
 
@@ -264,8 +265,8 @@ def sequence_parallel_transformer_forward(module: TransformerClassifier,
         batch_axis,
     )
     sh = NamedSharding(mesh, P(batch_axis, axis))
-    tokens = jax.device_put(tokens, sh)
-    mask = jax.device_put(mask, sh)
+    tokens = put_global(tokens, sh)
+    mask = put_global(mask, sh)
     return shard_fn(params, tokens, mask)
 
 

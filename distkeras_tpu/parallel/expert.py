@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distkeras_tpu.parallel.mesh import put_global
+
 
 def init_moe_params(rng: np.random.Generator, d_model: int, d_hidden: int,
                     num_experts: int, scale: float = 0.02) -> dict:
@@ -165,8 +167,8 @@ def moe_mlp(params, x, mesh: Mesh, axis: str = "ep", top_k: int = 1,
         check_vma=False,
     )
     params = {
-        k: jax.device_put(v, NamedSharding(mesh, pspec[k]))
+        k: put_global(v, NamedSharding(mesh, pspec[k]))
         for k, v in params.items()
     }
-    x = jax.device_put(x, NamedSharding(mesh, P(axis)))
+    x = put_global(x, NamedSharding(mesh, P(axis)))
     return fn(params, x)

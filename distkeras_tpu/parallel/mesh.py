@@ -69,7 +69,9 @@ def put_global(array, sharding: NamedSharding):
     shipping (reference ``distkeras/workers.py :: Worker.train`` ran against
     rows Spark had already moved to the executor; SURVEY.md §3.1 boundary #1).
     """
-    if jax.process_count() == 1:
+    if isinstance(array, jax.core.Tracer) or jax.process_count() == 1:
+        # under a jit trace device_put lowers to a sharding constraint, which
+        # is the right multi-process semantics too (GSPMD owns the layout)
         return jax.device_put(array, sharding)
     array = np.asarray(array)
     return jax.make_array_from_callback(

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distkeras_tpu.parallel.mesh import put_global
+
 
 def _tree_ppermute(tree, axis_name, perm):
     return jax.tree.map(lambda x: jax.lax.ppermute(x, axis_name, perm), tree)
@@ -145,7 +147,7 @@ def pipeline_apply(stage_fn, stage_params, x, mesh: Mesh,
         check_vma=False,
     )
     stage_params = jax.tree.map(
-        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        lambda p, s: put_global(p, NamedSharding(mesh, s)),
         stage_params, pspec,
     )
     out_mb = fn(stage_params, x_mb)

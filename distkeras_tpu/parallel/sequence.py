@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distkeras_tpu.parallel.mesh import put_global
+
 _NEG = -1e9  # finite "masked" score: keeps the online softmax NaN-free
 
 
@@ -138,7 +140,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str | None = None,
     )
     spec = P(None, axis, None, None)
     sharding = NamedSharding(mesh, spec)
-    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    q, k, v = (put_global(x, sharding) for x in (q, k, v))
     if key_mask is None:
         shard_fn = jax.shard_map(
             body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -150,5 +152,5 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str | None = None,
         body, mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
         check_vma=False,
     )
-    key_mask = jax.device_put(key_mask, NamedSharding(mesh, mspec))
+    key_mask = put_global(key_mask, NamedSharding(mesh, mspec))
     return jax.jit(shard_fn)(q, k, v, key_mask)

@@ -15,6 +15,7 @@ import numpy as np
 
 from distkeras_tpu.data import Dataset
 from distkeras_tpu.model import ModelSpec, from_keras
+from distkeras_tpu.parallel.mesh import put_global
 
 
 class ModelPredictor:
@@ -62,8 +63,8 @@ class ModelPredictor:
                 )
             self._x_sharding = NamedSharding(mesh, P(dp_axis))
             rep = NamedSharding(mesh, P())
-            self.params = jax.device_put(self.params, rep)
-            self.state = jax.device_put(self.state, rep)
+            self.params = jax.tree.map(lambda p: put_global(p, rep), self.params)
+            self.state = jax.tree.map(lambda s: put_global(s, rep), self.state)
         spec = self.spec
 
         def fwd(params, state, x):
@@ -85,7 +86,7 @@ class ModelPredictor:
                     np.concatenate([c, np.repeat(c[-1:], pad, axis=0)]) for c in chunk
                 ]
             if self._x_sharding is not None:
-                chunk = [jax.device_put(c, self._x_sharding) for c in chunk]
+                chunk = [put_global(c, self._x_sharding) for c in chunk]
             x = chunk[0] if len(chunk) == 1 else tuple(chunk)
             out = np.asarray(self._fwd(self.params, self.state, x))
             outs.append(out[: bs - pad] if pad else out)
