@@ -39,6 +39,10 @@ class ModelSpec:
     #: stacked-worker vmap axis (e.g. sync BatchNorm) and therefore cannot
     #: run on the PS backend's independent host threads
     requires_worker_axis: bool = False
+    #: the underlying flax module when built by :func:`from_flax` — lets
+    #: strategy engines (pipeline/sequence/expert) rebuild mesh-specialized
+    #: forwards; ``None`` for Keras or hand-written specs
+    module: Any = None
 
     def init_np(self, seed: int = 0) -> tuple[Pytree, Pytree]:
         """Host-side init convenience returning NumPy pytrees."""
@@ -79,7 +83,8 @@ def from_flax(module, example_input, *, name: str | None = None,
         out = module.apply({"params": params, **state}, *inputs, training=training)
         return out, state
 
-    return ModelSpec(init=init, apply=apply, name=name or type(module).__name__)
+    return ModelSpec(init=init, apply=apply, name=name or type(module).__name__,
+                     module=module)
 
 
 def from_keras(model, *, name: str | None = None) -> ModelSpec:

@@ -98,7 +98,7 @@ class FSDPEngine(SPMDEngine):
         self.tensor_parallel = bool(tensor_parallel)
         self.min_size = int(min_size)
 
-    def init_state(self, params, nt):
+    def _resolve_specs(self, params):
         if self.param_specs is None:
             base = (megatron_specs(params, self.tp_axis)
                     if self.tensor_parallel else None)
@@ -106,4 +106,3 @@ class FSDPEngine(SPMDEngine):
                 params, self.mesh.shape[self.dp_axis], axis=self.dp_axis,
                 base_specs=base, min_size=self.min_size,
             )
-        return super().init_state(params, nt)

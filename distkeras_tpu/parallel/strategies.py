@@ -1,0 +1,245 @@
+"""Trainer-level strategy seams for pipeline / sequence / expert parallelism.
+
+The reference's product surface was *trainer ergonomics*: one class per
+distribution strategy, ``trainer.train(dataset)`` and nothing else (reference
+``distkeras/trainers.py`` — SURVEY.md §2b #3-8). The rebuild's PP/SP/EP
+libraries (:mod:`distkeras_tpu.parallel.pipeline`, ``.sequence``, ``.expert``)
+were originally reachable only by writing your own loop; this module closes
+that gap by expressing each strategy as the pieces
+:class:`~distkeras_tpu.parallel.tensor.SPMDEngine` consumes:
+
+- a ``loss_step(params, nt, batch) -> (loss, new_nt)`` whose forward runs the
+  strategy's mesh program (GPipe scan, ring attention shard_map, GShard
+  all_to_all);
+- a ``PartitionSpec`` pytree giving the parameter layout the strategy wants
+  (stages over ``pp``, replicated for SP, experts over ``ep``);
+- for pipeline, a params re-layout: per-block subtrees are stacked onto a
+  leading ``[S]`` axis so each device *stores* exactly its stage (true
+  pipeline memory scaling), and unstacked again for the returned model.
+
+``MeshTrainer(strategy=...)`` wires these into the ordinary engine loop, so
+checkpointing, profiling, metrics, and the resident input path work for every
+strategy for free.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+Pytree = object
+
+
+def _split_batch(batch):
+    """``(*features, label)`` → (tokens, mask, label); mask defaults to ones.
+
+    All three strategies train the transformer families, whose feature
+    columns are ``(tokens,)`` or ``(tokens, mask)`` — anything else is a
+    configuration error, not something to paper over with a ones-mask.
+    """
+    if len(batch) not in (2, 3):
+        raise ValueError(
+            f"pipeline/sequence/expert strategies take features_col="
+            f"['tokens'] or ['tokens', 'mask']; got {len(batch) - 1} "
+            f"feature columns"
+        )
+    toks = batch[0]
+    if len(batch) == 3:
+        mask = batch[1]
+    else:
+        mask = jnp.ones(toks.shape, jnp.float32)
+    return toks, mask, batch[-1]
+
+
+def _require_module(spec, strategy: str, cls):
+    module = getattr(spec, "module", None)
+    if module is None or not isinstance(module, cls):
+        raise TypeError(
+            f"strategy={strategy!r} needs a ModelSpec built by from_flax "
+            f"around a {cls.__name__} (got "
+            f"{type(module).__name__ if module else 'no module'}); use "
+            f"distkeras_tpu.models.{'moe_transformer_classifier' if strategy == 'expert' else 'transformer_classifier'}(...)"
+        )
+    return module
+
+
+# ---------------------------------------------------------------------------
+# Pipeline (GPipe over 'pp', optionally × dp)
+# ---------------------------------------------------------------------------
+
+
+def split_pipeline_params(params, depth: int):
+    """Model params → engine layout: ``blocks_i`` stacked on a ``[S]`` axis.
+
+    The stacked subtree is what :func:`...pipeline.pipeline_apply` consumes
+    and — sharded ``P('pp')`` — what makes each device store only its stage.
+    """
+    from distkeras_tpu.parallel.pipeline import stack_stage_params
+
+    missing = [i for i in range(depth) if f"blocks_{i}" not in params]
+    if missing:
+        raise ValueError(
+            f"params lack pipeline stages blocks_{missing}; strategy="
+            f"'pipeline' needs the TransformerClassifier block layout"
+        )
+    stages = stack_stage_params([params[f"blocks_{i}"] for i in range(depth)])
+    rest = {k: v for k, v in params.items() if not k.startswith("blocks_")}
+    return {"stages": stages, "rest": rest}
+
+
+def join_pipeline_params(split, depth: int):
+    """Engine layout → model params (host-side, for the trained result)."""
+    params = dict(split["rest"])
+    for i in range(depth):
+        params[f"blocks_{i}"] = jax.tree.map(
+            lambda s: np.asarray(s[i]), split["stages"]
+        )
+    return params
+
+
+def pipeline_strategy(spec, loss_fn, mesh, *, pp_axis: str = "pp",
+                      dp_axis: str | None = None,
+                      microbatches: int | None = None):
+    """Build (loss_step, param_specs, to_engine, from_engine) for GPipe.
+
+    Stage params live stacked ``[S, …]`` sharded over ``pp`` (one stage per
+    device); embed/head replicated. The loss forward is the differentiable
+    collective pipeline — XLA derives the reverse schedule through the scan.
+    Cites reference ``distkeras/trainers.py`` ergonomics; pipeline math per
+    Huang et al. 2019 (GPipe).
+    """
+    from distkeras_tpu.models.transformer import (
+        EncoderBlock,
+        TransformerClassifier,
+    )
+    from distkeras_tpu.parallel.pipeline import pipeline_apply
+
+    module = _require_module(spec, "pipeline", TransformerClassifier)
+    if module.depth != mesh.shape[pp_axis]:
+        raise ValueError(
+            f"model depth {module.depth} != mesh axis '{pp_axis}' size "
+            f"{mesh.shape[pp_axis]} (one encoder block per stage)"
+        )
+    block = EncoderBlock(dim=module.dim, heads=module.heads,
+                         causal=module.causal, dtype=module.dtype,
+                         attn_impl=module.attn_impl)
+    depth = module.depth
+
+    def loss_step(params, nt, batch):
+        toks, mask, y = _split_batch(batch)
+        x = module.apply({"params": params["rest"]}, toks,
+                         method=TransformerClassifier.embed_tokens)
+
+        def stage(p, act):
+            h, m = act
+            return block.apply({"params": p}, h, m, False), m
+
+        x, _ = pipeline_apply(stage, params["stages"], (x, mask), mesh,
+                              axis=pp_axis, microbatches=microbatches,
+                              batch_axis=dp_axis)
+        logits = module.apply({"params": params["rest"]}, x, mask,
+                              method=TransformerClassifier.head_logits)
+        return loss_fn(y, logits), nt
+
+    def specs_for(eparams):
+        return {
+            "stages": jax.tree.map(lambda _: P(pp_axis), eparams["stages"]),
+            "rest": jax.tree.map(lambda _: P(), eparams["rest"]),
+        }
+
+    return (loss_step, specs_for,
+            lambda p: split_pipeline_params(p, depth),
+            lambda p: join_pipeline_params(p, depth))
+
+
+# ---------------------------------------------------------------------------
+# Sequence (ring attention over 'sp', optionally × dp)
+# ---------------------------------------------------------------------------
+
+
+def sequence_strategy(spec, loss_fn, mesh, *, sp_axis: str = "sp",
+                      dp_axis: str | None = None):
+    """Build the SP pieces: activations sharded along L, ring attention.
+
+    Params replicated (they are small relative to long-context activations —
+    the memory axis SP scales is L); compose ``parameter_sharding`` needs via
+    dp×sp + fsdp in a later round if a use case appears.
+    """
+    from distkeras_tpu.models.transformer import (
+        TransformerClassifier,
+        sequence_parallel_transformer_forward,
+    )
+
+    module = _require_module(spec, "sequence", TransformerClassifier)
+
+    def loss_step(params, nt, batch):
+        toks, mask, y = _split_batch(batch)
+        logits = sequence_parallel_transformer_forward(
+            module, params, toks, mask, mesh, axis=sp_axis,
+            batch_axis=dp_axis,
+        )
+        return loss_fn(y, logits), nt
+
+    def specs_for(eparams):
+        return jax.tree.map(lambda _: P(), eparams)
+
+    ident = lambda p: p
+    return loss_step, specs_for, ident, ident
+
+
+# ---------------------------------------------------------------------------
+# Expert (GShard MoE over 'ep')
+# ---------------------------------------------------------------------------
+
+
+def expert_specs(params, ep_axis: str = "ep"):
+    """PartitionSpec pytree for the MoE family: expert-stacked leaves
+    (``w1/b1/w2/b2``, leading ``[E]`` axis) shard over ``ep``; the gate,
+    attention, and embed/head stay replicated (GShard layout, Lepikhin et
+    al. 2020)."""
+
+    def spec_for(path, leaf):
+        last = getattr(path[-1], "key", getattr(path[-1], "name", None))
+        if last in ("w1", "b1", "w2", "b2"):
+            return P(ep_axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def expert_strategy(spec, loss_fn, mesh, *, ep_axis: str = "ep",
+                    aux_weight: float = 1e-2):
+    """Build the EP pieces: experts sharded over ``ep``, tokens exchanged
+    with ``all_to_all``, gating auxiliary loss folded into the objective."""
+    from distkeras_tpu.models.moe import (
+        MoETransformerClassifier,
+        moe_aux_loss,
+    )
+
+    module = _require_module(spec, "expert", MoETransformerClassifier)
+    if module.num_experts % mesh.shape[ep_axis]:
+        raise ValueError(
+            f"{module.num_experts} experts not divisible by mesh axis "
+            f"'{ep_axis}' of size {mesh.shape[ep_axis]}"
+        )
+    smod = module.clone(mesh=mesh, ep_axis=ep_axis)
+
+    def loss_step(params, nt, batch):
+        toks, mask, y = _split_batch(batch)
+        logits, aux = moe_aux_loss(smod, params, (toks, mask), training=True)
+        return loss_fn(y, logits) + aux_weight * aux, nt
+
+    def specs_for(eparams):
+        return expert_specs(eparams, ep_axis)
+
+    ident = lambda p: p
+    return loss_step, specs_for, ident, ident
+
+
+STRATEGIES = {
+    "pipeline": pipeline_strategy,
+    "sequence": sequence_strategy,
+    "expert": expert_strategy,
+}

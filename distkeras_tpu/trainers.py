@@ -116,6 +116,15 @@ def _make_loss_step(spec: ModelSpec, loss_fn: Callable, n_feat: int):
     return loss_step
 
 
+def _fits_device_budget(ds: Dataset, cols, budget_bytes: int) -> bool:
+    """One accounting rule for the auto resident-vs-stream input decision,
+    shared by DistributedTrainer and MeshTrainer."""
+    row_bytes = sum(
+        int(np.prod(ds[c].shape[1:])) * ds[c].dtype.itemsize for c in cols
+    )
+    return len(ds) * row_bytes <= budget_bytes
+
+
 def _as_spec(model) -> tuple[ModelSpec, Any]:
     """Accept a Keras model or a ModelSpec; return (spec, keras_model|None)."""
     if isinstance(model, ModelSpec):
@@ -185,6 +194,23 @@ class Trainer:
         if self.log_metrics:
             print(json.dumps({"metric": label, **rec}), flush=True)
 
+    def _materialize_history(self):
+        """Pull device loss scalars to host and expand per-epoch loss arrays
+        into one record per window (the reference's per-window history)."""
+        expanded = []
+        for rec in self.history.records:
+            if "losses" in rec:
+                arr = np.asarray(jax.device_get(rec["losses"]))
+                expanded.extend(
+                    {"loss": float(v), "epoch": rec.get("epoch")} for v in arr
+                )
+            elif "loss" in rec:
+                rec["loss"] = float(jax.device_get(rec["loss"]))
+                expanded.append(rec)
+            else:
+                expanded.append(rec)
+        self.history.records = expanded
+
     # -- core -------------------------------------------------------------
 
     def train(self, dataset, shuffle: bool = False):
@@ -227,6 +253,7 @@ class DistributedTrainer(Trainer):
                  backend: str = "collective", mesh=None, seed: int = 0,
                  device_data: bool | None = None,
                  ps_transport: str = "inprocess", ps_port: int = 0,
+                 ps_host: str | None = None, worker_id_offset: int = 0,
                  checkpoint_dir=None, checkpoint_every: int = 1,
                  resume: bool = False, profile_dir=None,
                  log_metrics: bool = False,
@@ -258,6 +285,18 @@ class DistributedTrainer(Trainer):
             )
         self.ps_transport = ps_transport
         self.ps_port = ps_port
+        # ps_host points this trainer's workers at an EXTERNAL socket PS
+        # (another process/host — the reference's driver-hosted PS serving
+        # remote executors, reference ``distkeras/parameter_servers.py ::
+        # SocketParameterServer``). The PS owner decides the global worker
+        # count; worker_id_offset de-conflicts ids across trainer processes.
+        if ps_host is not None and ps_transport != "socket":
+            raise ValueError(
+                "ps_host requires ps_transport='socket' (an external PS is "
+                "only reachable over TCP)"
+            )
+        self.ps_host = ps_host
+        self.worker_id_offset = int(worker_id_offset)
         # device_data=True stages each epoch in HBM and scans all windows in
         # one dispatch; None = auto (on when the epoch fits the budget).
         # NOTE on shuffle semantics: with shuffle=False the two paths are
@@ -316,6 +355,16 @@ class DistributedTrainer(Trainer):
                 "single-process mesh"
             )
         if self.backend == "ps":
+            if jax.process_count() > 1:
+                # fail fast — hogwild threads are placed over jax.devices(),
+                # which under jax.distributed includes devices this process
+                # cannot address (and every controller would run its own
+                # full hogwild loop)
+                raise NotImplementedError(
+                    "backend='ps' under multi-process jax.distributed is "
+                    "not supported; run one trainer per host against a "
+                    "shared ps_transport='socket' server instead"
+                )
             _reject_worker_axis_model(
                 self.spec, "backend='ps' (independent hogwild host threads)"
             )
@@ -367,10 +416,9 @@ class DistributedTrainer(Trainer):
 
         use_resident = self.device_data
         if use_resident is None:
-            row_bytes = sum(
-                int(np.prod(ds[c].shape[1:])) * ds[c].dtype.itemsize for c in cols
+            use_resident = _fits_device_budget(
+                ds, cols, self.device_data_budget_bytes
             )
-            use_resident = len(ds) * row_bytes <= self.device_data_budget_bytes
 
         self.record_training_start()
         if use_resident:
@@ -459,24 +507,6 @@ class DistributedTrainer(Trainer):
         ckpt.save_checkpoint(
             self.checkpoint_dir, {"state": state, "epoch": epoch}, step=epoch
         )
-
-    def _materialize_history(self):
-        """Pull device loss scalars to host and expand per-epoch loss arrays
-        into one record per window (the reference's per-window history)."""
-        expanded = []
-        for rec in self.history.records:
-            if "losses" in rec:
-                arr = np.asarray(jax.device_get(rec["losses"]))
-                expanded.extend(
-                    {"loss": float(v), "epoch": rec.get("epoch")} for v in arr
-                )
-            elif "loss" in rec:
-                rec["loss"] = float(jax.device_get(rec["loss"]))
-                expanded.append(rec)
-            else:
-                expanded.append(rec)
-        self.history.records = expanded
-
 
 class AsynchronousDistributedTrainer(DistributedTrainer):
     """Parity alias: the reference's base class for the five asynchronous
@@ -580,43 +610,64 @@ class EAMSGD(AEASGD):
 
 
 class MeshTrainer(Trainer):
-    """Sync SPMD trainer over an N-D mesh — data × tensor parallelism.
+    """Sync SPMD trainer over an N-D mesh — the full parallelism portfolio.
 
     Beyond-reference (SURVEY.md §2b.2 lists TP as "natural extension via
-    jax.sharding"): trains ONE set of parameters with synchronous data
-    parallelism over the ``dp`` mesh axis and Megatron-style tensor
-    parallelism over ``tp`` (column/row-parallel kernels, vocab-parallel
-    embedding — see :mod:`distkeras_tpu.parallel.tensor`). The math equals
-    single-device training on the global batch (pinned by
-    tests/test_tensor_parallel.py), so it is the scale-out path for models
-    whose weights outgrow one chip, while the five reference algorithms
-    remain the local-SGD/PS paths.
+    jax.sharding"): trains ONE set of parameters over a device mesh, with the
+    distribution strategy selected by ``strategy``:
+
+    - ``"spmd"`` (default) — data parallelism over ``dp`` × Megatron tensor
+      parallelism over ``tp``; ``parameter_sharding`` picks the layout
+      (``"megatron"``, ``"fsdp"``/ZeRO-3, ``"fsdp+megatron"``). Math equals
+      single-device training on the global batch (tests/test_tensor_parallel).
+    - ``"pipeline"`` — GPipe: the transformer's encoder blocks are pipeline
+      stages over a ``pp`` axis (``depth == mesh.shape['pp']``), each device
+      storing exactly its stage; optional ``dp`` axis composes data
+      parallelism. ``microbatches`` controls the bubble fraction.
+    - ``"sequence"`` — ring attention: activations sharded along L over an
+      ``sp`` axis (per-chip activation memory O(L/N)); optional ``dp`` axis.
+    - ``"expert"`` — GShard MoE over an ``ep`` axis: experts sharded, tokens
+      exchanged with ``all_to_all``, gating aux loss (weight ``aux_weight``)
+      folded into the objective. Needs a ``moe_transformer_classifier`` model.
+
+    The reference's product surface was exactly this one-class-per-strategy
+    ergonomics (reference ``distkeras/trainers.py``); here every strategy is a
+    kwarg on the same trainer, and checkpoint/resume, profiling, metrics, and
+    the resident input path apply to all of them.
 
     ``mesh_shape`` e.g. ``{"dp": 2, "tp": 4}``; ``param_specs`` overrides the
-    automatic Megatron rules with an explicit PartitionSpec pytree.
-
-    ``parameter_sharding`` selects the parameter layout:
-
-    - ``"megatron"`` (default) — Megatron column/row rules over ``tp``
-      (replicated when the mesh has no ``tp`` axis);
-    - ``"fsdp"`` — ZeRO-3: every large leaf sharded over ``dp``, optimizer
-      state sharded by propagation (:mod:`distkeras_tpu.parallel.fsdp`);
-    - ``"fsdp+megatron"`` — Megatron over ``tp`` first, FSDP shards the
-      remaining dims over ``dp``.
+    automatic partitioning rules with an explicit PartitionSpec pytree.
 
     ``grad_accum=A`` accumulates gradients over A equal microbatches per
     optimizer update (a ``lax.scan`` inside the jitted step) — ~A× less
     activation memory at the same effective batch size.
+
+    ``checkpoint_dir``/``checkpoint_every``/``resume`` snapshot the sharded
+    training state (params + optimizer in their mesh layout, gathered to
+    host) at epoch boundaries and restore it back onto the mesh —
+    resume-equality is pinned by tests/test_fsdp.py. ``profile_dir`` wraps
+    training in ``jax.profiler.trace``. ``input_mode="resident"`` uploads the
+    dataset once and runs each epoch as one jitted scan (no per-step host
+    round-trip); ``"auto"`` chooses resident when the dataset fits the
+    ``device_data_budget_bytes`` budget, mirroring DistributedTrainer.
     """
+
+    device_data_budget_bytes = 1 << 30
 
     def __init__(self, keras_model, loss="sparse_softmax_cross_entropy",
                  worker_optimizer="adam", learning_rate: float = 1e-3,
                  mesh=None, mesh_shape: dict | None = None, param_specs=None,
+                 strategy: str = "spmd",
                  parameter_sharding: str = "megatron",
-                 grad_accum: int = 1,
+                 grad_accum: int = 1, microbatches: int | None = None,
+                 aux_weight: float = 1e-2,
                  batch_size: int = 32, features_col="features",
                  label_col: str = "label", num_epoch: int = 1, seed: int = 0,
-                 log_metrics: bool = False):
+                 log_metrics: bool = False,
+                 checkpoint_dir=None, checkpoint_every: int = 1,
+                 resume: bool = False, profile_dir=None,
+                 input_mode: str = "auto"):
+        from distkeras_tpu.parallel.strategies import STRATEGIES
         from distkeras_tpu.parallel.tensor import get_mesh_nd
 
         super().__init__(keras_model, loss, worker_optimizer,
@@ -625,69 +676,200 @@ class MeshTrainer(Trainer):
             mesh = get_mesh_nd(mesh_shape or {"dp": len(jax.devices())})
         self.mesh = mesh
         self.param_specs = param_specs
+        if strategy not in ("spmd",) + tuple(STRATEGIES):
+            raise ValueError(
+                f"strategy={strategy!r}: expected 'spmd', "
+                f"{', '.join(repr(s) for s in STRATEGIES)}"
+            )
+        self.strategy = strategy
         if parameter_sharding not in ("megatron", "fsdp", "fsdp+megatron"):
             raise ValueError(
                 f"parameter_sharding={parameter_sharding!r}: expected "
                 f"'megatron', 'fsdp', or 'fsdp+megatron'"
             )
+        if strategy != "spmd" and parameter_sharding != "megatron":
+            raise ValueError(
+                f"parameter_sharding={parameter_sharding!r} only applies to "
+                f"strategy='spmd'; {strategy!r} fixes its own layout"
+            )
         self.parameter_sharding = parameter_sharding
         self.grad_accum = int(grad_accum)
+        self.microbatches = microbatches
+        self.aux_weight = float(aux_weight)
         self.batch_size = int(batch_size)
         self.features_col: list[str] = _as_cols(features_col)
         self.label_col = label_col
         self.num_epoch = int(num_epoch)
         self.log_metrics = bool(log_metrics)
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.resume = bool(resume)
+        self.profile_dir = profile_dir
+        if input_mode not in ("auto", "stream", "resident"):
+            raise ValueError(
+                f"input_mode={input_mode!r}: expected 'auto', 'stream', or "
+                f"'resident'"
+            )
+        self.input_mode = input_mode
 
-    def train(self, dataset, shuffle: bool = False):
+    def _build_engine(self):
+        """Construct the strategy's engine + params re-layout callables."""
         from distkeras_tpu.parallel.fsdp import FSDPEngine
+        from distkeras_tpu.parallel.strategies import STRATEGIES
         from distkeras_tpu.parallel.tensor import SPMDEngine
 
-        _reject_worker_axis_model(
-            self.spec, "MeshTrainer (single-model GSPMD, no worker axis)"
-        )
-        ds = self._coerce_dataset(dataset)
-        cols = self.features_col + [self.label_col]
-        loss_step = _make_loss_step(
-            self.spec, self.loss_fn, len(self.features_col)
-        )
         optimizer = resolve_optimizer(
             self.worker_optimizer, self.learning_rate
         )
-        if self.parameter_sharding == "megatron":
-            engine = SPMDEngine(self.spec, loss_step, optimizer, self.mesh,
-                                param_specs=self.param_specs,
-                                grad_accum=self.grad_accum)
-        else:
-            engine = FSDPEngine(
-                self.spec, loss_step, optimizer, self.mesh,
-                tensor_parallel=(self.parameter_sharding == "fsdp+megatron"),
-                param_specs=self.param_specs, grad_accum=self.grad_accum,
+        ident = lambda p: p
+        if self.strategy == "spmd":
+            loss_step = _make_loss_step(
+                self.spec, self.loss_fn, len(self.features_col)
             )
-        params, nt, opt = engine.init_state(*self.spec.init_np(self.seed))
-
-        self.record_training_start()
-        for epoch in range(self.num_epoch):
-            seed = (self.seed + epoch) if shuffle else None
-            t0 = time.perf_counter()
-            n_steps = 0
-            for b in ds.batches(self.batch_size, cols, seed=seed):
-                params, nt, opt, loss = engine.run_step(params, nt, opt, b)
-                self.history.append(loss=loss, epoch=epoch)
-                n_steps += 1
-            if self.log_metrics and n_steps:
-                jax.block_until_ready(loss)
-                self._epoch_metrics(
-                    epoch, n_steps * self.batch_size, n_steps,
-                    time.perf_counter() - t0,
+            if self.parameter_sharding == "megatron":
+                engine = SPMDEngine(
+                    self.spec, loss_step, optimizer, self.mesh,
+                    param_specs=self.param_specs,
+                    grad_accum=self.grad_accum,
                 )
+            else:
+                engine = FSDPEngine(
+                    self.spec, loss_step, optimizer, self.mesh,
+                    tensor_parallel=(
+                        self.parameter_sharding == "fsdp+megatron"
+                    ),
+                    param_specs=self.param_specs,
+                    grad_accum=self.grad_accum,
+                )
+            return engine, ident, ident
+
+        dp_axis = "dp" if "dp" in self.mesh.shape else None
+        kwargs = {}
+        if self.strategy == "pipeline":
+            kwargs = dict(dp_axis=dp_axis, microbatches=self.microbatches)
+        elif self.strategy == "sequence":
+            kwargs = dict(dp_axis=dp_axis)
+        elif self.strategy == "expert":
+            kwargs = dict(aux_weight=self.aux_weight)
+        loss_step, specs_for, to_engine, from_engine = STRATEGIES[
+            self.strategy
+        ](self.spec, self.loss_fn, self.mesh, **kwargs)
+        # one init serves both the specs derivation and (via the cache)
+        # train()'s fresh-start state — no duplicate Flax init
+        self._init_cache = self.spec.init_np(self.seed)
+        specs = (self.param_specs if self.param_specs is not None
+                 else specs_for(to_engine(self._init_cache[0])))
+        engine = SPMDEngine(
+            self.spec, loss_step, optimizer, self.mesh, param_specs=specs,
+            dp_axis=dp_axis, grad_accum=self.grad_accum,
+        )
+        return engine, to_engine, from_engine
+
+    def train(self, dataset, shuffle: bool = False):
+        _reject_worker_axis_model(
+            self.spec, "MeshTrainer (single-model GSPMD, no worker axis)"
+        )
+        if (self.checkpoint_dir or self.profile_dir) \
+                and jax.process_count() > 1:
+            raise NotImplementedError(
+                "checkpoint_dir/profile_dir under multi-process "
+                "jax.distributed is not supported yet; run them from a "
+                "single-process mesh"
+            )
+        ds = self._coerce_dataset(dataset)
+        cols = self.features_col + [self.label_col]
+        engine, to_engine, from_engine = self._build_engine()
+
+        start_epoch = 0
+        restored = None
+        if self.checkpoint_dir and self.resume:
+            from distkeras_tpu import checkpoint as ckpt
+
+            if ckpt.latest_step(self.checkpoint_dir) is not None:
+                payload, _ = ckpt.restore_checkpoint(self.checkpoint_dir)
+                restored = payload
+                start_epoch = int(payload["epoch"]) + 1
+        if restored is not None:
+            params, nt, opt = engine.place_state(
+                restored["params"], restored["nt"], restored["opt"]
+            )
+        else:
+            p0, nt0 = (self._init_cache if getattr(self, "_init_cache", None)
+                       else self.spec.init_np(self.seed))
+            params, nt, opt = engine.init_state(to_engine(p0), nt0)
+        self._init_cache = None
+
+        use_resident = {
+            "stream": False, "resident": True,
+            "auto": _fits_device_budget(
+                ds, cols, self.device_data_budget_bytes
+            ),
+        }[self.input_mode]
+
+        ctx = (
+            jax.profiler.trace(str(self.profile_dir))
+            if self.profile_dir else contextlib.nullcontext()
+        )
+        self.record_training_start()
+        with ctx:
+            if use_resident:
+                staged = engine.stage_epoch(tuple(ds[c] for c in cols))
+                rows = (staged[0].shape[0] // self.batch_size) \
+                    * self.batch_size
+                for epoch in range(start_epoch, self.num_epoch):
+                    seed = (self.seed + epoch) if shuffle else None
+                    t0 = time.perf_counter() if self.log_metrics else 0.0
+                    params, nt, opt, losses = engine.run_epoch_resident(
+                        params, nt, opt, staged, self.batch_size, seed
+                    )
+                    self.history.append(losses=losses, epoch=epoch)
+                    if self.log_metrics:
+                        jax.block_until_ready(losses)
+                        self._epoch_metrics(
+                            epoch, rows, rows // self.batch_size,
+                            time.perf_counter() - t0,
+                        )
+                    self._maybe_checkpoint(params, nt, opt, epoch)
+            else:
+                for epoch in range(start_epoch, self.num_epoch):
+                    seed = (self.seed + epoch) if shuffle else None
+                    t0 = time.perf_counter() if self.log_metrics else 0.0
+                    n_steps = 0
+                    for b in ds.batches(self.batch_size, cols, seed=seed):
+                        params, nt, opt, loss = engine.run_step(
+                            params, nt, opt, b
+                        )
+                        self.history.append(loss=loss, epoch=epoch)
+                        n_steps += 1
+                    if self.log_metrics and n_steps:
+                        jax.block_until_ready(loss)
+                        self._epoch_metrics(
+                            epoch, n_steps * self.batch_size, n_steps,
+                            time.perf_counter() - t0,
+                        )
+                    self._maybe_checkpoint(params, nt, opt, epoch)
         jax.block_until_ready(jax.tree.leaves(params)[0])
         self.record_training_end()
-        for rec in self.history.records:
-            if "loss" in rec:
-                rec["loss"] = float(jax.device_get(rec["loss"]))
+        self._materialize_history()
         return self._finalize(
-            jax.tree.map(np.asarray, jax.device_get(params)),
+            from_engine(jax.tree.map(np.asarray, jax.device_get(params))),
             jax.tree.map(np.asarray, jax.device_get(nt)),
+        )
+
+    def _maybe_checkpoint(self, params, nt, opt, epoch: int):
+        if not self.checkpoint_dir:
+            return
+        from distkeras_tpu import checkpoint as ckpt
+
+        if not ckpt.should_checkpoint(epoch, self.checkpoint_every,
+                                      self.num_epoch):
+            return
+        # device_get gathers the sharded leaves to host (single-process);
+        # the engine layout is saved as-is and re-placed on resume
+        ckpt.save_checkpoint(
+            self.checkpoint_dir,
+            {"params": params, "nt": nt, "opt": opt, "epoch": epoch},
+            step=epoch,
         )
 
 

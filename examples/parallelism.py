@@ -105,67 +105,79 @@ def demo_fsdp(n_devices, rng):
 
 
 def demo_pp(n_devices, rng):
-    """Pipeline parallelism: the transformer's blocks as GPipe stages."""
+    """Pipeline parallelism: the transformer's blocks as GPipe stages —
+    one trainer call, each device storing exactly its stage."""
+    from distkeras_tpu import MeshTrainer
+    from distkeras_tpu.data import Dataset
     from distkeras_tpu.models import transformer_classifier
-    from distkeras_tpu.models.transformer import (
-        TransformerClassifier,
-        pipelined_transformer_forward,
+
+    pp = 4 if n_devices % 4 == 0 else n_devices
+    dp = n_devices // pp
+    toks, mask, y = make_task(rng, 256)
+    ds = Dataset({"features": toks, "mask": mask, "label": y})
+    trainer = MeshTrainer(
+        transformer_classifier(vocab=64, maxlen=16, dim=64, heads=4,
+                               depth=pp, num_classes=4, dtype=jnp.float32),
+        worker_optimizer="adam", learning_rate=2e-3,
+        mesh_shape={"dp": dp, "pp": pp} if dp > 1 else {"pp": pp},
+        strategy="pipeline", batch_size=32, num_epoch=6,
+        features_col=["features", "mask"], label_col="label",
     )
-    from distkeras_tpu.parallel.tensor import get_mesh_nd
-
-    depth = n_devices
-    mesh = get_mesh_nd({"pp": depth})
-    kw = dict(vocab=64, maxlen=16, dim=64, heads=4, depth=depth,
-              num_classes=4, dtype=jnp.float32)
-    spec = transformer_classifier(**kw)
-    module = TransformerClassifier(**kw)
-    params, _ = spec.init_np(0)
-    toks, mask, y = make_task(rng, 32)
-
-    ref = module.apply({"params": params}, toks, mask, False)
-    out = pipelined_transformer_forward(module, params, toks, mask, mesh)
-    err = float(jnp.max(jnp.abs(out - ref)))
-    print(f"[pp] {depth}-stage GPipe forward == sequential forward "
-          f"(max err {err:.1e})")
+    trainer.train(ds, shuffle=True)
+    losses = [r["loss"] for r in trainer.history.records if "loss" in r]
+    print(f"[pp] MeshTrainer GPipe dp={dp}×pp={pp}: loss "
+          f"{losses[0]:.3f} → {losses[-1]:.3f}")
 
 
 def demo_sp(n_devices, rng):
-    """Sequence parallelism: ring attention, context sharded over devices."""
-    from distkeras_tpu.parallel.mesh import get_mesh
-    from distkeras_tpu.parallel.sequence import (
-        attention_reference,
-        ring_attention,
-    )
+    """Sequence parallelism: ring attention, context sharded over devices —
+    one trainer call."""
+    from distkeras_tpu import MeshTrainer
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.models import transformer_classifier
 
-    mesh = get_mesh(n_devices, axis="sp")
-    B, L, H, D = 2, 64 * n_devices, 4, 32
-    q, k, v = (rng.normal(size=(B, L, H, D)).astype(np.float32)
-               for _ in range(3))
-    out = ring_attention(q, k, v, mesh, causal=True)
-    ref = attention_reference(q, k, v, causal=True)
-    err = float(jnp.max(jnp.abs(out - ref)))
-    print(f"[sp] ring attention, L={L} sharded over {n_devices} devices "
-          f"(max err {err:.1e})")
+    sp = 4 if n_devices % 4 == 0 else n_devices
+    dp = n_devices // sp
+    L = 16 * sp
+    toks, mask, y = make_task(rng, 256, maxlen=L)
+    ds = Dataset({"features": toks, "mask": mask, "label": y})
+    trainer = MeshTrainer(
+        transformer_classifier(vocab=64, maxlen=L, dim=64, heads=4, depth=2,
+                               num_classes=4, dtype=jnp.float32),
+        worker_optimizer="adam", learning_rate=2e-3,
+        mesh_shape={"dp": dp, "sp": sp} if dp > 1 else {"sp": sp},
+        strategy="sequence", batch_size=32, num_epoch=6,
+        features_col=["features", "mask"], label_col="label",
+    )
+    trainer.train(ds, shuffle=True)
+    losses = [r["loss"] for r in trainer.history.records if "loss" in r]
+    print(f"[sp] MeshTrainer ring attention dp={dp}×sp={sp}, L={L}: loss "
+          f"{losses[0]:.3f} → {losses[-1]:.3f}")
 
 
 def demo_ep(n_devices, rng):
-    """Expert parallelism: MoE layer, experts exchanged via all_to_all."""
-    from distkeras_tpu.parallel.expert import (
-        init_moe_params,
-        moe_mlp,
-        moe_mlp_reference,
-    )
-    from distkeras_tpu.parallel.tensor import get_mesh_nd
+    """Expert parallelism: GShard MoE, experts exchanged via all_to_all —
+    one trainer call."""
+    from distkeras_tpu import MeshTrainer
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.models import moe_transformer_classifier
 
-    mesh = get_mesh_nd({"ep": n_devices})
     E = 2 * n_devices
-    params = init_moe_params(rng, 32, 64, E, scale=0.2)
-    x = rng.normal(size=(16 * n_devices, 32)).astype(np.float32)
-    y, _ = moe_mlp(params, x, mesh, top_k=2, capacity_factor=E / 2)
-    ref, _ = moe_mlp_reference(params, x, top_k=2)
-    err = float(jnp.max(jnp.abs(y - ref)))
-    print(f"[ep] MoE, {E} experts over {n_devices} devices via all_to_all "
-          f"(max err {err:.1e})")
+    toks, mask, y = make_task(rng, 256)
+    ds = Dataset({"features": toks, "mask": mask, "label": y})
+    trainer = MeshTrainer(
+        moe_transformer_classifier(vocab=64, maxlen=16, dim=64, heads=4,
+                                   depth=2, num_experts=E, top_k=2,
+                                   num_classes=4, dtype=jnp.float32),
+        worker_optimizer="adam", learning_rate=2e-3,
+        mesh_shape={"ep": n_devices}, strategy="expert",
+        batch_size=32, num_epoch=6,
+        features_col=["features", "mask"], label_col="label",
+    )
+    trainer.train(ds, shuffle=True)
+    losses = [r["loss"] for r in trainer.history.records if "loss" in r]
+    print(f"[ep] MeshTrainer MoE, {E} experts over {n_devices} devices: "
+          f"loss {losses[0]:.3f} → {losses[-1]:.3f}")
 
 
 def main():

@@ -1,0 +1,240 @@
+"""MeshTrainer strategy seam: pipeline / sequence / expert through
+``trainer.train(ds)`` only, plus the aux-parity features (checkpoint/resume,
+profile_dir, resident input path).
+
+The reference's product surface was one-class-per-strategy trainer ergonomics
+(reference ``distkeras/trainers.py``); these tests pin that the rebuild's
+parallelism portfolio meets the same bar — no hand-rolled loops anywhere.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.trainers import MeshTrainer
+
+VOCAB, MAXLEN, CLASSES = 64, 32, 4
+
+
+def token_task(rng, n, maxlen=MAXLEN):
+    """Tokens whose high bits encode the class — learnable in a few epochs."""
+    y = rng.integers(0, CLASSES, size=(n,)).astype(np.int32)
+    toks = (
+        y[:, None] * (VOCAB // CLASSES)
+        + rng.integers(0, VOCAB // CLASSES, size=(n, maxlen))
+    ).astype(np.int32)
+    mask = np.ones((n, maxlen), np.float32)
+    return Dataset({"features": toks, "mask": mask, "label": y})
+
+
+def small_transformer(depth=2, **kw):
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models import transformer_classifier
+
+    return transformer_classifier(
+        vocab=VOCAB, maxlen=MAXLEN, dim=32, heads=4, depth=depth,
+        num_classes=CLASSES, dtype=jnp.float32, **kw,
+    )
+
+
+def losses_of(trainer):
+    return [r["loss"] for r in trainer.history.records if "loss" in r]
+
+
+def assert_learns(trainer):
+    losses = losses_of(trainer)
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < 0.6 * np.mean(losses[:4])
+
+
+def test_pipeline_strategy_trainer_learns(rng):
+    """dp×pp: encoder blocks as GPipe stages, driven by trainer.train only.
+    The returned params are in model layout (blocks unstacked) and usable
+    for plain inference."""
+    spec = small_transformer(depth=4)
+    ds = token_task(rng, 64)
+    trainer = MeshTrainer(
+        spec, worker_optimizer="adam", learning_rate=3e-3,
+        mesh_shape={"dp": 2, "pp": 4}, strategy="pipeline",
+        batch_size=16, num_epoch=8,
+        features_col=["features", "mask"], label_col="label",
+    )
+    params = trainer.train(ds, shuffle=True)
+    assert_learns(trainer)
+    assert "blocks_0" in params and "stages" not in params
+    out, _ = spec.apply(params, trainer.trained_nt_,
+                        (ds["features"][:8], ds["mask"][:8]), False)
+    assert out.shape == (8, CLASSES)
+
+
+def test_pipeline_stage_params_stored_sharded(rng):
+    """Each device stores exactly its stage: the engine-layout stacked
+    ``[S, …]`` leaves are sharded over pp (true pipeline memory scaling)."""
+    from jax.sharding import PartitionSpec as P
+
+    from distkeras_tpu.parallel.strategies import split_pipeline_params
+    from distkeras_tpu.parallel.tensor import get_mesh_nd
+
+    spec = small_transformer(depth=8)
+    trainer = MeshTrainer(
+        spec, mesh_shape={"pp": 8}, strategy="pipeline", batch_size=16,
+        features_col=["features", "mask"],
+    )
+    engine, to_engine, _ = trainer._build_engine()
+    params, nt, opt = engine.init_state(
+        to_engine(spec.init_np(0)[0]), spec.init_np(0)[1]
+    )
+    qkv = params["stages"]["qkv"]["kernel"]
+    assert qkv.shape[0] == 8
+    # one stage per device
+    assert {s.data.shape[0] for s in qkv.addressable_shards} == {1}
+    assert all(
+        s.sharding.is_equivalent_to(
+            jax.sharding.NamedSharding(trainer.mesh, P("pp")), s.ndim
+        )
+        for s in jax.tree.leaves(params["stages"])
+    )
+
+
+def test_sequence_strategy_trainer_learns(rng):
+    """dp×sp: ring attention, activations sharded along L, trainer-driven."""
+    spec = small_transformer(depth=2)
+    ds = token_task(rng, 64)
+    trainer = MeshTrainer(
+        spec, worker_optimizer="adam", learning_rate=3e-3,
+        mesh_shape={"dp": 2, "sp": 4}, strategy="sequence",
+        batch_size=16, num_epoch=8,
+        features_col=["features", "mask"], label_col="label",
+    )
+    params = trainer.train(ds, shuffle=True)
+    assert_learns(trainer)
+    out, _ = spec.apply(params, trainer.trained_nt_,
+                        (ds["features"][:8], ds["mask"][:8]), False)
+    assert out.shape == (8, CLASSES)
+
+
+def test_expert_strategy_trainer_learns(rng):
+    """ep: GShard MoE, experts sharded over the mesh, trainer-driven; the
+    expert leaves really live sharded over ep."""
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models import moe_transformer_classifier
+
+    spec = moe_transformer_classifier(
+        vocab=VOCAB, maxlen=MAXLEN, dim=32, heads=4, depth=2,
+        num_experts=8, top_k=2, num_classes=CLASSES, dtype=jnp.float32,
+    )
+    ds = token_task(rng, 64)
+    trainer = MeshTrainer(
+        spec, worker_optimizer="adam", learning_rate=3e-3,
+        mesh_shape={"ep": 8}, strategy="expert",
+        batch_size=16, num_epoch=8,
+        features_col=["features", "mask"], label_col="label",
+    )
+    params = trainer.train(ds, shuffle=True)
+    assert_learns(trainer)
+    # trained result predicts through the oracle (mesh=None) forward
+    out, _ = spec.apply(params, trainer.trained_nt_,
+                        (ds["features"][:8], ds["mask"][:8]), False)
+    assert out.shape == (8, CLASSES)
+
+
+def test_strategy_validation(rng):
+    from distkeras_tpu.models import mlp
+
+    with pytest.raises(ValueError, match="strategy"):
+        MeshTrainer(small_transformer(), strategy="tesseract")
+    with pytest.raises(ValueError, match="parameter_sharding"):
+        MeshTrainer(small_transformer(), strategy="sequence",
+                    parameter_sharding="fsdp")
+    # pipeline needs depth == pp size
+    t = MeshTrainer(small_transformer(depth=2), strategy="pipeline",
+                    mesh_shape={"pp": 8}, features_col=["features", "mask"])
+    with pytest.raises(ValueError, match="depth"):
+        t._build_engine()
+    # expert needs the MoE family
+    t = MeshTrainer(small_transformer(), strategy="expert",
+                    mesh_shape={"ep": 8}, features_col=["features", "mask"])
+    with pytest.raises(TypeError, match="MoETransformerClassifier"):
+        t._build_engine()
+    # pipeline/sequence need a flax transformer, not an arbitrary spec
+    t = MeshTrainer(mlp(), strategy="pipeline", mesh_shape={"pp": 8})
+    with pytest.raises(TypeError, match="TransformerClassifier"):
+        t._build_engine()
+
+
+def test_mesh_trainer_checkpoint_resume_fsdp(rng, tmp_path):
+    """Aux parity (VERDICT r2 #4): sharded-state checkpointing. A run that
+    crashes after epoch 0 and resumes matches the uninterrupted run exactly —
+    params AND adam moments restored into their ZeRO layout."""
+    from distkeras_tpu.models import mlp
+
+    x = rng.normal(size=(64, 16)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    ds = Dataset({"features": x, "label": y})
+
+    def make(ckpt_dir, num_epoch, resume=False):
+        return MeshTrainer(
+            mlp(input_shape=(16,), hidden=(512,), num_classes=2), worker_optimizer="adam",
+            learning_rate=5e-3, mesh_shape={"dp": 8},
+            parameter_sharding="fsdp", batch_size=16, num_epoch=num_epoch,
+            seed=7, checkpoint_dir=ckpt_dir, resume=resume,
+            input_mode="stream",
+        )
+
+    # uninterrupted 2-epoch run
+    t_full = make(tmp_path / "full", 2)
+    p_full = t_full.train(ds)
+
+    # epoch 0 only, then resume for epoch 1
+    make(tmp_path / "half", 1).train(ds)
+    t_res = make(tmp_path / "half", 2, resume=True)
+    p_res = t_res.train(ds)
+
+    for a, b in zip(jax.tree.leaves(p_full), jax.tree.leaves(p_res)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    # the resumed run only trained the second epoch
+    assert len(losses_of(t_res)) == len(losses_of(t_full)) // 2
+
+
+def test_mesh_trainer_profile_dir(rng, tmp_path):
+    from distkeras_tpu.models import mlp
+
+    x = rng.normal(size=(32, 16)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    trainer = MeshTrainer(
+        mlp(input_shape=(16,), hidden=(512,), num_classes=2), mesh_shape={"dp": 8}, batch_size=16,
+        num_epoch=1, profile_dir=tmp_path / "trace",
+    )
+    trainer.train(Dataset({"features": x, "label": y}))
+    assert any((tmp_path / "trace").rglob("*"))
+
+
+def test_mesh_trainer_resident_equals_stream(rng):
+    """input_mode='resident' (one jitted scan per epoch, data staged once)
+    computes the same training run as the per-step stream path."""
+    from distkeras_tpu.models import mlp
+
+    x = rng.normal(size=(64, 16)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    ds = Dataset({"features": x, "label": y})
+
+    def run(mode):
+        t = MeshTrainer(
+            mlp(input_shape=(16,), hidden=(512,), num_classes=2), worker_optimizer="adam",
+            learning_rate=5e-3, mesh_shape={"dp": 8}, batch_size=16,
+            num_epoch=3, seed=3, input_mode=mode,
+        )
+        return t.train(ds), losses_of(t)
+
+    p_stream, l_stream = run("stream")
+    p_res, l_res = run("resident")
+    assert len(l_stream) == len(l_res)
+    np.testing.assert_allclose(l_stream, l_res, rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_stream), jax.tree.leaves(p_res)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
