@@ -232,7 +232,25 @@ def run_async_training(trainer, ds, shuffle: bool):
             start_epoch = int(payload["epoch"]) + 1
 
     transport = getattr(trainer, "ps_transport", "inprocess")
-    if transport == "socket":
+    external_host = getattr(trainer, "ps_host", None)
+    offset = int(getattr(trainer, "worker_id_offset", 0))
+    if external_host is not None:
+        # External PS (another process/host — the reference's driver-hosted
+        # PS serving remote executors): this process contributes W workers;
+        # the server owner holds the center and the global worker count.
+        if ckpt_dir:
+            raise NotImplementedError(
+                "checkpoint_dir with an external ps_host is not supported: "
+                "the center lives in the PS owner's process"
+            )
+        ps = None
+        clients = [
+            ParameterServerClient(
+                external_host, int(getattr(trainer, "ps_port", 0)), offset + i
+            )
+            for i in range(W)
+        ]
+    elif transport == "socket":
         ps = SocketParameterServer(
             params, rule, W, port=getattr(trainer, "ps_port", 0)
         )
@@ -253,7 +271,7 @@ def run_async_training(trainer, ds, shuffle: bool):
         seed=trainer.seed if shuffle else None, cover_all=shuffle,
     )  # tuple of [W, rows_pw, …]
 
-    if restored_updates:
+    if restored_updates and ps is not None:
         ps.num_updates = restored_updates
 
     window_fn = _build_local_window(trainer._loss_step(), optimizer)
@@ -319,15 +337,11 @@ def run_async_training(trainer, ds, shuffle: bool):
     for t in threads:
         t.join()
 
-    if transport == "socket":
-        for c in clients:
-            c.close()
-    ps.stop()
-
     errors = [w.error for w in workers if w.error is not None]
     if errors:
         # a BrokenBarrierError is a symptom of a peer's failure — surface the
-        # root cause first
+        # root cause first (and BEFORE any final PS round-trip: a dead
+        # external PS must not mask the workers' own errors)
         errors.sort(key=lambda e: isinstance(e, threading.BrokenBarrierError))
         survivors = sum(1 for w in workers if w.error is None)
         if not getattr(trainer, "tolerate_worker_failures", False):
@@ -343,10 +357,30 @@ def run_async_training(trainer, ds, shuffle: bool):
             stacklevel=2,
         )
 
+    final_center = None
+    if ps is None:
+        # external PS: the final center belongs to its owner — take a last
+        # snapshot over the wire (bounded: training is done, a stuck server
+        # must not hang the driver), leave the server running
+        clients[0]._sock.settimeout(60)
+        try:
+            final_center = clients[0].pull()
+        except OSError as e:
+            raise RuntimeError(
+                f"training finished but the external PS at {external_host} "
+                f"stopped answering the final pull: {e}"
+            ) from e
+    if transport == "socket":
+        for c in clients:
+            c.close()
+    if ps is not None:
+        ps.stop()
+
     final_nt = next(
         (w.final_nt for w in workers if hasattr(w, "final_nt")), nt
     )
-    return ps.get_model(), final_nt, history
+    return (ps.get_model() if ps is not None else final_center,
+            final_nt, history)
 
 
 class _BoundPS:
