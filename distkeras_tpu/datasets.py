@@ -25,14 +25,17 @@ import numpy as np
 
 from distkeras_tpu.data import Dataset
 
-_SEARCH_DIRS = [
-    os.environ.get("DISTKERAS_DATA", ""),
-    str(Path.home() / ".keras" / "datasets"),
-]
+def _search_dirs() -> list[str]:
+    # read the env at call time, not import time: on a real pod the data dir
+    # may be mounted/exported after this module is first imported
+    return [
+        os.environ.get("DISTKERAS_DATA", ""),
+        str(Path.home() / ".keras" / "datasets"),
+    ]
 
 
 def _find(name: str) -> Path | None:
-    for d in _SEARCH_DIRS:
+    for d in _search_dirs():
         if d and (p := Path(d) / name).exists():
             return p
     return None
@@ -114,8 +117,10 @@ def higgs(n_train: int = 100000, n_test: int = 20000, seed: int = 20):
     rng = np.random.default_rng(seed)
     if p is not None:
         with np.load(p) as z:
-            xtr, ytr = z["x_train"][:n_train], z["y_train"][:n_train]
-            xte, yte = z["x_test"][:n_test], z["y_test"][:n_test]
+            xtr = z["x_train"][:n_train].astype(np.float32)
+            ytr = z["y_train"][:n_train].astype(np.int32).reshape(-1)
+            xte = z["x_test"][:n_test].astype(np.float32)
+            yte = z["y_test"][:n_test].astype(np.int32).reshape(-1)
     else:
         # One mixing matrix and mean-shift direction for both splits — train
         # and test must share the decision boundary; only the samples differ.
