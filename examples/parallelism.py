@@ -28,6 +28,14 @@ import numpy as np
 import optax
 
 
+#: --quick halves rows and epochs (used by CI; results stay meaningful)
+QUICK = False
+
+
+def scale(n):
+    return max(1, n // 2) if QUICK else n
+
+
 def make_task(rng, n, vocab=64, maxlen=16, classes=4):
     """Tokens whose high bits encode the class — learnable in seconds."""
     y = rng.integers(0, classes, size=(n,)).astype(np.int32)
@@ -45,12 +53,12 @@ def demo_dp(n_devices):
     from distkeras_tpu.datasets import mnist
     from distkeras_tpu.models import mlp
 
-    train, test = mnist(n_train=256 * n_devices, n_test=512)
+    train, test = mnist(n_train=scale(256) * n_devices, n_test=512)
     trainer = ADAG(
         mlp(dtype=jnp.float32), loss="sparse_softmax_cross_entropy",
         worker_optimizer="adam", learning_rate=1e-3,
         num_workers=n_devices, batch_size=32, communication_window=4,
-        num_epoch=3,
+        num_epoch=scale(3),
     )
     params = trainer.train(train, shuffle=True)
     spec = trainer.spec
@@ -67,13 +75,13 @@ def demo_tp(n_devices, rng):
 
     tp = 2 if n_devices % 2 == 0 else 1
     dp = n_devices // tp
-    toks, mask, y = make_task(rng, 256)
+    toks, mask, y = make_task(rng, scale(256))
     ds = Dataset({"features": toks, "mask": mask, "label": y})
     trainer = MeshTrainer(
         transformer_classifier(vocab=64, maxlen=16, dim=64, heads=4, depth=2,
                                num_classes=4, dtype=jnp.float32),
         worker_optimizer="adam", learning_rate=2e-3,
-        mesh_shape={"dp": dp, "tp": tp}, batch_size=32, num_epoch=6,
+        mesh_shape={"dp": dp, "tp": tp}, batch_size=32, num_epoch=scale(6),
         features_col=["features", "mask"], label_col="label",
     )
     trainer.train(ds, shuffle=True)
@@ -88,14 +96,14 @@ def demo_fsdp(n_devices, rng):
     from distkeras_tpu.data import Dataset
     from distkeras_tpu.models import transformer_classifier
 
-    toks, mask, y = make_task(rng, 256)
+    toks, mask, y = make_task(rng, scale(256))
     ds = Dataset({"features": toks, "mask": mask, "label": y})
     trainer = MeshTrainer(
         transformer_classifier(vocab=64, maxlen=16, dim=64, heads=4, depth=2,
                                num_classes=4, dtype=jnp.float32),
         worker_optimizer="adam", learning_rate=2e-3,
         mesh_shape={"dp": n_devices}, parameter_sharding="fsdp",
-        grad_accum=2, batch_size=32, num_epoch=6,
+        grad_accum=2, batch_size=32, num_epoch=scale(6),
         features_col=["features", "mask"], label_col="label",
     )
     trainer.train(ds, shuffle=True)
@@ -113,14 +121,14 @@ def demo_pp(n_devices, rng):
 
     pp = 4 if n_devices % 4 == 0 else n_devices
     dp = n_devices // pp
-    toks, mask, y = make_task(rng, 256)
+    toks, mask, y = make_task(rng, scale(256))
     ds = Dataset({"features": toks, "mask": mask, "label": y})
     trainer = MeshTrainer(
         transformer_classifier(vocab=64, maxlen=16, dim=64, heads=4,
                                depth=pp, num_classes=4, dtype=jnp.float32),
         worker_optimizer="adam", learning_rate=2e-3,
         mesh_shape={"dp": dp, "pp": pp} if dp > 1 else {"pp": pp},
-        strategy="pipeline", batch_size=32, num_epoch=6,
+        strategy="pipeline", batch_size=32, num_epoch=scale(6),
         features_col=["features", "mask"], label_col="label",
     )
     trainer.train(ds, shuffle=True)
@@ -139,14 +147,14 @@ def demo_sp(n_devices, rng):
     sp = 4 if n_devices % 4 == 0 else n_devices
     dp = n_devices // sp
     L = 16 * sp
-    toks, mask, y = make_task(rng, 256, maxlen=L)
+    toks, mask, y = make_task(rng, scale(256), maxlen=L)
     ds = Dataset({"features": toks, "mask": mask, "label": y})
     trainer = MeshTrainer(
         transformer_classifier(vocab=64, maxlen=L, dim=64, heads=4, depth=2,
                                num_classes=4, dtype=jnp.float32),
         worker_optimizer="adam", learning_rate=2e-3,
         mesh_shape={"dp": dp, "sp": sp} if dp > 1 else {"sp": sp},
-        strategy="sequence", batch_size=32, num_epoch=6,
+        strategy="sequence", batch_size=32, num_epoch=scale(6),
         features_col=["features", "mask"], label_col="label",
     )
     trainer.train(ds, shuffle=True)
@@ -163,7 +171,7 @@ def demo_ep(n_devices, rng):
     from distkeras_tpu.models import moe_transformer_classifier
 
     E = 2 * n_devices
-    toks, mask, y = make_task(rng, 256)
+    toks, mask, y = make_task(rng, scale(256))
     ds = Dataset({"features": toks, "mask": mask, "label": y})
     trainer = MeshTrainer(
         moe_transformer_classifier(vocab=64, maxlen=16, dim=64, heads=4,
@@ -171,7 +179,7 @@ def demo_ep(n_devices, rng):
                                    num_classes=4, dtype=jnp.float32),
         worker_optimizer="adam", learning_rate=2e-3,
         mesh_shape={"ep": n_devices}, strategy="expert",
-        batch_size=32, num_epoch=6,
+        batch_size=32, num_epoch=scale(6),
         features_col=["features", "mask"], label_col="label",
     )
     trainer.train(ds, shuffle=True)
@@ -185,7 +193,11 @@ def main():
     ap.add_argument("--only",
                     choices=["dp", "tp", "fsdp", "pp", "sp", "ep"],
                     default=None)
+    ap.add_argument("--quick", action="store_true",
+                    help="half rows/epochs (CI)")
     args = ap.parse_args()
+    global QUICK
+    QUICK = args.quick
 
     n = len(jax.devices())
     print(f"devices: {n} × {jax.devices()[0].platform}")

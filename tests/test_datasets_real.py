@@ -105,8 +105,10 @@ def test_imdb_real_file(data_dir):
     assert test["features"].shape == (4, 32)
 
 
-def test_synthetic_without_file(data_dir):
-    """Empty DISTKERAS_DATA dir (and no ~/.keras file): stand-in kicks in."""
-    assert datasets.is_synthetic("mnist") or True  # ~/.keras may exist in CI
+def test_synthetic_without_file(data_dir, monkeypatch):
+    """Empty DISTKERAS_DATA dir and an empty home: the stand-in kicks in."""
+    monkeypatch.setattr("pathlib.Path.home",
+                        staticmethod(lambda: data_dir / "emptyhome"))
+    assert datasets.is_synthetic("mnist")
     train, _ = datasets.mnist(n_train=8, n_test=4)
     assert train["features"].shape == (8, 28, 28, 1)

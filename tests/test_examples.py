@@ -2,6 +2,8 @@
 strategy was examples-as-integration-tests — SURVEY.md §4)."""
 
 import os
+
+import pytest
 import pathlib
 import subprocess
 import sys
@@ -22,13 +24,15 @@ def run_example(script: str, *args):
     )
 
 
+@pytest.mark.slow
 def test_parallelism_example_runs_all_strategies():
-    proc = run_example("parallelism.py")
+    proc = run_example("parallelism.py", "--quick")
     assert proc.returncode == 0, proc.stderr[-2000:]
     for tag in ("[dp]", "[tp]", "[fsdp]", "[pp]", "[sp]", "[ep]"):
         assert tag in proc.stdout, (tag, proc.stdout)
 
 
+@pytest.mark.slow
 def test_longcontext_example_runs_quick():
     proc = run_example("longcontext.py", "--quick")
     assert proc.returncode == 0, proc.stderr[-2000:]

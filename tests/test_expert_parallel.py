@@ -111,6 +111,7 @@ def test_validation_errors(rng):
         moe_mlp(params, np.zeros((T + 1, D), np.float32), mesh)
 
 
+@pytest.mark.slow
 def test_moe_transformer_mesh_matches_reference(rng):
     """Full MoE model: expert-parallel forward == single-device forward."""
     from distkeras_tpu.models.moe import MoETransformerClassifier

@@ -12,6 +12,7 @@ import nbformat
 import pytest
 
 
+@pytest.mark.slow
 def test_workflow_notebook_executes_end_to_end(monkeypatch):
     monkeypatch.setenv("DISTKERAS_WORKFLOW_ROWS", "8192")
     path = pathlib.Path(__file__).parent.parent / "examples" / "workflow.ipynb"
