@@ -155,7 +155,11 @@ class SPMDEngine:
 
     def _resolve_specs(self, params):
         if self.param_specs is None:
-            self.param_specs = megatron_specs(params, self.tp_axis)
+            if self.tp_axis in self.mesh.shape:
+                self.param_specs = megatron_specs(params, self.tp_axis)
+            else:
+                # dp-only mesh: the documented layout is plain replication
+                self.param_specs = jax.tree.map(lambda _: P(), params)
 
     def init_state(self, params, nt):
         """Shard params per the specs; opt state pinned to the same layout."""

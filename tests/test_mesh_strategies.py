@@ -201,6 +201,20 @@ def test_mesh_trainer_checkpoint_resume_fsdp(rng, tmp_path):
     assert len(losses_of(t_res)) == len(losses_of(t_full)) // 2
 
 
+def test_mesh_trainer_transformer_dp_only_mesh(rng):
+    """Regression: a named-layer model on a dp-only mesh must fall back to
+    replicated params (the Megatron rules name a 'tp' axis the mesh lacks)."""
+    spec = small_transformer(depth=2)
+    ds = token_task(rng, 32)
+    trainer = MeshTrainer(
+        spec, worker_optimizer="adam", learning_rate=3e-3,
+        mesh_shape={"dp": 8}, batch_size=16, num_epoch=1,
+        features_col=["features", "mask"], label_col="label",
+    )
+    trainer.train(ds)
+    assert np.isfinite(losses_of(trainer)).all()
+
+
 def test_mesh_trainer_profile_dir(rng, tmp_path):
     from distkeras_tpu.models import mlp
 
