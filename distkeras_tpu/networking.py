@@ -22,6 +22,7 @@ beyond the job's network only deliberately.
 from __future__ import annotations
 
 import io
+import os
 import pickle
 import socket
 import struct
@@ -67,9 +68,20 @@ def determine_host_address() -> str:
     """Best-effort routable address of this host.
 
     Parity: reference ``distkeras/networking.py :: determine_host_address``.
-    Uses the UDP-connect trick (no packets sent); falls back to loopback on
-    isolated hosts.
+    Prefers the TPU-pod worker address from the metadata env
+    (``TPU_WORKER_HOSTNAMES``/``TPU_WORKER_ID``) when present — on an
+    airgapped pod the UDP-connect trick below can pick an interface that is
+    routable-looking but wrong for DCN. Otherwise uses the UDP-connect trick
+    (no packets are sent — 8.8.8.8 only selects the default route's
+    interface); falls back to loopback on fully isolated hosts.
     """
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    worker_id = os.environ.get("TPU_WORKER_ID", "")
+    if hostnames and worker_id.isdigit():
+        # index the RAW split: filtering blanks first would misalign ids
+        hosts = hostnames.split(",")
+        if int(worker_id) < len(hosts) and hosts[int(worker_id)].strip():
+            return hosts[int(worker_id)].strip()
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     try:
         s.connect(("8.8.8.8", 80))

@@ -36,6 +36,17 @@ def test_determine_host_address_returns_ip():
     assert isinstance(addr, str) and addr.count(".") == 3
 
 
+def test_determine_host_address_prefers_tpu_metadata(monkeypatch):
+    """On a pod the worker address comes from the TPU metadata env, not the
+    UDP-connect interface guess (which can be wrong for DCN when airgapped)."""
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0.pod,w1.pod,w2.pod")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    assert networking.determine_host_address() == "w1.pod"
+    monkeypatch.setenv("TPU_WORKER_ID", "9")  # out of range: fall through
+    addr = networking.determine_host_address()
+    assert addr.count(".") == 3
+
+
 def test_recv_data_rejects_oversized_frame():
     import socket
     import struct
