@@ -824,7 +824,9 @@ class MeshTrainer(Trainer):
                     )
                     self.history.append(losses=losses, epoch=epoch)
                     if self.log_metrics:
-                        jax.block_until_ready(losses)
+                        # block on params too: loss scalars can stream back
+                        # before the epoch's update compute drains
+                        jax.block_until_ready((params, losses))
                         self._epoch_metrics(
                             epoch, rows, rows // self.batch_size,
                             time.perf_counter() - t0,
