@@ -32,9 +32,15 @@ class LSTMClassifier(nn.Module):
     hidden_dim: int = 128
     num_classes: int = 2
     dtype: jnp.dtype = jnp.bfloat16
+    #: recurrence implementation: "pallas" (the fused VMEM-carry kernel in
+    #: ops.recurrent — forget bias +1.0, same gate math), "xla" (lax.scan),
+    #: "auto" (kernel natively on TPU with tile-friendly shapes)
+    scan_impl: str = "auto"
 
     @nn.compact
     def __call__(self, tokens, mask=None, training: bool = False):
+        from distkeras_tpu.ops.recurrent import lstm_scan
+
         if mask is None:
             mask = jnp.ones(tokens.shape, jnp.float32)
         H = self.hidden_dim
@@ -43,23 +49,9 @@ class LSTMClassifier(nn.Module):
         gates_x = nn.Dense(4 * H, dtype=self.dtype, name="wx")(x)  # [B,T,4H]
         wh = self.param("wh", nn.initializers.orthogonal(), (H, 4 * H),
                         jnp.float32)
-
-        def step(carry, gx_t):
-            c, h = carry
-            z = (gx_t + h @ wh.astype(self.dtype)).astype(jnp.float32)
-            i, f, g, o = jnp.split(z, 4, axis=-1)
-            # forget bias +1.0 (Jozefowicz et al. 2015)
-            c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
-            h = (jax.nn.sigmoid(o) * jnp.tanh(c)).astype(self.dtype)
-            return (c, h), h
-
-        B = tokens.shape[0]
-        c0 = jnp.zeros((B, H), jnp.float32)
-        h0 = jnp.zeros((B, H), self.dtype)
-        # ys stacked in `dtype`: the [T, B, H] buffer (and its saved-for-
-        # backward copy) stays bf16; the mask-mean below accumulates in f32
-        _, outs = jax.lax.scan(step, (c0, h0), jnp.moveaxis(gates_x, 1, 0))
-        outs = jnp.moveaxis(outs, 0, 1)  # [B, T, H] `dtype`
+        # ys in `dtype`: the [B, T, H] buffer (and its saved-for-backward
+        # copy) stays bf16; the mask-mean below accumulates in f32
+        outs = lstm_scan(gates_x, wh, impl=self.scan_impl)  # [B, T, H]
         m = mask.astype(jnp.float32)[..., None]
         pooled = jnp.sum(outs.astype(jnp.float32) * m, axis=1) / jnp.maximum(
             jnp.sum(m, axis=1), 1.0
@@ -71,10 +63,11 @@ class LSTMClassifier(nn.Module):
 
 
 def lstm_classifier(vocab=20000, maxlen=200, embed_dim=128, hidden_dim=128,
-                    num_classes=2, dtype=jnp.bfloat16) -> ModelSpec:
+                    num_classes=2, dtype=jnp.bfloat16,
+                    scan_impl="auto") -> ModelSpec:
     module = LSTMClassifier(
         vocab=vocab, embed_dim=embed_dim, hidden_dim=hidden_dim,
-        num_classes=num_classes, dtype=dtype,
+        num_classes=num_classes, dtype=dtype, scan_impl=scan_impl,
     )
     example = (
         jnp.zeros((1, maxlen), jnp.int32),
