@@ -72,9 +72,14 @@ def _gates(z):
             jax.nn.sigmoid(o))
 
 
-def _lstm_fwd_kernel(gx_ref, wh_ref, hs_ref, cs_ref, h_s, c_s, *, K):
+def _lstm_fwd_kernel(gx_ref, wh_ref, hs_ref, *rest, K):
     """One grid step = K timesteps: z = gx_t + h @ wh; gate math; stream
-    out h_t / c_t; carries stay in VMEM scratch."""
+    out h_t (and c_t when training needs the residual); carries stay in
+    VMEM scratch."""
+    if len(rest) == 3:
+        cs_ref, h_s, c_s = rest
+    else:
+        cs_ref, (h_s, c_s) = None, rest
     t0 = pl.program_id(0)
 
     @pl.when(t0 == 0)
@@ -97,7 +102,8 @@ def _lstm_fwd_kernel(gx_ref, wh_ref, hs_ref, cs_ref, h_s, c_s, *, K):
         c_s[:] = c
         h_s[:] = h
         hs_ref[k] = h.astype(hs_ref.dtype)
-        cs_ref[k] = c.astype(cs_ref.dtype)
+        if cs_ref is not None:
+            cs_ref[k] = c.astype(cs_ref.dtype)
 
 
 def _lstm_bwd_kernel(gx_ref, wh_ref, hs_ref, hsp_ref, cs_ref, csp_ref,
@@ -166,33 +172,36 @@ def _lstm_bwd_kernel(gx_ref, wh_ref, hs_ref, hsp_ref, cs_ref, csp_ref,
         dwh_ref[:] = dwh_s[:].astype(dwh_ref.dtype)
 
 
-def _fwd(gx_t, wh, interpret):
-    """gx_t [T, B, 4H] (time-major), wh [H, 4H] → (hs [T, B, H], cs)."""
+def _fwd(gx_t, wh, interpret, save_c: bool = True):
+    """gx_t [T, B, 4H] (time-major), wh [H, 4H] → (hs [T, B, H], cs|None).
+
+    ``save_c=False`` (the eval/primal path) skips streaming the c sequence
+    to HBM entirely — it is only the backward's residual. When saved, cs is
+    stored in the model dtype (halves its HBM traffic for bf16 training);
+    the f32 carry inside the kernel keeps the recurrence full-precision.
+    """
     T, B, H4 = gx_t.shape
     H = H4 // 4
-    # streamed blocks per timestep: gx [B,4H] in, hs+cs [B,H] out
-    K = _pick_chunk(T, (H4 + 2 * H) * B * gx_t.dtype.itemsize)
-    hs, cs = pl.pallas_call(
+    # streamed blocks per timestep: gx [B,4H] in, hs(+cs) [B,H] out
+    K = _pick_chunk(T, (H4 + (2 if save_c else 1) * H) * B
+                    * gx_t.dtype.itemsize)
+    seq_spec = pl.BlockSpec((K, B, H), lambda t: (t, 0, 0))
+    seq_shape = jax.ShapeDtypeStruct((T, B, H), gx_t.dtype)
+    out = pl.pallas_call(
         functools.partial(_lstm_fwd_kernel, K=K), grid=(T // K,),
         in_specs=[
             pl.BlockSpec((K, B, H4), lambda t: (t, 0, 0)),
             pl.BlockSpec((H, H4), lambda t: (0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((K, B, H), lambda t: (t, 0, 0)),
-            pl.BlockSpec((K, B, H), lambda t: (t, 0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T, B, H), gx_t.dtype),
-            jax.ShapeDtypeStruct((T, B, H), gx_t.dtype),
-        ],
+        out_specs=[seq_spec, seq_spec] if save_c else [seq_spec],
+        out_shape=[seq_shape, seq_shape] if save_c else [seq_shape],
         scratch_shapes=[
             pltpu.VMEM((B, H), gx_t.dtype),   # h carry
             pltpu.VMEM((B, H), jnp.float32),  # c carry
         ],
         interpret=interpret,
     )(gx_t, wh)
-    return hs, cs
+    return (out[0], out[1]) if save_c else (out[0], None)
 
 
 def _bwd(gx_t, wh, hs, cs, dhs, interpret):
@@ -238,7 +247,7 @@ def _bwd(gx_t, wh, hs, cs, dhs, interpret):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _lstm_core(gx_t, wh, interpret):
-    hs, _ = _fwd(gx_t, wh, interpret)
+    hs, _ = _fwd(gx_t, wh, interpret, save_c=False)
     return hs
 
 
