@@ -853,6 +853,12 @@ class MeshTrainer(Trainer):
         jax.block_until_ready(jax.tree.leaves(params)[0])
         self.record_training_end()
         self._materialize_history()
+        if jax.process_count() > 1:
+            # gather sharded leaves to host: under jax.distributed some
+            # shards live on devices this controller cannot address
+            from jax.experimental import multihost_utils
+
+            params = multihost_utils.process_allgather(params, tiled=True)
         return self._finalize(
             from_engine(jax.tree.map(np.asarray, jax.device_get(params))),
             jax.tree.map(np.asarray, jax.device_get(nt)),

@@ -1,14 +1,15 @@
 """Multi-host training end-to-end: a LocalRunner-launched 2-process
 `jax.distributed` cluster actually TRAINS (not just allgathers), and the
-result equals the single-process run.
+result equals the single-process run — for the reference trainer API (ADAG)
+AND the beyond-reference GSPMD trainer (MeshTrainer/FSDP); plus the socket
+PS serving workers across a real process boundary.
 
 Parity: the reference really trained across machines (reference
 ``distkeras/workers.py :: Worker.train`` ran on remote Spark executors;
 ``distkeras/job_deployment.py :: Job`` submitted to a live cluster —
-SURVEY.md §3.1 boundaries #1/#2). Here the same ADAG window program runs
+SURVEY.md §3.1 boundaries #1/#2). Here the same programs run
 multi-controller SPMD: every process feeds `put_global` the same
-deterministic superbatches and XLA runs one global program over the
-2-host mesh.
+deterministic batches and XLA runs one global program over the 2-host mesh.
 """
 
 import json
@@ -22,8 +23,8 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# one shared recipe so oracle and cluster cannot drift apart
-TRAIN_SNIPPET = """
+# shared recipes so oracle and cluster cannot drift apart
+ADAG_SNIPPET = """
 from distkeras_tpu import ADAG
 from distkeras_tpu.datasets import higgs
 from distkeras_tpu.models import mlp
@@ -42,9 +43,35 @@ def run_training():
     return params, losses
 """
 
+MESH_SNIPPET = """
+from distkeras_tpu.datasets import higgs
+from distkeras_tpu.models import mlp
+from distkeras_tpu.trainers import MeshTrainer
+import jax.numpy as jnp
 
-@pytest.mark.slow
-def test_two_process_adag_matches_single_process(tmp_path):
+def run_training():
+    train, _ = higgs(n_train=512, n_test=64)
+    t = MeshTrainer(
+        mlp(input_shape=(28,), hidden=(64, 32), num_classes=2,
+            dtype=jnp.float32),
+        loss="sparse_softmax_cross_entropy", worker_optimizer="adam",
+        learning_rate=1e-3, mesh_shape={"dp": 8},
+        parameter_sharding="fsdp", batch_size=32, num_epoch=2, seed=11,
+        input_mode="stream",
+    )
+    params = t.train(train)
+    losses = [float(l) for l in t.get_history().losses()]
+    return params, losses
+"""
+
+
+def run_two_process_cluster_vs_oracle(tmp_path, train_snippet,
+                                      timeout=420):
+    """Launch `train_snippet.run_training()` on a LocalRunner 2-process
+    `jax.distributed` cluster (4+4 virtual CPU devices), run the same
+    recipe single-process as the oracle, and assert params AND losses
+    match. The snippet must define ``run_training() -> (params, losses)``.
+    """
     from distkeras_tpu.job_deployment import Job, LocalRunner, Punchcard
 
     with socket.socket() as s:  # free coordinator port
@@ -63,7 +90,7 @@ def test_two_process_adag_matches_single_process(tmp_path):
         info = initialize_cluster(**cluster_args_from_env())
         assert info["process_count"] == 2, info
         assert len(jax.devices()) == 8, jax.devices()
-    """) + TRAIN_SNIPPET + textwrap.dedent(f"""
+    """) + train_snippet + textwrap.dedent(f"""
         import numpy as np
         params, losses = run_training()
         if jax.process_index() == 0:
@@ -78,12 +105,12 @@ def test_two_process_adag_matches_single_process(tmp_path):
                    coordinator_port=port)
     runner = LocalRunner()
     Job(pc, runner=runner).run()
-    codes = runner.wait(timeout=420)
+    codes = runner.wait(timeout=timeout)
     assert codes == [0, 0], [p.captured_stderr[-2000:] for p in runner.procs]
 
     # the single-process oracle: same recipe on this process's 8-device mesh
     ns = {}
-    exec(TRAIN_SNIPPET, ns)
+    exec(train_snippet, ns)
     oracle_params, oracle_losses = ns["run_training"]()
     oracle_leaves = jax.tree.leaves(oracle_params)
 
@@ -99,6 +126,20 @@ def test_two_process_adag_matches_single_process(tmp_path):
     np.testing.assert_allclose(cluster_losses, oracle_losses,
                                rtol=1e-4, atol=1e-5)
     assert cluster_losses[-1] < cluster_losses[0]  # it actually learned
+
+
+@pytest.mark.slow
+def test_two_process_adag_matches_single_process(tmp_path):
+    run_two_process_cluster_vs_oracle(tmp_path, ADAG_SNIPPET)
+
+
+@pytest.mark.slow
+def test_two_process_mesh_trainer_fsdp_matches_single_process(tmp_path):
+    """The GSPMD path trains across processes too: a 2-process MeshTrainer
+    (ZeRO-3 params + moments sharded over an 8-device dp axis spanning both
+    controllers, final params gathered via process_allgather) matches the
+    single-process run."""
+    run_two_process_cluster_vs_oracle(tmp_path, MESH_SNIPPET)
 
 
 @pytest.mark.slow
