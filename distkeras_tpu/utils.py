@@ -14,6 +14,7 @@ architecture-JSON + weights contract for API parity.
 from __future__ import annotations
 
 import io
+import os
 import json
 import pickle
 import time
@@ -157,6 +158,28 @@ def json_default(o):
 # Training history — parity with ``Trainer.get_history`` and the history
 # helpers in reference ``distkeras/utils.py`` (SURVEY.md §5.5).
 # ---------------------------------------------------------------------------
+
+
+def enable_compilation_cache(directory: str | None = None,
+                             min_compile_secs: float = 1.0) -> str:
+    """Turn on JAX's persistent compilation cache for this process.
+
+    First-compile latency is the dominant interactive cost on TPU (tens of
+    seconds per trainer program — SCALING.md); with the cache, identical
+    programs (same model/config/shape) skip XLA compilation on every later
+    run. Call once before training; returns the cache directory. The CI
+    conftest enables the same cache for the test suite.
+    """
+    import tempfile
+
+    directory = directory or os.environ.get(
+        "DISTKERAS_COMPILATION_CACHE",
+        os.path.join(tempfile.gettempdir(), "distkeras-jax-cache"),
+    )
+    jax.config.update("jax_compilation_cache_dir", str(directory))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))
+    return str(directory)
 
 
 # ---------------------------------------------------------------------------

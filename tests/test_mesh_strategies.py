@@ -215,6 +215,23 @@ def test_mesh_trainer_transformer_dp_only_mesh(rng):
     assert np.isfinite(losses_of(trainer)).all()
 
 
+def test_sequence_strategy_with_grad_accum(rng):
+    """Strategy engines compose with the microbatch lever: grad_accum=2
+    through the sequence strategy keeps training (the scan splits each
+    global batch inside the jitted step)."""
+    spec = small_transformer(depth=1)
+    ds = token_task(rng, 32)
+    trainer = MeshTrainer(
+        spec, worker_optimizer="adam", learning_rate=3e-3,
+        mesh_shape={"dp": 2, "sp": 4}, strategy="sequence", grad_accum=2,
+        batch_size=16, num_epoch=2,
+        features_col=["features", "mask"], label_col="label",
+    )
+    trainer.train(ds)
+    losses = losses_of(trainer)
+    assert len(losses) == 4 and np.isfinite(losses).all()
+
+
 def test_pipeline_strategy_checkpoint_resume(rng, tmp_path):
     """Resume with strategy='pipeline': the engine-layout checkpoint (stages
     stacked [S, …]) restores through place_state back onto the pp axis and
