@@ -167,13 +167,14 @@ def enable_compilation_cache(directory: str | None = None,
     First-compile latency is the dominant interactive cost on TPU (tens of
     seconds per trainer program — SCALING.md); with the cache, identical
     programs (same model/config/shape) skip XLA compilation on every later
-    run. Call once before training; returns the cache directory. The CI
-    conftest enables the same cache for the test suite.
+    run. Call once before training; returns the cache directory.
+    Precedence: explicit argument > ``JAX_COMPILATION_CACHE_DIR`` (JAX's
+    own env var) > a tmp-dir default. The CI conftest uses this helper too.
     """
     import tempfile
 
     directory = directory or os.environ.get(
-        "DISTKERAS_COMPILATION_CACHE",
+        "JAX_COMPILATION_CACHE_DIR",
         os.path.join(tempfile.gettempdir(), "distkeras-jax-cache"),
     )
     jax.config.update("jax_compilation_cache_dir", str(directory))

@@ -22,23 +22,21 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compile cache: identical programs (shared model configs across
-# tests, reruns of either tier) skip XLA compilation — the dominant cost on
-# this 1-core CI host.
-import tempfile
-
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(tempfile.gettempdir(), "distkeras-jax-test-cache"),
-    ),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Persistent compile cache: identical programs (shared model configs across
+# tests, reruns of either tier) skip XLA compilation — the dominant cost on
+# this 1-core CI host. One code path with the user-facing helper.
+import tempfile
+
+from distkeras_tpu.utils import enable_compilation_cache
+
+enable_compilation_cache(os.environ.get(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "distkeras-jax-test-cache"),
+))
 
 import numpy as np
 import pytest

@@ -55,3 +55,26 @@ def test_uniform_weights():
 def test_count_params():
     t = _tree()
     assert utils.tree_count_params(t) == 10
+
+
+def test_enable_compilation_cache(tmp_path, monkeypatch):
+    """The helper points JAX's persistent cache where asked (explicit arg >
+    JAX_COMPILATION_CACHE_DIR env > tmp default) and the config keys exist
+    in this JAX version."""
+    import jax
+
+    from distkeras_tpu.utils import enable_compilation_cache
+
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        got = enable_compilation_cache(str(tmp_path / "explicit"))
+        assert got == str(tmp_path / "explicit")
+        assert jax.config.jax_compilation_cache_dir == got
+
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR",
+                           str(tmp_path / "from_env"))
+        assert enable_compilation_cache() == str(tmp_path / "from_env")
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 1.0
+    finally:
+        # restore the conftest-configured cache for the rest of the suite
+        enable_compilation_cache(before)
