@@ -138,6 +138,13 @@ def moe_mlp(params, x, mesh: Mesh, axis: str = "ep", top_k: int = 1,
     - capacity per expert = ``capacity_factor · T_local · top_k / E`` per
       shard, the GShard convention.
 
+    Composition: on a multi-axis mesh (e.g. ``{"dp": 2, "ep": 4}``) only
+    ``axis`` is mapped manually — the other axes stay *auto*, so an outer
+    GSPMD program (a dp-sharded train step) partitions the per-shard work
+    over them; expert weights replicate over dp by propagation. The math is
+    identical to the ``ep``-only program (pinned by
+    tests/test_expert_parallel.py).
+
     Returns ``(y [T, d], aux_loss)`` — ``y`` matches
     :func:`moe_mlp_reference` exactly when no token overflows capacity.
     """
@@ -160,11 +167,15 @@ def moe_mlp(params, x, mesh: Mesh, axis: str = "ep", top_k: int = 1,
     body = functools.partial(
         _moe_shard, axis_name=axis, top_k=top_k, capacity=capacity,
     )
+    kwargs = {}
+    if len(mesh.axis_names) > 1:
+        kwargs["axis_names"] = frozenset({axis})
     fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=(pspec, P(axis)),
         out_specs=(P(axis), P()),
         check_vma=False,
+        **kwargs,
     )
     params = {
         k: put_global(v, NamedSharding(mesh, pspec[k]))

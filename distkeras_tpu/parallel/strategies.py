@@ -212,7 +212,13 @@ def expert_specs(params, ep_axis: str = "ep"):
 def expert_strategy(spec, loss_fn, mesh, *, ep_axis: str = "ep",
                     aux_weight: float = 1e-2):
     """Build the EP pieces: experts sharded over ``ep``, tokens exchanged
-    with ``all_to_all``, gating auxiliary loss folded into the objective."""
+    with ``all_to_all``, gating auxiliary loss folded into the objective.
+
+    Composes with data parallelism on a 2-D mesh (``{"dp": d, "ep": e}``):
+    the batch shards over ``dp`` (the engine's dp_axis) while the MoE
+    layer's ``shard_map`` maps only ``ep`` manually — dp stays auto and
+    GSPMD partitions the routing work over it (expert weights replicate
+    over dp by propagation)."""
     from distkeras_tpu.models.moe import (
         MoETransformerClassifier,
         moe_aux_loss,

@@ -142,6 +142,30 @@ def test_expert_strategy_trainer_learns(rng):
     assert out.shape == (8, CLASSES)
 
 
+def test_expert_strategy_composes_with_dp(rng):
+    """dp×ep through the trainer: batch over dp, experts over ep, one 2-D
+    mesh, driven by trainer.train only."""
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models import moe_transformer_classifier
+
+    spec = moe_transformer_classifier(
+        vocab=VOCAB, maxlen=MAXLEN, dim=32, heads=4, depth=1,
+        num_experts=8, top_k=2, num_classes=CLASSES, dtype=jnp.float32,
+    )
+    ds = token_task(rng, 64)
+    trainer = MeshTrainer(
+        spec, worker_optimizer="adam", learning_rate=3e-3,
+        mesh_shape={"dp": 2, "ep": 4}, strategy="expert",
+        batch_size=16, num_epoch=6,
+        features_col=["features", "mask"], label_col="label",
+    )
+    trainer.train(ds, shuffle=True)
+    losses = losses_of(trainer)
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < 0.8 * np.mean(losses[:4])
+
+
 def test_strategy_validation(rng):
     from distkeras_tpu.models import mlp
 
