@@ -167,19 +167,22 @@ def moe_mlp(params, x, mesh: Mesh, axis: str = "ep", top_k: int = 1,
     body = functools.partial(
         _moe_shard, axis_name=axis, top_k=top_k, capacity=capacity,
     )
-    kwargs = {}
-    if len(mesh.axis_names) > 1:
-        kwargs["axis_names"] = frozenset({axis})
     fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=(pspec, P(axis)),
         out_specs=(P(axis), P()),
         check_vma=False,
-        **kwargs,
+        # only `axis` is manual; other mesh axes (dp) stay auto for GSPMD
+        axis_names=frozenset({axis}),
     )
     params = {
         k: put_global(v, NamedSharding(mesh, pspec[k]))
         for k, v in params.items()
     }
-    x = put_global(x, NamedSharding(mesh, P(axis)))
+    if not isinstance(x, jax.core.Tracer):
+        # host-call placement only: inside a jitted (dp-sharded) program a
+        # sharding constraint to P(axis) would pin the tokens dp-REPLICATED
+        # and force an all-gather per MoE block — leave the auto axes to
+        # GSPMD there (shard_map reshards the manual axis as needed)
+        x = put_global(x, NamedSharding(mesh, P(axis)))
     return fn(params, x)
