@@ -170,7 +170,9 @@ def demo_ep(n_devices, rng):
     from distkeras_tpu.data import Dataset
     from distkeras_tpu.models import moe_transformer_classifier
 
-    E = 2 * n_devices
+    dp = 2 if n_devices % 2 == 0 else 1
+    ep = n_devices // dp
+    E = 2 * ep
     toks, mask, y = make_task(rng, scale(256))
     ds = Dataset({"features": toks, "mask": mask, "label": y})
     trainer = MeshTrainer(
@@ -178,13 +180,13 @@ def demo_ep(n_devices, rng):
                                    depth=2, num_experts=E, top_k=2,
                                    num_classes=4, dtype=jnp.float32),
         worker_optimizer="adam", learning_rate=2e-3,
-        mesh_shape={"ep": n_devices}, strategy="expert",
-        batch_size=32, num_epoch=scale(6),
+        mesh_shape={"dp": dp, "ep": ep} if dp > 1 else {"ep": ep},
+        strategy="expert", batch_size=32, num_epoch=scale(6),
         features_col=["features", "mask"], label_col="label",
     )
     trainer.train(ds, shuffle=True)
     losses = [r["loss"] for r in trainer.history.records if "loss" in r]
-    print(f"[ep] MeshTrainer MoE, {E} experts over {n_devices} devices: "
+    print(f"[ep] MeshTrainer MoE dp={dp}×ep={ep}, {E} experts: "
           f"loss {losses[0]:.3f} → {losses[-1]:.3f}")
 
 
