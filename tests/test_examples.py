@@ -33,6 +33,18 @@ def test_parallelism_example_runs_all_strategies():
 
 
 @pytest.mark.slow
+def test_mnist_example_runs_end_to_end():
+    """The reference's canonical example: transformers → trainer →
+    predictor → evaluator, via the CLI."""
+    proc = run_example("mnist.py", "--model", "mlp", "--rows", "2048",
+                       "--epochs", "2", "--batch-size", "32")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "test accuracy:" in proc.stdout, proc.stdout
+    acc = float(proc.stdout.rsplit("test accuracy:", 1)[1].strip())
+    assert acc > 0.8, proc.stdout  # synthetic mnist is easy — it must learn
+
+
+@pytest.mark.slow
 def test_longcontext_example_runs_quick():
     proc = run_example("longcontext.py", "--quick")
     assert proc.returncode == 0, proc.stderr[-2000:]
