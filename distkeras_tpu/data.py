@@ -224,3 +224,27 @@ class Dataset:
             f"{k}:{v.dtype}{list(v.shape[1:])}" for k, v in self._columns.items()
         )
         return f"Dataset({len(self)} rows; {cols})"
+
+
+def padded_chunks(
+    cols: Sequence[np.ndarray], batch_size: int
+) -> Iterator[tuple[list[np.ndarray], int]]:
+    """Fixed-size chunks of column arrays for static-shape inference/eval.
+
+    The tail chunk is padded by repeating its last row so every chunk has
+    the SAME shape — XLA compiles the downstream apply exactly once. Yields
+    ``(chunk_cols, n_real)``; callers trim or mask the ``batch_size -
+    n_real`` pad rows. Shared by ``ModelPredictor.predict`` and the
+    trainers' ``validation_data`` evaluator.
+    """
+    n = len(cols[0])
+    for start in range(0, n, batch_size):
+        chunk = [c[start : start + batch_size] for c in cols]
+        real = len(chunk[0])
+        pad = batch_size - real
+        if pad:
+            chunk = [
+                np.concatenate([c, np.repeat(c[-1:], pad, axis=0)])
+                for c in chunk
+            ]
+        yield chunk, real

@@ -265,15 +265,20 @@ class LocalSGDEngine:
     def center_params(self, state: TrainState) -> Pytree:
         return jax.tree.map(lambda x: jax.device_get(x), state.center)
 
-    def worker_nt(self, state: TrainState, i: int = 0) -> Pytree:
-        # replicate the slice before device_get: under jax.distributed the
-        # worker-sharded leaves are not addressable from every process
+    def worker_nt_device(self, state: TrainState, i: int = 0) -> Pytree:
+        """One worker's non-trainable state, replicated but still on the
+        mesh (no host round-trip) — e.g. for per-epoch validation."""
         if self._take_worker is None:
             self._take_worker = jax.jit(
                 lambda nt, i: jax.tree.map(lambda x: x[i], nt),
                 out_shardings=self._rep,
             )
-        return jax.tree.map(jax.device_get, self._take_worker(state.nt, i))
+        return self._take_worker(state.nt, i)
+
+    def worker_nt(self, state: TrainState, i: int = 0) -> Pytree:
+        # replicate the slice before device_get: under jax.distributed the
+        # worker-sharded leaves are not addressable from every process
+        return jax.tree.map(jax.device_get, self.worker_nt_device(state, i))
 
 
 def _as_tree(state_shardings: TrainState):

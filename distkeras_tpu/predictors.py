@@ -13,7 +13,7 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from distkeras_tpu.data import Dataset
+from distkeras_tpu.data import Dataset, padded_chunks
 from distkeras_tpu.model import ModelSpec, from_keras
 from distkeras_tpu.parallel.mesh import put_global
 
@@ -74,22 +74,14 @@ class ModelPredictor:
         self._fwd = jax.jit(fwd)
 
     def predict(self, ds: Dataset) -> Dataset:
-        n = len(ds)
         cols = [ds[c] for c in self.features_col]
         outs = []
-        bs = self.batch_size
-        for start in range(0, n, bs):
-            chunk = [c[start : start + bs] for c in cols]
-            pad = bs - len(chunk[0])
-            if pad:  # keep a single static shape for XLA
-                chunk = [
-                    np.concatenate([c, np.repeat(c[-1:], pad, axis=0)]) for c in chunk
-                ]
+        for chunk, real in padded_chunks(cols, self.batch_size):
             if self._x_sharding is not None:
                 chunk = [put_global(c, self._x_sharding) for c in chunk]
             x = chunk[0] if len(chunk) == 1 else tuple(chunk)
             out = np.asarray(self._fwd(self.params, self.state, x))
-            outs.append(out[: bs - pad] if pad else out)
+            outs.append(out[:real])
         return ds.with_column(self.output_col, np.concatenate(outs))
 
 

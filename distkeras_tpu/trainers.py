@@ -38,7 +38,7 @@ import numpy as np
 import optax
 
 from distkeras_tpu import utils
-from distkeras_tpu.data import Dataset
+from distkeras_tpu.data import Dataset, padded_chunks
 from distkeras_tpu.model import ModelSpec, from_keras, keras_weights_to_model
 from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.parallel.local_sgd import LocalSGDEngine
@@ -52,35 +52,57 @@ from distkeras_tpu.parallel.merge_rules import (
 from distkeras_tpu.parallel.mesh import get_mesh
 
 
+def _with_clipping(base, clipnorm, clipvalue):
+    """Chain Keras-style gradient clipping in front of an optax transform.
+
+    Parity: the reference's ``worker_optimizer`` was a Keras 1.x optimizer,
+    whose constructors accepted ``clipnorm``/``clipvalue``. ``clipvalue``
+    keeps Keras's elementwise semantics (``optax.clip``); ``clipnorm`` is
+    lowered to GLOBAL-norm clipping (``optax.clip_by_global_norm``) — the
+    modern form (one fused norm over the whole gradient pytree, a single
+    scalar on TPU) rather than Keras 1.x's per-tensor norms.
+    """
+    pre = []
+    if clipnorm is not None:
+        pre.append(optax.clip_by_global_norm(float(clipnorm)))
+    if clipvalue is not None:
+        pre.append(optax.clip(float(clipvalue)))
+    return optax.chain(*pre, base) if pre else base
+
+
 def resolve_optimizer(worker_optimizer, learning_rate: float,
-                      momentum: float = 0.0, nesterov: bool = False):
+                      momentum: float = 0.0, nesterov: bool = False,
+                      clipnorm=None, clipvalue=None):
     """Map the reference's Keras optimizer names onto optax transforms."""
     if isinstance(worker_optimizer, optax.GradientTransformation):
-        return worker_optimizer
+        return _with_clipping(worker_optimizer, clipnorm, clipvalue)
     name = str(worker_optimizer).lower()
     if name == "sgd":
-        if momentum:
-            return optax.sgd(learning_rate, momentum=momentum, nesterov=nesterov)
-        return optax.sgd(learning_rate)
-    if name == "adam":
-        return optax.adam(learning_rate)
-    if name == "fused_adam":
+        base = (
+            optax.sgd(learning_rate, momentum=momentum, nesterov=nesterov)
+            if momentum else optax.sgd(learning_rate)
+        )
+    elif name == "adam":
+        base = optax.adam(learning_rate)
+    elif name == "fused_adam":
         from distkeras_tpu.ops.pallas_kernels import fused_adam
 
-        return fused_adam(learning_rate)
-    if name == "adagrad":
-        return optax.adagrad(learning_rate)
-    if name == "rmsprop":
-        return optax.rmsprop(learning_rate)
-    if name == "adadelta":
-        return optax.adadelta(learning_rate)
-    if name == "adamw":
-        return optax.adamw(learning_rate)
-    if name == "adamax":
-        return optax.adamax(learning_rate)
-    if name == "nadam":
-        return optax.nadam(learning_rate)
-    raise ValueError(f"unknown worker_optimizer {worker_optimizer!r}")
+        base = fused_adam(learning_rate)
+    elif name == "adagrad":
+        base = optax.adagrad(learning_rate)
+    elif name == "rmsprop":
+        base = optax.rmsprop(learning_rate)
+    elif name == "adadelta":
+        base = optax.adadelta(learning_rate)
+    elif name == "adamw":
+        base = optax.adamw(learning_rate)
+    elif name == "adamax":
+        base = optax.adamax(learning_rate)
+    elif name == "nadam":
+        base = optax.nadam(learning_rate)
+    else:
+        raise ValueError(f"unknown worker_optimizer {worker_optimizer!r}")
+    return _with_clipping(base, clipnorm, clipvalue)
 
 
 def _reject_worker_axis_model(spec, where: str) -> None:
@@ -125,6 +147,79 @@ def _fits_device_budget(ds: Dataset, cols, budget_bytes: int) -> bool:
     return len(ds) * row_bytes <= budget_bytes
 
 
+class _Validator:
+    """Per-epoch held-out evaluation (beyond-reference; the reference only
+    ever evaluated after training, via ``evaluators.py`` — SURVEY.md §2b #17).
+
+    Keras-style ``validation_data``: after each epoch the center/global
+    parameters are scored on a held-out ``Dataset``. Evaluation is one jitted
+    masked apply per fixed-size chunk (same static-shape padding scheme as
+    ``ModelPredictor``): the pad rows carry mask 0, so the reported
+    ``val_loss`` is the exact mean over real rows for every NAMED loss (all
+    of ``ops.losses`` is mean-reduced). A custom callable loss is scored as
+    the mean of its single-row values — for a non-mean-reduced or
+    batch-coupled callable that is a different scale than the training
+    loss, so prefer named losses when comparing the two curves.
+    ``val_accuracy`` is
+    reported when the label column is integer-typed and the model emits a
+    trailing class dimension (argmax classification).
+    """
+
+    def __init__(self, spec: ModelSpec, loss_fn: Callable, ds: Dataset,
+                 features_col: list[str], label_col: str, batch_size: int):
+        if len(ds) == 0:
+            raise ValueError("validation_data has 0 rows")
+        self.ds = ds
+        self.cols = list(features_col) + [label_col]
+        self.bs = int(batch_size)
+        n_feat = len(features_col)
+        label_integer = np.issubdtype(
+            np.asarray(ds[label_col][:1]).dtype, np.integer
+        )
+
+        def eval_batch(params, nt, arrs, mask):
+            feats, y = arrs[:n_feat], arrs[n_feat]
+            x = feats[0] if n_feat == 1 else tuple(feats)
+            out, _ = spec.apply(params, nt, x, training=False)
+            # loss_fn is mean-reduced; vmap over single-row slices recovers
+            # per-row losses for any named loss, so pad rows mask out exactly
+            per_row = jax.vmap(
+                lambda yy, oo: loss_fn(yy[None], oo[None])
+            )(y, out)
+            loss_sum = jnp.sum(per_row * mask)
+            # Accuracy only for one-label-per-row classification (y rank 1,
+            # out [bs, C]) — per-token labels get val_loss only (the [bs]
+            # row mask can't weight a token axis).
+            if (label_integer and y.ndim == 1 and out.ndim == 2
+                    and out.shape[-1] >= 2):
+                pred = jnp.argmax(out, axis=-1).astype(y.dtype)
+                correct = jnp.sum((pred == y).astype(jnp.float32) * mask)
+            else:
+                correct = jnp.full((), -1.0)  # sentinel: accuracy undefined
+            return loss_sum, correct
+
+        self._eval = jax.jit(eval_batch)
+
+    def __call__(self, params, nt) -> dict:
+        n = len(self.ds)
+        cols = [np.asarray(self.ds[c]) for c in self.cols]
+        loss_sum, correct_sum, acc_defined = 0.0, 0.0, True
+        for chunk, real in padded_chunks(cols, self.bs):
+            mask = np.zeros(self.bs, np.float32)
+            mask[:real] = 1.0
+            ls, cs = self._eval(params, nt, tuple(chunk), mask)
+            loss_sum += float(ls)
+            cs = float(cs)
+            if cs < 0:
+                acc_defined = False
+            else:
+                correct_sum += cs
+        rec = {"val_loss": loss_sum / n}
+        if acc_defined:
+            rec["val_accuracy"] = correct_sum / n
+        return rec
+
+
 def _as_spec(model) -> tuple[ModelSpec, Any]:
     """Accept a Keras model or a ModelSpec; return (spec, keras_model|None)."""
     if isinstance(model, ModelSpec):
@@ -146,12 +241,18 @@ class Trainer:
     """
 
     def __init__(self, keras_model, loss="mse", worker_optimizer="sgd",
-                 learning_rate: float = 0.01, seed: int = 0):
+                 learning_rate: float = 0.01, seed: int = 0,
+                 clipnorm=None, clipvalue=None):
         self.spec, self.keras_model = _as_spec(keras_model)
         self.loss = loss
         self.loss_fn = get_loss(loss)
         self.worker_optimizer = worker_optimizer
         self.learning_rate = learning_rate
+        # Keras-optimizer parity: the reference's worker_optimizer was a
+        # Keras 1.x optimizer carrying clipnorm/clipvalue — see
+        # _with_clipping for the TPU lowering.
+        self.clipnorm = clipnorm
+        self.clipvalue = clipvalue
         self.seed = seed
         self.history = utils.History()
         self.timer = utils.Timer()
@@ -193,6 +294,27 @@ class Trainer:
         self.history.append(**rec)
         if self.log_metrics:
             print(json.dumps({"metric": label, **rec}), flush=True)
+
+    def _make_validator(self):
+        """Build the validation_data evaluator (or None) — fail-fast: called
+        before training starts on every backend."""
+        if getattr(self, "validation_data", None) is None:
+            return None
+        return _Validator(
+            self.spec, self.loss_fn,
+            self._coerce_dataset(self.validation_data),
+            self.features_col, self.label_col, self.batch_size,
+        )
+
+    def _validate_epoch(self, validator, params, nt, epoch):
+        """Score held-out data and record/stream the result (beyond-reference
+        Keras-style validation; see _Validator)."""
+        rec = validator(params, nt)
+        rec = {"epoch": epoch, **rec} if epoch is not None else dict(rec)
+        self.metrics_.append(rec)
+        self.history.append(**rec)
+        if self.log_metrics:
+            print(json.dumps({"metric": "validation", **rec}), flush=True)
 
     def _materialize_history(self):
         """Pull device loss scalars to host and expand per-epoch loss arrays
@@ -257,9 +379,16 @@ class DistributedTrainer(Trainer):
                  checkpoint_dir=None, checkpoint_every: int = 1,
                  resume: bool = False, profile_dir=None,
                  log_metrics: bool = False,
-                 tolerate_worker_failures: bool = False):
+                 tolerate_worker_failures: bool = False,
+                 clipnorm=None, clipvalue=None, validation_data=None):
         super().__init__(keras_model, loss, worker_optimizer,
-                         learning_rate=learning_rate, seed=seed)
+                         learning_rate=learning_rate, seed=seed,
+                         clipnorm=clipnorm, clipvalue=clipvalue)
+        # Keras-style per-epoch validation (beyond-reference — SURVEY.md §5.5
+        # build note): a held-out Dataset (or (X, y)) scored after each epoch
+        # on the collective backend, and after the run on the free-running PS
+        # backend; val_loss/val_accuracy land in the history + metrics stream.
+        self.validation_data = validation_data
         self.mesh = mesh if mesh is not None else get_mesh(num_workers)
         self.num_workers = (
             int(num_workers) if num_workers is not None
@@ -336,7 +465,10 @@ class DistributedTrainer(Trainer):
         raise NotImplementedError
 
     def allocate_optimizer(self):
-        return resolve_optimizer(self.worker_optimizer, self.learning_rate)
+        return resolve_optimizer(
+            self.worker_optimizer, self.learning_rate,
+            clipnorm=self.clipnorm, clipvalue=self.clipvalue,
+        )
 
     def _loss_step(self) -> Callable:
         return _make_loss_step(self.spec, self.loss_fn, len(self.features_col))
@@ -413,6 +545,7 @@ class DistributedTrainer(Trainer):
                     state = state.replace(step=jnp.asarray(host_state.step))
                 start_epoch = int(payload["epoch"]) + 1
         cols = self.features_col + [self.label_col]
+        validator = self._make_validator()
 
         use_resident = self.device_data
         if use_resident is None:
@@ -449,6 +582,11 @@ class DistributedTrainer(Trainer):
                     self._epoch_metrics(
                         epoch, epoch_rows, n_windows, time.perf_counter() - t0
                     )
+                if validator is not None:
+                    self._validate_epoch(
+                        validator, state.center,
+                        engine.worker_nt_device(state, 0), epoch,
+                    )
                 self._maybe_checkpoint(state, epoch)
         else:
             win_rows = (
@@ -471,6 +609,11 @@ class DistributedTrainer(Trainer):
                         epoch, n_windows * win_rows, n_windows,
                         time.perf_counter() - t0,
                     )
+                if validator is not None:
+                    self._validate_epoch(
+                        validator, state.center,
+                        engine.worker_nt_device(state, 0), epoch,
+                    )
                 self._maybe_checkpoint(state, epoch)
         jax.block_until_ready(state.center)
         self.record_training_end()
@@ -482,6 +625,8 @@ class DistributedTrainer(Trainer):
     def _train_ps(self, ds: Dataset, shuffle: bool):
         from distkeras_tpu.workers import run_async_training
 
+        # fail-fast: a malformed validation_data must not cost a full run
+        validator = self._make_validator()
         self.record_training_start()
         t0 = time.perf_counter()
         params, nt, history = run_async_training(self, ds, shuffle)
@@ -494,6 +639,9 @@ class DistributedTrainer(Trainer):
             n_updates = sum(1 for r in history if "loss" in r)
             rows = n_updates * self.communication_window * self.batch_size
             self._epoch_metrics(None, rows, n_updates, elapsed, label="run")
+        if validator is not None:
+            # hogwild epochs overlap freely — score once, after the run
+            self._validate_epoch(validator, params, nt, None)
         return self._finalize(params, nt)
 
     def _maybe_checkpoint(self, state, epoch: int):
@@ -527,13 +675,16 @@ class SingleTrainer(DistributedTrainer):
     def __init__(self, keras_model, loss="mse", worker_optimizer="sgd",
                  learning_rate: float = 0.01, batch_size: int = 32,
                  features_col="features", label_col: str = "label",
-                 num_epoch: int = 1, seed: int = 0, mesh=None):
+                 num_epoch: int = 1, seed: int = 0, mesh=None,
+                 clipnorm=None, clipvalue=None, validation_data=None):
         super().__init__(
             keras_model, loss, worker_optimizer, learning_rate=learning_rate,
             num_workers=1, batch_size=batch_size, features_col=features_col,
             label_col=label_col, num_epoch=num_epoch, communication_window=1,
             backend="collective",
             mesh=mesh if mesh is not None else get_mesh(1), seed=seed,
+            clipnorm=clipnorm, clipvalue=clipvalue,
+            validation_data=validation_data,
         )
 
     def allocate_merge_rule(self) -> MergeRule:
@@ -606,6 +757,7 @@ class EAMSGD(AEASGD):
         return resolve_optimizer(
             self.worker_optimizer, self.learning_rate,
             momentum=self.momentum, nesterov=True,
+            clipnorm=self.clipnorm, clipvalue=self.clipvalue,
         )
 
 
@@ -666,12 +818,18 @@ class MeshTrainer(Trainer):
                  log_metrics: bool = False,
                  checkpoint_dir=None, checkpoint_every: int = 1,
                  resume: bool = False, profile_dir=None,
-                 input_mode: str = "auto"):
+                 input_mode: str = "auto",
+                 clipnorm=None, clipvalue=None, validation_data=None):
         from distkeras_tpu.parallel.strategies import STRATEGIES
         from distkeras_tpu.parallel.tensor import get_mesh_nd
 
         super().__init__(keras_model, loss, worker_optimizer,
-                         learning_rate=learning_rate, seed=seed)
+                         learning_rate=learning_rate, seed=seed,
+                         clipnorm=clipnorm, clipvalue=clipvalue)
+        # Keras-style per-epoch validation — same contract as
+        # DistributedTrainer.validation_data; the engine-layout params are
+        # gathered to the standard layout before scoring.
+        self.validation_data = validation_data
         if mesh is None:
             mesh = get_mesh_nd(mesh_shape or {"dp": len(jax.devices())})
         self.mesh = mesh
@@ -719,7 +877,8 @@ class MeshTrainer(Trainer):
         from distkeras_tpu.parallel.tensor import SPMDEngine
 
         optimizer = resolve_optimizer(
-            self.worker_optimizer, self.learning_rate
+            self.worker_optimizer, self.learning_rate,
+            clipnorm=self.clipnorm, clipvalue=self.clipvalue,
         )
         ident = lambda p: p
         if self.strategy == "spmd":
@@ -779,6 +938,31 @@ class MeshTrainer(Trainer):
         ds = self._coerce_dataset(dataset)
         cols = self.features_col + [self.label_col]
         engine, to_engine, from_engine = self._build_engine()
+        if self.validation_data is not None and jax.process_count() > 1:
+            raise NotImplementedError(
+                "validation_data under multi-process jax.distributed is "
+                "not supported yet (the per-epoch gather would device_get "
+                "shards this process cannot address)"
+            )
+        validator = self._make_validator()
+
+        def run_validation(epoch):
+            if validator is None:
+                return
+            if self.strategy == "spmd":
+                # engine layout == model layout: score the sharded params
+                # in place — the jitted eval compiles over their mesh
+                # (GSPMD), so a model that only fits sharded stays sharded
+                self._validate_epoch(validator, params, nt, epoch)
+                return
+            # pipeline/sequence/expert layouts need the from_engine
+            # re-layout, which today goes through host (full-pytree gather
+            # per epoch — fine for models these strategies train here)
+            p_std = from_engine(
+                jax.tree.map(np.asarray, jax.device_get(params))
+            )
+            nt_std = jax.tree.map(np.asarray, jax.device_get(nt))
+            self._validate_epoch(validator, p_std, nt_std, epoch)
 
         start_epoch = 0
         restored = None
@@ -831,6 +1015,7 @@ class MeshTrainer(Trainer):
                             epoch, rows, rows // self.batch_size,
                             time.perf_counter() - t0,
                         )
+                    run_validation(epoch)
                     self._maybe_checkpoint(params, nt, opt, epoch)
             else:
                 for epoch in range(start_epoch, self.num_epoch):
@@ -849,6 +1034,7 @@ class MeshTrainer(Trainer):
                             epoch, n_steps * self.batch_size, n_steps,
                             time.perf_counter() - t0,
                         )
+                    run_validation(epoch)
                     self._maybe_checkpoint(params, nt, opt, epoch)
         jax.block_until_ready(jax.tree.leaves(params)[0])
         self.record_training_end()

@@ -229,6 +229,10 @@ class History:
     def losses(self) -> list[float]:
         return [r["loss"] for r in self.records if "loss" in r]
 
+    def val_losses(self) -> list[float]:
+        """Per-epoch held-out losses (trainers' ``validation_data``)."""
+        return [r["val_loss"] for r in self.records if "val_loss" in r]
+
     def to_json(self) -> str:
         return json.dumps(self.records, default=json_default)
 

@@ -331,3 +331,29 @@ def test_mesh_trainer_fsdp_megatron_end_to_end(rng):
         params, trainer.trained_nt_, (toks[:8], mask[:8]), False
     )
     assert out.shape == (8, CLASSES)
+
+
+def test_mesh_trainer_fsdp_validation_stays_sharded(rng):
+    """validation_data on strategy='spmd' scores the SHARDED params in place
+    (no host gather / single-device re-placement — a model that only fits
+    sharded must stay sharded); val records land per epoch and track
+    training."""
+    from distkeras_tpu.trainers import MeshTrainer
+
+    ds, toks, mask, y = learnable_token_dataset(rng)
+
+    trainer = MeshTrainer(
+        small_transformer(), loss="sparse_softmax_cross_entropy",
+        worker_optimizer="adam", learning_rate=2e-3,
+        mesh_shape={"dp": 8}, parameter_sharding="fsdp",
+        batch_size=16, num_epoch=8,
+        features_col=["features", "mask"], label_col="label",
+        validation_data=ds,  # training set as val: loss must fall
+    )
+    trainer.train(ds, shuffle=True)
+    recs = [r for r in trainer.history.records if "val_loss" in r]
+    assert len(recs) == 8
+    vls = [r["val_loss"] for r in recs]
+    assert np.isfinite(vls).all()
+    assert vls[-1] < vls[0]
+    assert 0.0 <= recs[-1]["val_accuracy"] <= 1.0

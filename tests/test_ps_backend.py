@@ -241,3 +241,21 @@ def test_ps_backend_elastic_resume(tmp_path):
     hist = [r for r in t2.get_history() if "loss" in r]
     assert {r["epoch"] for r in hist} == {2, 3}  # epochs 0-1 from checkpoint
     assert np.all(np.isfinite([r["loss"] for r in hist]))
+
+
+def test_ps_backend_validation_scores_after_run():
+    """On the free-running hogwild backend validation runs once, after the
+    run (per-epoch boundaries don't exist), and lands in the history."""
+    from distkeras_tpu import ADAG
+
+    ds = blobs_dataset(n=512)
+    t = ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+             worker_optimizer="adam", learning_rate=5e-3, num_workers=2,
+             batch_size=32, communication_window=2, num_epoch=2,
+             backend="ps", validation_data=ds)
+    t.train(ds)
+    recs = [r for r in t.get_history() if "val_loss" in r]
+    assert len(recs) == 1
+    assert "epoch" not in recs[0]
+    assert np.isfinite(recs[0]["val_loss"])
+    assert 0.0 <= recs[0]["val_accuracy"] <= 1.0

@@ -217,3 +217,108 @@ def test_learning_rate_accepts_optax_schedule():
     losses = [float(l) for l in t.get_history().losses()]
     assert np.all(np.isfinite(losses))
     assert np.mean(losses[-3:]) < losses[0]
+
+
+def test_gradient_clipping_kwargs():
+    """Keras-optimizer parity: the reference's worker_optimizer was a Keras
+    1.x optimizer carrying clipnorm/clipvalue. clipvalue clips elementwise;
+    clipnorm clips by global norm (documented modern lowering)."""
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu.trainers import resolve_optimizer
+
+    grads = {"w": jnp.array([3.0, -4.0])}  # global norm 5
+    params = {"w": jnp.zeros(2)}
+
+    tx = resolve_optimizer("sgd", 1.0, clipnorm=1.0)
+    upd, _ = tx.update(grads, tx.init(params), params)
+    np.testing.assert_allclose(  # scaled to norm 1, then sgd(-1x)
+        np.asarray(upd["w"]), [-0.6, 0.8], rtol=1e-6)
+
+    tx = resolve_optimizer("sgd", 1.0, clipvalue=0.5)
+    upd, _ = tx.update(grads, tx.init(params), params)
+    np.testing.assert_allclose(np.asarray(upd["w"]), [-0.5, 0.5], rtol=1e-6)
+
+    # under the threshold both are the identity
+    tx = resolve_optimizer("sgd", 1.0, clipnorm=100.0, clipvalue=100.0)
+    upd, _ = tx.update(grads, tx.init(params), params)
+    np.testing.assert_allclose(np.asarray(upd["w"]), [-3.0, 4.0], rtol=1e-6)
+
+    # clipping chains in front of explicit optax transforms too
+    tx = resolve_optimizer(optax.sgd(1.0), 1e-3, clipvalue=0.5)
+    upd, _ = tx.update(grads, tx.init(params), params)
+    np.testing.assert_allclose(np.asarray(upd["w"]), [-0.5, 0.5], rtol=1e-6)
+
+
+def test_trainer_level_clipping_trains_and_caps_steps():
+    """A SingleTrainer with a tiny clipnorm still learns, and the optimizer
+    the trainer builds caps the global update norm at lr*clipnorm even for
+    huge gradients."""
+    import jax.numpy as jnp
+
+    ds = blobs_dataset(n=512)
+    t = SingleTrainer(model_spec(), loss="sparse_softmax_cross_entropy",
+                      worker_optimizer="sgd", learning_rate=0.1,
+                      batch_size=64, num_epoch=4, clipnorm=1.0)
+    t.train(ds)
+    losses = [float(l) for l in t.get_history().losses()]
+    assert np.all(np.isfinite(losses))
+    assert np.mean(losses[-3:]) < losses[0]
+    # the magnitude bound, on the exact transform the trainer allocates
+    tx = t.allocate_optimizer()
+    grads = {"a": jnp.full((3,), 1e3), "b": jnp.full((2, 2), -1e3)}
+    params = jax.tree.map(jnp.zeros_like, grads)
+    upd, _ = tx.update(grads, tx.init(params), params)
+    gnorm = float(jnp.sqrt(sum(jnp.sum(u * u) for u in jax.tree.leaves(upd))))
+    np.testing.assert_allclose(gnorm, 0.1 * 1.0, rtol=1e-5)  # lr*clipnorm
+
+
+def test_validation_data_per_epoch():
+    """Keras-style validation_data: one val_loss/val_accuracy record per
+    epoch, exact masked mean over real rows (pad rows excluded), and the
+    numbers track training (val loss falls, accuracy rises on blobs)."""
+    full = blobs_dataset(n=1325, seed=0)
+    x, y = np.asarray(full["features"]), np.asarray(full["label"])
+    ds = Dataset.from_arrays(x[:1024], y[:1024])
+    val = Dataset.from_arrays(x[1024:], y[1024:])  # 301: not a batch multiple
+    t = ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+             worker_optimizer="adam", learning_rate=5e-3, num_workers=4,
+             batch_size=32, communication_window=2, num_epoch=4,
+             validation_data=val)
+    t.train(ds, shuffle=True)
+    recs = [r for r in t.get_history() if "val_loss" in r]
+    assert len(recs) == 4
+    assert [r["epoch"] for r in recs] == [0, 1, 2, 3]
+    vls = t.get_history().val_losses()
+    assert np.all(np.isfinite(vls))
+    assert vls[-1] < vls[0]
+    assert recs[-1]["val_accuracy"] > recs[0]["val_accuracy"] - 1e-9
+    assert 0.0 <= recs[-1]["val_accuracy"] <= 1.0
+
+
+def test_validation_loss_matches_manual_eval():
+    """val_loss at the last epoch equals a hand-computed full-batch loss on
+    the returned trained parameters."""
+    import jax.numpy as jnp
+
+    from distkeras_tpu.ops.losses import get_loss
+
+    full = blobs_dataset(n=712, seed=0)
+    x, y = np.asarray(full["features"]), np.asarray(full["label"])
+    ds = Dataset.from_arrays(x[:512], y[:512])
+    val = Dataset.from_arrays(x[512:], y[512:])
+    spec = model_spec()
+    t = SingleTrainer(spec, loss="sparse_softmax_cross_entropy",
+                      worker_optimizer="sgd", learning_rate=0.05,
+                      batch_size=64, num_epoch=2, validation_data=val)
+    t.train(ds)
+    rec = [r for r in t.get_history() if "val_loss" in r][-1]
+    out, _ = spec.apply(t.trained_params_, t.trained_nt_,
+                        jnp.asarray(val["features"]), training=False)
+    manual = float(get_loss("sparse_softmax_cross_entropy")(
+        jnp.asarray(val["label"]), out))
+    np.testing.assert_allclose(rec["val_loss"], manual, rtol=1e-5)
+    manual_acc = float(np.mean(
+        np.argmax(np.asarray(out), -1) == np.asarray(val["label"])))
+    np.testing.assert_allclose(rec["val_accuracy"], manual_acc, rtol=1e-6)
