@@ -13,7 +13,10 @@ five BASELINE configs map to:
   parallelism);
 - :func:`resnet_small` — beyond-reference batch-norm family: BatchNorm
   running stats ride the engines' non-trainable-state path (per-worker
-  stats, the standard data-parallel BN).
+  stats, the standard data-parallel BN);
+- :func:`transformer_lm` — beyond-reference decoder-only causal LM with
+  KV-cached autoregressive :func:`~distkeras_tpu.models.lm.generate`
+  (prefill + one ``lax.scan`` decode loop, static shapes throughout).
 
 All models emit **logits** (pair with the ``softmax_cross_entropy`` family) and
 default to bfloat16 activations with float32 parameters — bf16 keeps matmuls
@@ -27,6 +30,12 @@ from distkeras_tpu.models.lstm import LSTMClassifier, lstm_classifier
 from distkeras_tpu.models.moe import (
     MoETransformerClassifier,
     moe_transformer_classifier,
+)
+from distkeras_tpu.models.lm import (
+    TransformerLM,
+    generate,
+    next_token_dataset,
+    transformer_lm,
 )
 from distkeras_tpu.models.resnet import ResNetSmall, resnet_small
 from distkeras_tpu.models.transformer import (
@@ -44,4 +53,5 @@ __all__ = [
     "TransformerClassifier", "transformer_classifier",
     "pipelined_transformer_forward",
     "MoETransformerClassifier", "moe_transformer_classifier",
+    "TransformerLM", "transformer_lm", "generate", "next_token_dataset",
 ]

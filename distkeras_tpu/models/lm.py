@@ -1,0 +1,307 @@
+"""Decoder-only causal language model + TPU-idiomatic autoregressive decoding.
+
+Beyond-reference model family (the Spark-era reference topped out at an LSTM
+classifier — SURVEY.md §2b.2 "reference predates long-context"): a pre-norm
+causal transformer LM trainable by every trainer in this framework (the
+next-token objective is plain ``sparse_softmax_cross_entropy`` on the
+``[B, L, V]`` logits against the shifted token labels), plus a
+:func:`generate` path built the TPU way:
+
+- **Static shapes everywhere**: the prompt is one fixed-length prefill, the
+  KV cache is a preallocated ``[B, maxlen, H, Dh]`` buffer per block updated
+  with ``lax.dynamic_update_slice``, and the decode loop is a single
+  ``lax.scan`` over ``max_new_tokens`` steps — one XLA compilation, no
+  per-token Python.
+- **MXU-friendly**: cache and activations live in the model dtype (bf16 on
+  TPU); attention math accumulates in f32 like the training path.
+- The per-block parameter names (``qkv``/``attn_out``/``mlp_up``/
+  ``mlp_down``) match the encoder family, so ``parallel.tensor``'s Megatron
+  sharding rules apply unchanged and ``MeshTrainer`` trains the LM with any
+  ``parameter_sharding``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.model import ModelSpec, from_flax
+from distkeras_tpu.models.transformer import sincos_positions
+from distkeras_tpu.parallel.sequence import attention_reference
+
+
+class DecoderBlock(nn.Module):
+    """Pre-norm causal block with three entry points sharing one parameter
+    set: ``__call__`` (training / full forward), ``prefill`` (full forward
+    that also returns this block's K/V for the cache), and ``step`` (one
+    decode position against the cache)."""
+
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "reference"
+
+    def setup(self):
+        f32 = jnp.float32
+        self.ln_attn = nn.LayerNorm(dtype=f32)
+        self.qkv = nn.Dense(3 * self.dim, dtype=self.dtype)
+        self.attn_out = nn.Dense(self.dim, dtype=self.dtype)
+        self.ln_mlp = nn.LayerNorm(dtype=f32)
+        self.mlp_up = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype)
+        self.mlp_down = nn.Dense(self.dim, dtype=self.dtype)
+
+    def _project_qkv(self, x):
+        B, L, _ = x.shape
+        h = self.ln_attn(x)
+        qkv = self.qkv(h.astype(self.dtype))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, L, self.heads, self.dim // self.heads)
+        return tuple(t.reshape(shape) for t in (q, k, v))
+
+    def _mlp(self, x):
+        h = self.ln_mlp(x)
+        h = self.mlp_up(h.astype(self.dtype))
+        h = nn.gelu(h)
+        h = self.mlp_down(h)
+        return x + h.astype(jnp.float32)
+
+    def _attn_full(self, x, mask):
+        B, L, _ = x.shape
+        q, k, v = self._project_qkv(x)
+        if self.attn_impl == "reference":
+            att = attention_reference(q, k, v, causal=True, key_mask=mask)
+        else:
+            from distkeras_tpu.ops.flash_attention import attention
+
+            att = attention(q, k, v, causal=True, key_mask=mask,
+                            impl=self.attn_impl)
+        att = att.reshape(B, L, self.dim)
+        x = x + self.attn_out(att.astype(self.dtype)).astype(jnp.float32)
+        return x, k, v
+
+    def __call__(self, x, mask=None, training: bool = False):
+        x, _, _ = self._attn_full(x, mask)
+        return self._mlp(x)
+
+    def prefill(self, x, mask=None):
+        x, k, v = self._attn_full(x, mask)
+        return self._mlp(x), k, v
+
+    def step(self, x_t, k_cache, v_cache, pos):
+        """One decode position. ``x_t``: [B, 1, dim] residual stream;
+        ``k_cache``/``v_cache``: [B, maxlen, H, Dh] holding positions
+        ``< pos``; ``pos`` may be a traced scalar."""
+        q, k, v = self._project_qkv(x_t)  # each [B, 1, H, Dh]
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0)
+        )
+        dh = self.dim // self.heads
+        # same dtype path as attention_reference (parallel/sequence.py:39-52)
+        # so cached decode is bit-compatible with the full forward in bf16:
+        # q·k in model dtype, softmax in f32, p·v back in model dtype
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32) \
+            * (dh ** -0.5)
+        valid = jnp.arange(k_cache.shape[1]) <= pos  # causal: cache ≤ pos
+        s = jnp.where(valid[None, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        att = jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(v_cache.dtype), v_cache
+        )
+        att = att.reshape(x_t.shape[0], 1, self.dim)
+        x_t = x_t + self.attn_out(att.astype(self.dtype)).astype(jnp.float32)
+        return self._mlp(x_t), k_cache, v_cache
+
+
+class TransformerLM(nn.Module):
+    """Token sequence → next-token logits ``[B, L, vocab]`` (training), with
+    ``prefill``/``decode_step`` methods for cached autoregressive decoding."""
+
+    vocab: int = 1024
+    maxlen: int = 256
+    dim: int = 128
+    heads: int = 4
+    depth: int = 2
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "reference"
+
+    def setup(self):
+        self.embed = nn.Embed(self.vocab, self.dim, dtype=self.dtype)
+        self.blocks = [
+            DecoderBlock(dim=self.dim, heads=self.heads, dtype=self.dtype,
+                         attn_impl=self.attn_impl)
+            for _ in range(self.depth)
+        ]
+        self.ln_head = nn.LayerNorm(dtype=jnp.float32)
+        self.lm_head = nn.Dense(self.vocab, dtype=self.dtype)
+
+    def _embed_at(self, tokens, pos0: int | jax.Array = 0):
+        """Embed ``tokens`` occupying positions ``pos0 .. pos0+L``."""
+        x = self.embed(tokens).astype(jnp.float32)
+        table = jnp.asarray(sincos_positions(self.maxlen, self.dim))
+        pos = jax.lax.dynamic_slice(
+            table, (pos0, 0), (tokens.shape[1], self.dim)
+        )
+        return x + pos[None]
+
+    def _logits(self, x):
+        h = self.ln_head(x)
+        return self.lm_head(h.astype(self.dtype)).astype(jnp.float32)
+
+    def __call__(self, tokens, mask=None, training: bool = False):
+        x = self._embed_at(tokens)
+        for blk in self.blocks:
+            x = blk(x, mask, training)
+        return self._logits(x)
+
+    def prefill(self, tokens):
+        """Full forward over the prompt; returns ``(logits, caches)`` with
+        per-block maxlen-size K/V buffers holding positions ``< L``."""
+        B, L = tokens.shape
+        dh = self.dim // self.heads
+        x = self._embed_at(tokens)
+        caches = []
+        for blk in self.blocks:
+            x, k, v = blk.prefill(x, None)
+            kc = jnp.zeros((B, self.maxlen, self.heads, dh), self.dtype)
+            vc = jnp.zeros_like(kc)
+            kc = jax.lax.dynamic_update_slice(
+                kc, k.astype(self.dtype), (0, 0, 0, 0)
+            )
+            vc = jax.lax.dynamic_update_slice(
+                vc, v.astype(self.dtype), (0, 0, 0, 0)
+            )
+            caches.append((kc, vc))
+        return self._logits(x), tuple(caches)
+
+    def decode_step(self, tok, caches, pos):
+        """One decode step: ``tok`` [B] int32 at position ``pos`` (traced
+        scalar ok) → ``(next-token logits [B, vocab], updated caches)``."""
+        x = self._embed_at(tok[:, None], pos)
+        new_caches = []
+        for blk, (kc, vc) in zip(self.blocks, caches):
+            x, kc, vc = blk.step(x, kc, vc, pos)
+            new_caches.append((kc, vc))
+        return self._logits(x)[:, 0], tuple(new_caches)
+
+
+def _sample_fn(temperature: float, top_k: int | None):
+    """Greedy for temperature==0, else temperature/top-k categorical."""
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / temperature
+        if top_k is not None:
+            kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+            scaled = jnp.where(scaled < kth, -1e30, scaled)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+    return sample
+
+
+@functools.lru_cache(maxsize=64)
+def _generate_program(module: TransformerLM, max_new_tokens: int,
+                      temperature: float, top_k: int | None):
+    """One jitted prefill+scan program per (module, decode config) — flax
+    modules are frozen dataclasses, so the lru_cache key is by value and
+    repeated generate()/GeneratorPredictor chunks reuse the compilation
+    (jit itself still specializes per prompt shape)."""
+    sample = _sample_fn(temperature, top_k)
+
+    def run(params, prompt, key):
+        lp = prompt.shape[1]
+        logits, caches = module.apply(
+            {"params": params}, prompt, method=TransformerLM.prefill
+        )
+        key, k0 = jax.random.split(key)
+        tok = sample(logits[:, -1], k0)
+
+        def body(carry, key_i):
+            tok, caches, pos = carry
+            logits, caches = module.apply(
+                {"params": params}, tok, caches, pos,
+                method=TransformerLM.decode_step,
+            )
+            nxt = sample(logits, key_i)
+            return (nxt, caches, pos + 1), tok
+
+        keys = jax.random.split(key, max_new_tokens)[1:]
+        (last, _, _), toks = jax.lax.scan(
+            body, (tok, caches, jnp.asarray(lp, jnp.int32)), keys
+        )
+        # toks: [max_new-1, B] emitted per step, plus the final carry token
+        out = jnp.concatenate([toks, last[None]], axis=0)
+        return jnp.concatenate([prompt, out.T.astype(jnp.int32)], axis=1)
+
+    return jax.jit(run)
+
+
+def generate(model, params, prompt, max_new_tokens: int, *,
+             temperature: float = 0.0, top_k: int | None = None,
+             seed: int = 0):
+    """Autoregressive decoding: ``prompt`` [B, Lp] int32 → [B, Lp+new] int32.
+
+    One jitted program: prefill writes the KV caches for the whole prompt in
+    a single batched forward, then a ``lax.scan`` emits one token per step
+    against the cache (O(L) per token instead of the O(L²) of re-running the
+    full forward). ``temperature=0`` is greedy; otherwise categorical
+    sampling at the given temperature, optionally truncated to the ``top_k``
+    highest-probability tokens. Deterministic for a fixed ``seed``.
+    """
+    module = model.module if isinstance(model, ModelSpec) else model
+    if not isinstance(module, TransformerLM):
+        raise TypeError(
+            f"generate() needs a TransformerLM (or its ModelSpec from "
+            f"transformer_lm()), got {type(module)}"
+        )
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim != 2:
+        raise ValueError(f"prompt must be [batch, length], got {prompt.shape}")
+    lp = prompt.shape[1]
+    if lp + max_new_tokens > module.maxlen:
+        raise ValueError(
+            f"prompt length {lp} + max_new_tokens {max_new_tokens} exceeds "
+            f"the model's maxlen {module.maxlen}"
+        )
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if top_k is not None and not 1 <= int(top_k) <= module.vocab:
+        raise ValueError(
+            f"top_k must be in [1, vocab={module.vocab}], got {top_k}"
+        )
+    run = _generate_program(
+        module, int(max_new_tokens), float(temperature), top_k
+    )
+    return np.asarray(run(params, prompt, jax.random.PRNGKey(seed)))
+
+
+def transformer_lm(vocab=1024, maxlen=256, dim=128, heads=4, depth=2,
+                   dtype=jnp.bfloat16, attn_impl="reference") -> ModelSpec:
+    """Causal-LM ModelSpec. Train with ``loss="sparse_softmax_cross_entropy"``
+    on ``features=tokens [B, L]`` / ``label=tokens shifted left [B, L]``
+    (see :func:`next_token_dataset`); decode with :func:`generate`."""
+    module = TransformerLM(
+        vocab=vocab, maxlen=maxlen, dim=dim, heads=heads, depth=depth,
+        dtype=dtype, attn_impl=attn_impl,
+    )
+    example = jnp.zeros((1, maxlen), jnp.int32)
+    return from_flax(module, example, name="transformer_lm")
+
+
+def next_token_dataset(tokens: np.ndarray):
+    """``[N, L+1]`` token rows → Dataset with ``features`` ``[N, L]`` and the
+    next-token ``label`` ``[N, L]`` (inputs shifted left by one)."""
+    from distkeras_tpu.data import Dataset
+
+    tokens = np.asarray(tokens, np.int32)
+    return Dataset(
+        {"features": tokens[:, :-1], "label": tokens[:, 1:]}
+    )

@@ -93,3 +93,53 @@ class LabelIndexPredictor(ModelPredictor):
         return out.with_column(
             self.output_col, np.argmax(out[self.output_col], axis=-1).astype(np.int32)
         )
+
+
+class GeneratorPredictor:
+    """Map KV-cached autoregressive decoding over a Dataset of prompts.
+
+    Beyond-reference sibling of ``ModelPredictor`` for the causal-LM family
+    (``models.transformer_lm``): appends a column of newly generated tokens
+    ``[N, max_new_tokens]``. Prompts are processed in fixed-size chunks
+    (static shapes — XLA compiles the prefill+scan program once); pad rows
+    are generated and discarded.
+    """
+
+    def __init__(self, model, params, *, features_col: str = "features",
+                 output_col: str = "generated", max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int | None = None,
+                 seed: int = 0, batch_size: int = 64):
+        from distkeras_tpu.models.lm import TransformerLM
+
+        module = model.module if isinstance(model, ModelSpec) else model
+        if not isinstance(module, TransformerLM):
+            raise TypeError(
+                f"GeneratorPredictor needs a TransformerLM (or its "
+                f"ModelSpec), got {type(module)}"
+            )
+        self.model = model
+        self.params = params
+        self.features_col = features_col
+        self.output_col = output_col
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.seed = int(seed)
+        self.batch_size = int(batch_size)
+
+    def predict(self, ds: Dataset) -> Dataset:
+        from distkeras_tpu.models.lm import generate
+
+        outs = []
+        for i, ((chunk,), real) in enumerate(padded_chunks(
+            [np.asarray(ds[self.features_col])], self.batch_size
+        )):
+            full = generate(
+                self.model, self.params, chunk, self.max_new_tokens,
+                temperature=self.temperature, top_k=self.top_k,
+                # distinct stream per chunk — identical prompts in different
+                # chunks must not draw identical samples
+                seed=self.seed + i,
+            )
+            outs.append(full[:real, chunk.shape[1]:])
+        return ds.with_column(self.output_col, np.concatenate(outs))

@@ -1,0 +1,213 @@
+"""Causal LM + KV-cached autoregressive decoding (models/lm.py).
+
+The load-bearing oracle: decoding one token at a time against the KV cache
+must produce exactly the same logits as re-running the full causal forward
+on the growing sequence — cache decode is an optimization, never a
+different model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import generate, next_token_dataset, transformer_lm
+from distkeras_tpu.models.lm import TransformerLM
+
+VOCAB, MAXLEN, DIM, HEADS, DEPTH = 64, 32, 32, 4, 2
+
+
+@pytest.fixture(scope="module")
+def lm():
+    spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS,
+                          depth=DEPTH, dtype=jnp.float32)
+    params, _ = spec.init_np(0)
+    return spec, params
+
+
+def test_decode_step_matches_full_forward(lm):
+    """Prefill + N cached decode steps == full forward logits, position by
+    position (f32, exact math path)."""
+    spec, params = lm
+    module = spec.module
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, VOCAB, size=(3, 12)).astype(np.int32)
+
+    lp = 5
+    logits_pre, caches = module.apply(
+        {"params": params}, toks[:, :lp], method=TransformerLM.prefill
+    )
+    # full-forward oracle on each prefix
+    for pos in range(lp, toks.shape[1]):
+        step_logits, caches = module.apply(
+            {"params": params}, toks[:, pos], caches, pos,
+            method=TransformerLM.decode_step,
+        )
+        full = module.apply({"params": params}, toks[:, : pos + 1])
+        np.testing.assert_allclose(
+            np.asarray(step_logits), np.asarray(full[:, -1]),
+            rtol=2e-4, atol=2e-4,
+        )
+    # prefill's own logits match the full forward too
+    full = module.apply({"params": params}, toks[:, :lp])
+    np.testing.assert_allclose(
+        np.asarray(logits_pre), np.asarray(full), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_greedy_generation_matches_uncached_argmax(lm):
+    """generate(temperature=0) equals the naive loop that re-runs the full
+    forward and argmaxes — the cache changes cost, not output."""
+    spec, params = lm
+    module = spec.module
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, VOCAB, size=(2, 6)).astype(np.int32)
+    out = generate(spec, params, prompt, max_new_tokens=8)
+    assert out.shape == (2, 14)
+    assert np.array_equal(out[:, :6], prompt)
+
+    seq = jnp.asarray(prompt)
+    for _ in range(8):
+        logits = module.apply({"params": params}, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, np.asarray(seq))
+
+
+def test_sampled_generation_reproducible_and_valid(lm):
+    spec, params = lm
+    prompt = np.zeros((4, 4), np.int32)
+    a = generate(spec, params, prompt, max_new_tokens=6, temperature=1.0,
+                 top_k=8, seed=7)
+    b = generate(spec, params, prompt, max_new_tokens=6, temperature=1.0,
+                 top_k=8, seed=7)
+    c = generate(spec, params, prompt, max_new_tokens=6, temperature=1.0,
+                 top_k=8, seed=8)
+    np.testing.assert_array_equal(a, b)  # same seed → same tokens
+    assert not np.array_equal(a, c)      # different seed → different draw
+    assert a.min() >= 0 and a.max() < VOCAB
+
+
+def test_top_k_restricts_support(lm):
+    """With top_k=1, sampling at any temperature degenerates to greedy."""
+    spec, params = lm
+    prompt = np.ones((2, 5), np.int32)
+    greedy = generate(spec, params, prompt, max_new_tokens=5)
+    k1 = generate(spec, params, prompt, max_new_tokens=5, temperature=2.0,
+                  top_k=1, seed=3)
+    np.testing.assert_array_equal(greedy, k1)
+
+
+def test_generate_validates_inputs(lm):
+    spec, params = lm
+    with pytest.raises(ValueError, match="maxlen"):
+        generate(spec, params, np.zeros((1, 30), np.int32), max_new_tokens=5)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(spec, params, np.zeros((1, 4), np.int32), max_new_tokens=0)
+    with pytest.raises(ValueError, match="batch, length"):
+        generate(spec, params, np.zeros((4,), np.int32), max_new_tokens=2)
+    with pytest.raises(TypeError, match="TransformerLM"):
+        from distkeras_tpu.models import mlp
+
+        generate(mlp(), params, np.zeros((1, 4), np.int32), max_new_tokens=2)
+
+
+def test_lm_trains_next_token_with_trainer():
+    """The LM is a first-class trainer citizen: ADAG on the 8-device mesh
+    drives next-token loss down on a deterministic-cycle language, and the
+    trained model then generates the cycle greedily."""
+    from distkeras_tpu import ADAG
+
+    period = 8
+    rows, length = 512, 16
+    rng = np.random.default_rng(0)
+    starts = rng.integers(0, period, size=(rows, 1))
+    grid = (starts + np.arange(length + 1)[None]) % period  # token = pos%8
+    ds = next_token_dataset(grid)
+    assert ds["features"].shape == (rows, length)
+    assert np.array_equal(ds["features"][:, 1:], ds["label"][:, :-1])
+
+    spec = transformer_lm(vocab=period, maxlen=32, dim=32, heads=4, depth=2,
+                          dtype=jnp.float32)
+    t = ADAG(spec, loss="sparse_softmax_cross_entropy",
+             worker_optimizer="adam", learning_rate=5e-3, num_workers=4,
+             batch_size=32, communication_window=2, num_epoch=6)
+    t.train(ds, shuffle=True)
+    losses = [float(l) for l in t.get_history().losses()]
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < 0.5 * np.mean(losses[:4])
+
+    prompt = np.tile(np.arange(6) % period, (2, 1)).astype(np.int32)
+    out = generate(spec, t.trained_params_, prompt, max_new_tokens=8)
+    expect = (np.arange(6, 14) % period)[None].repeat(2, axis=0)
+    assert np.array_equal(out[:, 6:], expect)
+
+
+def test_generator_predictor_appends_column(lm):
+    """GeneratorPredictor chunks prompts to a static batch and appends the
+    generated-token column; equal to calling generate() directly."""
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.predictors import GeneratorPredictor
+
+    spec, params = lm
+    rng = np.random.default_rng(2)
+    prompts = rng.integers(0, VOCAB, size=(11, 6)).astype(np.int32)  # 11 % 4 != 0
+    ds = Dataset({"features": prompts})
+    p = GeneratorPredictor(spec, params, max_new_tokens=5, batch_size=4)
+    out = p.predict(ds)
+    assert out["generated"].shape == (11, 5)
+    direct = generate(spec, params, prompts, max_new_tokens=5)
+    np.testing.assert_array_equal(out["generated"], direct[:, 6:])
+
+    with pytest.raises(TypeError, match="TransformerLM"):
+        from distkeras_tpu.models import mlp
+
+        GeneratorPredictor(mlp(), params)
+
+
+def test_generate_single_token_and_program_reuse(lm):
+    """max_new_tokens=1 (zero-length scan) works, and repeated generate()
+    calls with one decode config reuse one compiled program."""
+    from distkeras_tpu.models.lm import _generate_program
+
+    spec, params = lm
+    prompt = np.zeros((2, 4), np.int32)
+    out = generate(spec, params, prompt, max_new_tokens=1)
+    assert out.shape == (2, 5)
+    full = spec.module.apply({"params": params}, jnp.asarray(prompt))
+    np.testing.assert_array_equal(
+        out[:, -1], np.asarray(jnp.argmax(full[:, -1], -1)))
+    assert _generate_program(spec.module, 1, 0.0, None) is \
+        _generate_program(spec.module, 1, 0.0, None)
+
+
+def test_decode_matches_full_forward_bf16():
+    """The decode step follows attention_reference's exact dtype path, so
+    cache-vs-full parity holds in the default bf16 too (logit differences at
+    the bf16 resolution floor, not a different math path)."""
+    spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS,
+                          depth=DEPTH, dtype=jnp.bfloat16)
+    params, _ = spec.init_np(0)
+    module = spec.module
+    rng = np.random.default_rng(3)
+    toks = rng.integers(0, VOCAB, size=(2, 10)).astype(np.int32)
+    _, caches = module.apply(
+        {"params": params}, toks[:, :9], method=TransformerLM.prefill
+    )
+    step_logits, _ = module.apply(
+        {"params": params}, toks[:, 9], caches, 9,
+        method=TransformerLM.decode_step,
+    )
+    full = module.apply({"params": params}, toks)
+    np.testing.assert_allclose(
+        np.asarray(step_logits), np.asarray(full[:, -1]), rtol=0, atol=1e-3
+    )
+
+
+def test_generate_rejects_bad_top_k(lm):
+    spec, params = lm
+    prompt = np.zeros((1, 4), np.int32)
+    for bad in (0, -3, VOCAB + 1):
+        with pytest.raises(ValueError, match="top_k"):
+            generate(spec, params, prompt, max_new_tokens=2,
+                     temperature=1.0, top_k=bad)
