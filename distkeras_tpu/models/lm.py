@@ -45,6 +45,7 @@ class DecoderBlock(nn.Module):
     mlp_ratio: int = 4
     dtype: jnp.dtype = jnp.bfloat16
     attn_impl: str = "reference"
+    attn_window: int | None = None  # sliding-window (local) attention span
 
     def setup(self):
         f32 = jnp.float32
@@ -74,12 +75,18 @@ class DecoderBlock(nn.Module):
         B, L, _ = x.shape
         q, k, v = self._project_qkv(x)
         if self.attn_impl == "reference":
-            att = attention_reference(q, k, v, causal=True, key_mask=mask)
+            att = attention_reference(q, k, v, causal=True, key_mask=mask,
+                                      window=self.attn_window)
         else:
             from distkeras_tpu.ops.flash_attention import attention
 
+            # "flash" means "auto" here: decode prompts are ragged by
+            # nature, so a hard-forced kernel would reject prefill lengths
+            # that aren't tile multiples; training shapes (maxlen-derived)
+            # stay tile-friendly and keep the kernel
+            impl = "auto" if self.attn_impl == "flash" else self.attn_impl
             att = attention(q, k, v, causal=True, key_mask=mask,
-                            impl=self.attn_impl)
+                            impl=impl, window=self.attn_window)
         att = att.reshape(B, L, self.dim)
         x = x + self.attn_out(att.astype(self.dtype)).astype(jnp.float32)
         return x, k, v
@@ -109,7 +116,10 @@ class DecoderBlock(nn.Module):
         # q·k in model dtype, softmax in f32, p·v back in model dtype
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32) \
             * (dh ** -0.5)
-        valid = jnp.arange(k_cache.shape[1]) <= pos  # causal: cache ≤ pos
+        kp = jnp.arange(k_cache.shape[1])
+        valid = kp <= pos                            # causal: cache ≤ pos
+        if self.attn_window is not None:
+            valid &= pos - kp < self.attn_window     # sliding-window band
         s = jnp.where(valid[None, None, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         att = jnp.einsum(
@@ -131,12 +141,14 @@ class TransformerLM(nn.Module):
     depth: int = 2
     dtype: jnp.dtype = jnp.bfloat16
     attn_impl: str = "reference"
+    attn_window: int | None = None  # sliding-window (local) attention span
 
     def setup(self):
         self.embed = nn.Embed(self.vocab, self.dim, dtype=self.dtype)
         self.blocks = [
             DecoderBlock(dim=self.dim, heads=self.heads, dtype=self.dtype,
-                         attn_impl=self.attn_impl)
+                         attn_impl=self.attn_impl,
+                         attn_window=self.attn_window)
             for _ in range(self.depth)
         ]
         self.ln_head = nn.LayerNorm(dtype=jnp.float32)
@@ -284,13 +296,17 @@ def generate(model, params, prompt, max_new_tokens: int, *,
 
 
 def transformer_lm(vocab=1024, maxlen=256, dim=128, heads=4, depth=2,
-                   dtype=jnp.bfloat16, attn_impl="reference") -> ModelSpec:
+                   dtype=jnp.bfloat16, attn_impl="reference",
+                   attn_window=None) -> ModelSpec:
     """Causal-LM ModelSpec. Train with ``loss="sparse_softmax_cross_entropy"``
     on ``features=tokens [B, L]`` / ``label=tokens shifted left [B, L]``
-    (see :func:`next_token_dataset`); decode with :func:`generate`."""
+    (see :func:`next_token_dataset`); decode with :func:`generate`.
+    ``attn_window`` enables Mistral-style sliding-window attention (training
+    compute O(L·window) on the flash path; decode masks the cache to the
+    window band)."""
     module = TransformerLM(
         vocab=vocab, maxlen=maxlen, dim=dim, heads=heads, depth=depth,
-        dtype=dtype, attn_impl=attn_impl,
+        dtype=dtype, attn_impl=attn_impl, attn_window=attn_window,
     )
     example = jnp.zeros((1, maxlen), jnp.int32)
     return from_flax(module, example, name="transformer_lm")

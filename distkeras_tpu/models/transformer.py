@@ -36,7 +36,8 @@ def sincos_positions(maxlen: int, dim: int) -> np.ndarray:
 
 def attention_sublayer(x, mask, *, dim, heads, causal, dtype,
                        attn_impl: str = "reference",
-                       sp_axis: str | None = None, sp_size: int | None = None):
+                       sp_axis: str | None = None, sp_size: int | None = None,
+                       attn_window: int | None = None):
     """Pre-norm self-attention + residual, shared by the dense and MoE
     encoder blocks (must be called from a compact ``__call__``).
 
@@ -47,6 +48,9 @@ def attention_sublayer(x, mask, *, dim, heads, causal, dtype,
     "ring" (sequence-parallel ring attention — only valid when the caller is
     already inside ``shard_map`` over mesh axis ``sp_axis`` of size
     ``sp_size``, with ``x``/``mask`` holding this shard's sequence slice).
+    ``attn_window``: sliding-window (local) attention span — on the flash
+    path the kernel only visits in-band tiles, so long-context compute
+    scales as O(L·window).
     """
     B, L, _ = x.shape
     h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
@@ -57,6 +61,12 @@ def attention_sublayer(x, mask, *, dim, heads, causal, dtype,
     if attn_impl == "ring":
         from distkeras_tpu.parallel.sequence import ring_attention_shard
 
+        if attn_window is not None:
+            raise ValueError(
+                "attn_window is not supported with attn_impl='ring' (shard "
+                "the sequence over sp and use flash windows per shard, or "
+                "use a non-ring impl)"
+            )
         # no f32 pre-cast: the ring body casts per block internally, and
         # rotating K/V in bf16 halves the per-step ICI payload
         att = ring_attention_shard(
@@ -65,12 +75,13 @@ def attention_sublayer(x, mask, *, dim, heads, causal, dtype,
             scale=(dim // heads) ** -0.5,
         )
     elif attn_impl == "reference":
-        att = attention_reference(q, k, v, causal=causal, key_mask=mask)
+        att = attention_reference(q, k, v, causal=causal, key_mask=mask,
+                                  window=attn_window)
     else:
         from distkeras_tpu.ops.flash_attention import attention
 
         att = attention(q, k, v, causal=causal, key_mask=mask,
-                        impl=attn_impl)
+                        impl=attn_impl, window=attn_window)
     att = att.reshape(B, L, dim)
     return x + nn.Dense(dim, dtype=dtype, name="attn_out")(
         att.astype(dtype)
@@ -86,13 +97,15 @@ class EncoderBlock(nn.Module):
     attn_impl: str = "reference"
     sp_axis: str | None = None   # set (with sp_size) for attn_impl="ring"
     sp_size: int | None = None
+    attn_window: int | None = None  # sliding-window (local) attention span
 
     @nn.compact
     def __call__(self, x, mask=None, training: bool = False):
         x = attention_sublayer(x, mask, dim=self.dim, heads=self.heads,
                                causal=self.causal, dtype=self.dtype,
                                attn_impl=self.attn_impl,
-                               sp_axis=self.sp_axis, sp_size=self.sp_size)
+                               sp_axis=self.sp_axis, sp_size=self.sp_size,
+                               attn_window=self.attn_window)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
         h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype,
                      name="mlp_up")(h.astype(self.dtype))
@@ -123,6 +136,7 @@ class TransformerClassifier(nn.Module):
     attn_impl: str = "reference"
     sp_axis: str | None = None   # set (with sp_size) for attn_impl="ring"
     sp_size: int | None = None
+    attn_window: int | None = None  # sliding-window (local) attention span
     #: rematerialize each block's activations in the backward pass
     #: (jax.checkpoint): ~L·dim per block of saved activations traded for
     #: one extra forward — the standard long-context memory lever
@@ -138,7 +152,8 @@ class TransformerClassifier(nn.Module):
         self.blocks = [
             block_cls(dim=self.dim, heads=self.heads, causal=self.causal,
                       dtype=self.dtype, attn_impl=self.attn_impl,
-                      sp_axis=self.sp_axis, sp_size=self.sp_size)
+                      sp_axis=self.sp_axis, sp_size=self.sp_size,
+                      attn_window=self.attn_window)
             for _ in range(self.depth)
         ]
         self.ln_head = nn.LayerNorm(dtype=jnp.float32)
@@ -206,8 +221,10 @@ def pipelined_transformer_forward(module: TransformerClassifier, params,
     stage_params = stack_stage_params(
         [params[f"blocks_{i}"] for i in range(module.depth)]
     )
+    impl = "reference" if module.attn_impl == "ring" else module.attn_impl
     block = EncoderBlock(dim=module.dim, heads=module.heads,
-                         causal=module.causal, dtype=module.dtype)
+                         causal=module.causal, dtype=module.dtype,
+                         attn_impl=impl, attn_window=module.attn_window)
 
     def stage(p, act):
         h, m = act
@@ -295,11 +312,12 @@ def transformer_classifier(vocab=20000, maxlen=200, dim=128, heads=4, depth=2,
                            num_classes=2, causal=False,
                            dtype=jnp.bfloat16,
                            attn_impl="reference",
-                           remat=False) -> ModelSpec:
+                           remat=False,
+                           attn_window=None) -> ModelSpec:
     module = TransformerClassifier(
         vocab=vocab, maxlen=maxlen, dim=dim, heads=heads, depth=depth,
         num_classes=num_classes, causal=causal, dtype=dtype,
-        attn_impl=attn_impl, remat=remat,
+        attn_impl=attn_impl, remat=remat, attn_window=attn_window,
     )
     example = (
         jnp.zeros((1, maxlen), jnp.int32),

@@ -50,13 +50,112 @@ BLOCK_Q = 128   # q rows per grid step
 BLOCK_K = 512   # k/v rows per inner grid step
 
 
+def _first_k_tile(iq, *, block_q, block_k, window):
+    """Index of the first k tile inside the attention band of q block
+    ``iq`` (0 when unwindowed). Floor division handles the negative
+    numerator near the sequence start."""
+    if window is None:
+        return 0
+    return jnp.maximum(0, (iq * block_q - window + 1) // block_k)
+
+
+def _last_k_tile(iq, nk, *, block_q, block_k, causal, window):
+    """Index of the last contributing k tile for q block ``iq``: the causal
+    diagonal and/or the upper edge of the window band, else the last tile."""
+    last = nk - 1
+    if causal:
+        last = jnp.minimum(last, (iq * block_q + block_q - 1) // block_k)
+    elif window is not None:
+        last = jnp.minimum(
+            last, (iq * block_q + block_q - 1 + window - 1) // block_k
+        )
+    return last
+
+
+def band_predicate(q_pos, k_pos, causal, window):
+    """THE causal/sliding-window validity predicate, shared by the kernels
+    (both orientations), the XLA backward oracle, and
+    ``attention_reference``: query ``i`` sees key ``j`` iff ``j <= i`` when
+    causal, ``i - j < window`` (and ``j - i < window`` when bidirectional)
+    under a window. ``q_pos``/``k_pos`` broadcast; returns None when
+    everything is valid."""
+    if not causal and window is None:
+        return None
+    valid = None
+    if causal:
+        valid = q_pos >= k_pos
+    if window is not None:
+        band = q_pos - k_pos < window          # lower edge of the band
+        if not causal:
+            band &= k_pos - q_pos < window     # symmetric upper edge
+        valid = band if valid is None else (valid & band)
+    return valid
+
+
+def _band_valid(iq, kt, *, block_q, block_k, causal, window):
+    """[bq, bk] tile of :func:`band_predicate` for q tile ``iq`` × k tile
+    ``kt`` (None when everything is valid)."""
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = kt * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return band_predicate(q_pos, k_pos, causal, window)
+
+
+def _num_band_tiles(n_tiles, span, block):
+    """Static size of the restricted grid axis: max tiles of width ``block``
+    an arbitrarily aligned index range of length ``span`` can touch."""
+    return min(n_tiles, (span - 2) // block + 2)
+
+
+def _restricted_k_axis(nk, bq, bk, causal, window):
+    """(nkt, k_tile(iq, j)) for the forward/dq grids: the static size of the
+    k axis and the index map from (q tile, band step) → real k tile. With no
+    window the axis is the full nk and the map is the identity on j; with a
+    window only the tiles the band can touch are visited (and DMA'd), so
+    compute and bandwidth are O(L·window) — clamped duplicate tiles at the
+    sequence end are guarded off in-kernel by ``kt <= last_k``."""
+    if window is None:
+        return nk, (lambda i, j: j)
+    span = bq + window - 1 if causal else bq + 2 * window - 2
+
+    def k_tile(i, j):
+        fk = _first_k_tile(i, block_q=bq, block_k=bk, window=window)
+        return jnp.minimum(fk + j, nk - 1)
+
+    return _num_band_tiles(nk, span, bk), k_tile
+
+
+def _restricted_q_axis(nq, bq, bk, causal, window):
+    """(nqt, q_tile(jk, i)) for the dkv grid — the transposed mirror of
+    :func:`_restricted_k_axis`."""
+    if window is None:
+        return nq, (lambda j, i: i)
+    span = bk + window - 1 if causal else bk + 2 * window - 2
+
+    def q_tile(j, i):
+        fq = _first_q_tile(j, block_q=bq, block_k=bk, causal=causal,
+                           window=window)
+        return jnp.minimum(fq + i, nq - 1)
+
+    return _num_band_tiles(nq, span, bq), q_tile
+
+
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc, *,
-               scale, causal, block_q, block_k, km_ref=None):
+               scale, causal, block_q, block_k, window=None, nk=None,
+               km_ref=None):
     """One (bh, iq, jk) step: fold a [bq, bk] score tile into the online
-    softmax state; finalize on this q block's last contributing k step."""
+    softmax state; finalize on this q block's last contributing k step.
+
+    With ``window`` set the grid's k axis is restricted to the band (the
+    BlockSpec index map only loads in-band tiles), so ``jk`` counts tiles
+    from the band start: the real k tile is ``first_k + jk``."""
     iq = pl.program_id(1)
     jk = pl.program_id(2)
-    nk = pl.num_programs(2)
+    if nk is None:
+        nk = pl.num_programs(2)
 
     @pl.when(jk == 0)
     def _():
@@ -64,15 +163,15 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc, *,
         l_s[:] = jnp.zeros_like(l_s)
         acc[:] = jnp.zeros_like(acc)
 
-    # under causal masking, k tiles entirely above the diagonal contribute
-    # nothing — skip their MXU work (≈2× at long causal context) and
-    # finalize at the last tile that can contribute
-    if causal:
-        last_k = jnp.minimum(nk - 1, (iq * block_q + block_q - 1) // block_k)
-    else:
-        last_k = nk - 1
+    # under causal/window masking, k tiles outside the band contribute
+    # nothing — the restricted grid never visits tiles below the band, and
+    # the guards below skip tiles past its end (≈2× at long causal context)
+    kt = _first_k_tile(iq, block_q=block_q, block_k=block_k,
+                       window=window) + jk
+    last_k = _last_k_tile(iq, nk, block_q=block_q, block_k=block_k,
+                          causal=causal, window=window)
 
-    @pl.when(jk <= last_k)
+    @pl.when(kt <= last_k)
     def _():
         q = q_ref[0].astype(jnp.float32) * scale        # [bq, D]
         k = k_ref[0].astype(jnp.float32)                # [bk, D]
@@ -81,15 +180,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc, *,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                                # [bq, bk]
-        valid = None
-        if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = jk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            valid = q_pos >= k_pos
+        valid = _band_valid(iq, kt, block_q=block_q, block_k=block_k,
+                            causal=causal, window=window)
         if km_ref is not None:
             km = km_ref[0].astype(jnp.float32) > 0.5     # [1, bk]
             km = jnp.broadcast_to(km, s.shape)
@@ -110,7 +202,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc, *,
         )
         m_s[:] = m_new
 
-    @pl.when(jk == last_k)
+    @pl.when(kt == last_k)
     def _():
         l = jnp.maximum(l_s[:], 1e-30)
         o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
@@ -140,7 +232,8 @@ def _pick_block_k(L):
     return next(c for c in (BLOCK_K, 384, 256, 128) if L % c == 0)
 
 
-def _fa_forward(q, k, v, key_mask, *, scale, causal, interpret):
+def _fa_forward(q, k, v, key_mask, *, scale, causal, interpret,
+                window=None):
     """q/k/v [B, L, H, D] (+ key_mask [B, L]) → (out [B, L, H, D], lse)."""
     B, L, H, D = q.shape
     if L % BLOCK_Q:
@@ -153,9 +246,11 @@ def _fa_forward(q, k, v, key_mask, *, scale, causal, interpret):
     def bh(x):  # [B, L, H, D] → [B·H, L, D]
         return jnp.moveaxis(x, 2, 1).reshape(B * H, L, D)
 
-    grid = (B * H, L // bq, L // bk)
+    nk = L // bk
+    nkt, k_tile = _restricted_k_axis(nk, bq, bk, causal, window)
+    grid = (B * H, L // bq, nkt)
     qspec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
-    kvspec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
+    kvspec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, k_tile(i, j), 0))
     ospec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
     # lse carries a trailing singleton so its block obeys the (8, 128)
     # tile rule (last dim equal to the array dim is allowed)
@@ -174,12 +269,14 @@ def _fa_forward(q, k, v, key_mask, *, scale, causal, interpret):
     if key_mask is None:
         kernel = functools.partial(
             _fa_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+            window=window, nk=nk,
         )
     else:
         H_ = H
         # mask ships as [B, 1, L] so its block obeys the (8, 128) tile rule
         in_specs.append(
-            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // H_, 0, j))
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // H_, 0,
+                                                      k_tile(i, j)))
         )
         args.append(key_mask.astype(jnp.float32)[:, None, :])
 
@@ -187,7 +284,7 @@ def _fa_forward(q, k, v, key_mask, *, scale, causal, interpret):
                    m_s, l_s, acc):
             _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc,
                        scale=scale, causal=causal, block_q=bq, block_k=bk,
-                       km_ref=km_ref)
+                       window=window, nk=nk, km_ref=km_ref)
 
     o, lse = pl.pallas_call(
         kernel, grid=grid,
@@ -202,7 +299,7 @@ def _fa_forward(q, k, v, key_mask, *, scale, causal, interpret):
 
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, *rest,
-                      scale, causal, block_q, block_k):
+                      scale, causal, block_q, block_k, window=None, nk=None):
     """One (bh, iq, jk) step: rebuild the [bq, bk] probability tile from the
     saved lse and fold ``ds @ k`` into the dq accumulator; write on this q
     block's last contributing k step."""
@@ -212,18 +309,19 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, *rest,
         km_ref, (dq_ref, acc) = None, rest
     iq = pl.program_id(1)
     jk = pl.program_id(2)
-    nk = pl.num_programs(2)
+    if nk is None:
+        nk = pl.num_programs(2)
 
     @pl.when(jk == 0)
     def _():
         acc[:] = jnp.zeros_like(acc)
 
-    if causal:
-        last_k = jnp.minimum(nk - 1, (iq * block_q + block_q - 1) // block_k)
-    else:
-        last_k = nk - 1
+    kt = _first_k_tile(iq, block_q=block_q, block_k=block_k,
+                       window=window) + jk
+    last_k = _last_k_tile(iq, nk, block_q=block_q, block_k=block_k,
+                          causal=causal, window=window)
 
-    @pl.when(jk <= last_k)
+    @pl.when(kt <= last_k)
     def _():
         qs = q_ref[0].astype(jnp.float32) * scale       # [bq, D]
         kk = k_ref[0].astype(jnp.float32)               # [bk, D]
@@ -233,15 +331,8 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, *rest,
             qs, kk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                                # [bq, bk]
-        valid = None
-        if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = jk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            valid = q_pos >= k_pos
+        valid = _band_valid(iq, kt, block_q=block_q, block_k=block_k,
+                            causal=causal, window=window)
         if km_ref is not None:
             km = km_ref[0].astype(jnp.float32) > 0.5     # [1, bk]
             km = jnp.broadcast_to(km, s.shape)
@@ -261,16 +352,48 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, *rest,
             preferred_element_type=jnp.float32,
         ) * scale
 
-    @pl.when(jk == last_k)
+    @pl.when(kt == last_k)
     def _():
         dq_ref[0] = acc[:].astype(dq_ref.dtype)
 
 
+def _first_q_tile(jk, *, block_q, block_k, causal, window):
+    """First q tile that can see k tile ``jk``: the causal diagonal and/or
+    the lower edge of the window band (0 when unrestricted)."""
+    if causal:
+        return (jk * block_k) // block_q
+    if window is not None:
+        return jnp.maximum(0, (jk * block_k - window + 1) // block_q)
+    return 0
+
+
+def _last_q_tile(jk, nq, *, block_q, block_k, window):
+    """Last q tile inside k tile ``jk``'s band (``nq - 1`` unwindowed)."""
+    if window is None:
+        return nq - 1
+    return jnp.minimum(
+        nq - 1, (jk * block_k + block_k - 1 + window - 1) // block_q
+    )
+
+
+def _band_valid_t(jk, qt, *, block_q, block_k, causal, window):
+    """Transposed [bk, bq] tile of :func:`band_predicate` for k tile ``jk``
+    × q tile ``qt``."""
+    k_pos = jk * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 0
+    )
+    q_pos = qt * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 1
+    )
+    return band_predicate(q_pos, k_pos, causal, window)
+
+
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, *rest,
-                       scale, causal, block_q, block_k):
+                       scale, causal, block_q, block_k, window=None,
+                       nq=None):
     """One (bh, jk, iq) step: rebuild the transposed [bk, bq] probability
     tile and fold ``pᵀ @ dO`` / ``dsᵀ @ q`` into the dv/dk accumulators;
-    write on the last q step (the last q block always contributes)."""
+    write on this k block's last contributing q step."""
     if len(rest) == 5:
         km_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
     else:
@@ -278,20 +401,27 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, *rest,
         dk_ref, dv_ref, dk_acc, dv_acc = rest
     jk = pl.program_id(1)
     iq = pl.program_id(2)
-    nq = pl.num_programs(2)
+    if nq is None:
+        nq = pl.num_programs(2)
 
     @pl.when(iq == 0)
     def _():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    if causal:
-        # q blocks strictly above this k block's diagonal see nothing
-        first_q = (jk * block_k) // block_q
+    first_q = _first_q_tile(jk, block_q=block_q, block_k=block_k,
+                            causal=causal, window=window)
+    if window is None:
+        # full grid: iq is the real q tile, skip those before the band
+        qt = iq
+        last_q = nq - 1
     else:
-        first_q = 0
+        # restricted grid: iq counts tiles from the band start
+        qt = first_q + iq
+        last_q = _last_q_tile(jk, nq, block_q=block_q, block_k=block_k,
+                              window=window)
 
-    @pl.when(iq >= first_q)
+    @pl.when((qt >= first_q) & (qt <= last_q))
     def _():
         qs = q_ref[0].astype(jnp.float32) * scale       # [bq, D]
         kk = k_ref[0].astype(jnp.float32)               # [bk, D]
@@ -301,15 +431,8 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, *rest,
             kk, qs, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                                # [bk, bq]
-        valid = None
-        if causal:
-            k_pos = jk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_k, block_q), 0
-            )
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_k, block_q), 1
-            )
-            valid = q_pos >= k_pos
+        valid = _band_valid_t(jk, qt, block_q=block_q, block_k=block_k,
+                              causal=causal, window=window)
         if km_ref is not None:
             km = km_ref[0].astype(jnp.float32) > 0.5     # [bk, 1]
             km = jnp.broadcast_to(km, st.shape)
@@ -333,14 +456,14 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, *rest,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(iq == nq - 1)
+    @pl.when(qt == last_q)
     def _():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _fa_backward(q, k, v, key_mask, out, lse, g, *, scale, causal,
-                 interpret):
+                 interpret, window=None):
     """Blockwise flash-attention backward: (dq, dk, dv) via two Pallas
     kernels, ``O(block_q · block_k)`` on-chip — no [B, H, L, L] tensors."""
     B, L, H, D = q.shape
@@ -357,22 +480,28 @@ def _fa_backward(q, k, v, key_mask, out, lse, g, *, scale, causal,
     lse_col, d_col = lse[..., None], delta[..., None]      # [B·H, L, 1]
     lse_row, d_row = lse[:, None, :], delta[:, None, :]    # [B·H, 1, L]
     H_ = H
+    nk, nq = L // bk, L // bq
+    # same restricted band axes as the forward (one shared builder, so the
+    # forward and backward grids cannot drift apart)
+    nkt, k_tile = _restricted_k_axis(nk, bq, bk, causal, window)
+    nqt, q_tile = _restricted_q_axis(nq, bq, bk, causal, window)
 
     qspec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
-    kvspec_q = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
+    kvspec_q = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, k_tile(i, j), 0))
     colspec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
 
     dq_specs = [qspec, kvspec_q, kvspec_q, qspec, colspec, colspec]
     dq_args = [qb, kb, vb, gb, lse_col, d_col]
     if key_mask is not None:
         dq_specs.append(
-            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // H_, 0, j))
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // H_, 0,
+                                                      k_tile(i, j)))
         )
         dq_args.append(key_mask.astype(jnp.float32)[:, None, :])
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
-        grid=(B * H, L // bq, L // bk),
+                          block_q=bq, block_k=bk, window=window, nk=nk),
+        grid=(B * H, nq, nkt),
         in_specs=dq_specs,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
@@ -382,8 +511,8 @@ def _fa_backward(q, k, v, key_mask, out, lse, g, *, scale, causal,
 
     # dk/dv: k blocks on the parallel axis, q innermost
     kvspec = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
-    qspec2 = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
-    rowspec = pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i))
+    qspec2 = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, q_tile(j, i), 0))
+    rowspec = pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, q_tile(j, i)))
     dkv_specs = [qspec2, kvspec, kvspec, qspec2, rowspec, rowspec]
     dkv_args = [qb, kb, vb, gb, lse_row, d_row]
     if key_mask is not None:
@@ -393,8 +522,8 @@ def _fa_backward(q, k, v, key_mask, out, lse, g, *, scale, causal,
         dkv_args.append(key_mask.astype(jnp.float32)[..., None])
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
-        grid=(B * H, L // bk, L // bq),
+                          block_q=bq, block_k=bk, window=window, nq=nq),
+        grid=(B * H, nk, nqt),
         in_specs=dkv_specs,
         out_specs=[kvspec, kvspec],
         out_shape=[jax.ShapeDtypeStruct((B * H, L, D), k.dtype),
@@ -410,16 +539,17 @@ def _fa_backward(q, k, v, key_mask, out, lse, g, *, scale, causal,
     return unbh(dq), unbh(dk), unbh(dv)
 
 
-def _attention_bwd_math(q, k, v, key_mask, lse, g, *, scale, causal):
+def _attention_bwd_math(q, k, v, key_mask, lse, g, *, scale, causal,
+                        window=None):
     """Recompute-based backward (plain XLA): p from saved lse, then the
     standard flash-attention gradient identities."""
     B, L, H, D = q.shape
     qf = q.astype(jnp.float32) * scale
     s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
-    valid = None
-    if causal:
-        tri = jnp.tril(jnp.ones((L, L), bool))
-        valid = jnp.broadcast_to(tri[None, None], s.shape)
+    band = band_predicate(jnp.arange(L)[:, None], jnp.arange(L)[None, :],
+                          causal, window)
+    valid = (None if band is None
+             else jnp.broadcast_to(band[None, None], s.shape))
     if key_mask is not None:
         km = key_mask.astype(bool)[:, None, None, :]
         valid = km if valid is None else (valid & km)
@@ -441,27 +571,29 @@ def _attention_bwd_math(q, k, v, key_mask, lse, g, *, scale, causal):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_core(q, k, v, key_mask, causal, scale, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_core(q, k, v, key_mask, causal, scale, interpret, window):
     out, _ = _fa_forward(
-        q, k, v, key_mask, scale=scale, causal=causal, interpret=interpret
+        q, k, v, key_mask, scale=scale, causal=causal, interpret=interpret,
+        window=window,
     )
     return out
 
 
-def _fa_fwd(q, k, v, key_mask, causal, scale, interpret):
+def _fa_fwd(q, k, v, key_mask, causal, scale, interpret, window):
     out, lse = _fa_forward(
-        q, k, v, key_mask, scale=scale, causal=causal, interpret=interpret
+        q, k, v, key_mask, scale=scale, causal=causal, interpret=interpret,
+        window=window,
     )
     # saving `out` adds no memory under jit: it aliases the primal output
     return out, (q, k, v, key_mask, out, lse)
 
 
-def _fa_bwd(causal, scale, interpret, res, g):
+def _fa_bwd(causal, scale, interpret, window, res, g):
     q, k, v, key_mask, out, lse = res
     dq, dk, dv = _fa_backward(
         q, k, v, key_mask, out, lse, g,
-        scale=scale, causal=causal, interpret=interpret,
+        scale=scale, causal=causal, interpret=interpret, window=window,
     )
     dmask = None if key_mask is None else jnp.zeros_like(key_mask)
     return dq, dk, dv, dmask
@@ -470,23 +602,37 @@ def _fa_bwd(causal, scale, interpret, res, g):
 _flash_core.defvjp(_fa_fwd, _fa_bwd)
 
 
+def _canonical_window(window, L):
+    """Validate ``window``; a band covering the whole sequence is None."""
+    if window is None:
+        return None
+    window = int(window)
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    return None if window >= L else window
+
+
 def flash_attention(q, k, v, causal: bool = False, scale=None, key_mask=None,
-                    interpret: bool | None = None):
+                    interpret: bool | None = None, window: int | None = None):
     """Pallas flash attention; same contract as ``attention_reference``.
 
     ``q/k/v`` [B, L, H, D] → [B, L, H, D]; optional ``key_mask`` [B, L]
     (1 = attend). Gradients flow to q/k/v (the mask gets zero cotangent, as
-    with the hard mask in the reference).
+    with the hard mask in the reference). ``window`` enables sliding-window
+    (local) attention: query ``i`` sees keys ``(i-window, i]`` when causal,
+    ``|i-j| < window`` otherwise; the kernel grid only visits in-band tiles,
+    so compute AND k/v DMA scale as O(L·window).
     """
     return _flash_core(
         q, k, v, key_mask, bool(causal),
         float(scale if scale is not None else q.shape[-1] ** -0.5),
         _interpret_default() if interpret is None else bool(interpret),
+        _canonical_window(window, q.shape[1]),
     )
 
 
 def attention(q, k, v, causal: bool = False, scale=None, key_mask=None,
-              impl: str = "auto"):
+              impl: str = "auto", window: int | None = None):
     """Dispatch between the Pallas kernel and the XLA reference.
 
     ``impl``: ``"flash"`` forces the kernel (requires ``L % 128 == 0``),
@@ -494,6 +640,8 @@ def attention(q, k, v, causal: bool = False, scale=None, key_mask=None,
     running natively on TPU AND the shapes are tile-friendly — interpret
     mode off-TPU is for testing, not speed. ``key_mask`` is treated as a
     static-presence argument (its values are traced, its presence is not).
+    ``window``: sliding-window (local) attention span — see
+    :func:`flash_attention`.
     """
     from distkeras_tpu.parallel.sequence import attention_reference
 
@@ -508,5 +656,5 @@ def attention(q, k, v, causal: bool = False, scale=None, key_mask=None,
         and (L % BLOCK_Q or jax.default_backend() != "tpu")
     ):
         return attention_reference(q, k, v, causal=causal, scale=scale,
-                                   key_mask=key_mask)
-    return flash_attention(q, k, v, causal, scale, key_mask)
+                                   key_mask=key_mask, window=window)
+    return flash_attention(q, k, v, causal, scale, key_mask, window=window)

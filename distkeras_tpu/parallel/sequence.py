@@ -29,25 +29,38 @@ _NEG = -1e9  # finite "masked" score: keeps the online softmax NaN-free
 
 
 def attention_reference(q, k, v, causal: bool = False, scale=None,
-                        key_mask=None):
+                        key_mask=None, window: int | None = None):
     """Plain single-device softmax attention — the correctness oracle.
 
     Shapes: q/k/v ``[B, L, H, D]`` → ``[B, L, H, D]``. ``key_mask`` is an
-    optional ``[B, Lk]`` validity mask (1 = attend, 0 = ignore, e.g. padding).
+    optional ``[B, Lk]`` validity mask (1 = attend, 0 = ignore, e.g.
+    padding). ``window`` restricts attention to a sliding local band:
+    query ``i`` sees keys ``(i-window, i]`` when causal, ``|i-j| < window``
+    otherwise (same contract as ``ops.flash_attention``).
     """
+    from distkeras_tpu.ops.flash_attention import band_predicate
+
+    if window is not None and int(window) < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        Lq, Lk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((Lq, Lk), bool))
-        s = jnp.where(mask, s, _NEG)
+    Lq, Lk = s.shape[-2], s.shape[-1]
+    # one shared band predicate with the flash kernels — the oracle and the
+    # kernel cannot drift apart on window semantics
+    band = band_predicate(jnp.arange(Lq)[:, None], jnp.arange(Lk)[None, :],
+                          causal, window)
+    if band is not None:
+        s = jnp.where(band, s, _NEG)
     if key_mask is not None:
         valid = key_mask[:, None, None, :].astype(bool)
+        if band is not None:
+            valid = valid & band[None, None]
         s = jnp.where(valid, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     if key_mask is not None:
-        # fully-masked rows yield zeros (same convention as ring_attention),
-        # not the mean of values a softmax over uniform -1e9 would give
+        # rows whose whole band is masked yield zeros (same convention as
+        # ring_attention and the flash kernel), not the mean of values a
+        # softmax over uniform -1e9 would give
         p = p * valid
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
 

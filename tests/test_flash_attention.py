@@ -323,3 +323,143 @@ def test_wide_k_tile_bk_over_bq_path(rng, causal, monkeypatch):
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-3, atol=5e-4)
+
+
+# ---------------------------------------------------------------------------
+# Sliding-window (local) attention
+# ---------------------------------------------------------------------------
+#
+# The windowed comparisons pin matmul precision: this host's XLA:CPU runs
+# f32 dots at reduced precision (~1e-2 abs on L=256 scores), and a windowed
+# softmax has few enough terms that the noise no longer averages out of the
+# normalized output (full-row softmax comparisons above absorb it).
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_windowed_forward_matches_reference(rng, causal):
+    q, k, v = qkv(rng)
+    with jax.default_matmul_precision("highest"):
+        for w in (1, 17, 128, 200):
+            out = flash_attention(q, k, v, causal=causal, window=w)
+            ref = attention_reference(q, k, v, causal=causal, window=w)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-4, atol=2e-5,
+                                       err_msg=f"window={w}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_windowed_gradients_match_reference(rng, causal):
+    q, k, v = qkv(rng)
+    cot = rng.normal(size=(B, L, H, D)).astype(np.float32)
+    with jax.default_matmul_precision("highest"):
+        for w in (17, 200):
+            g = jax.grad(
+                lambda q, k, v: jnp.sum(
+                    flash_attention(q, k, v, causal=causal, window=w) * cot
+                ),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            r = jax.grad(
+                lambda q, k, v: jnp.sum(
+                    attention_reference(q, k, v, causal=causal, window=w)
+                    * cot
+                ),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            for name, gg, rr in zip("qkv", g, r):
+                np.testing.assert_allclose(
+                    np.asarray(gg), np.asarray(rr), rtol=5e-3, atol=5e-4,
+                    err_msg=f"window={w} {name}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_windowed_restricted_grid_multi_tile(rng, causal, monkeypatch):
+    """nk > 1 with a window smaller than the sequence: the kernel's k axis
+    is RESTRICTED (first_k > 0 for late q blocks, index-map clamping at the
+    band edges) — the path the single-tile shapes never reach."""
+    from distkeras_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa, "BLOCK_K", 128)
+    Lw = 512                                  # 4 q blocks × 4 k tiles
+    mk = lambda: rng.normal(0, 1, size=(1, Lw, 2, D)).astype(np.float32)
+    q, k, v = mk(), mk(), mk()
+    cot = rng.normal(size=(1, Lw, 2, D)).astype(np.float32)
+    with jax.default_matmul_precision("highest"):
+        for w in (64, 130):
+            g = jax.grad(
+                lambda q, k, v: jnp.sum(
+                    fa.flash_attention(q, k, v, causal=causal, window=w)
+                    * cot
+                ),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            r = jax.grad(
+                lambda q, k, v: jnp.sum(
+                    attention_reference(q, k, v, causal=causal, window=w)
+                    * cot
+                ),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            for name, gg, rr in zip("qkv", g, r):
+                np.testing.assert_allclose(
+                    np.asarray(gg), np.asarray(rr), rtol=5e-3, atol=5e-4,
+                    err_msg=f"window={w} {name}")
+
+
+def test_windowed_with_key_mask_band_fully_masked(rng):
+    """Queries whose whole BAND is key-masked must yield zeros and finite
+    zero gradients in both the kernel and the reference (the reference's
+    zeroing convention combines the band with the key mask)."""
+    q, k, v = qkv(rng)
+    mask = np.ones((B, L), np.float32)
+    mask[:, L - 100:] = 0.0                    # last 100 keys invalid
+    w = 40                                     # queries >= L-61 see nothing
+    cot = rng.normal(size=(B, L, H, D)).astype(np.float32)
+    with jax.default_matmul_precision("highest"):
+        out = flash_attention(q, k, v, key_mask=mask, window=w)
+        ref = attention_reference(q, k, v, key_mask=mask, window=w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+        dead = np.asarray(out)[:, L - 61:]
+        np.testing.assert_allclose(dead, np.zeros_like(dead), atol=1e-6)
+        g = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, key_mask=mask, window=w) * cot
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        r = jax.grad(
+            lambda q, k, v: jnp.sum(
+                attention_reference(q, k, v, key_mask=mask, window=w) * cot
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for name, gg, rr in zip("qkv", g, r):
+            assert np.isfinite(np.asarray(gg)).all(), name
+            np.testing.assert_allclose(np.asarray(gg), np.asarray(rr),
+                                       rtol=5e-3, atol=5e-4, err_msg=name)
+
+
+def test_window_validation_and_degenerate(rng):
+    q, k, v = qkv(rng)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, window=0)
+    with pytest.raises(ValueError, match="window"):
+        attention_reference(q, k, v, window=-3)
+    # window >= L is exactly the unwindowed program
+    a = flash_attention(q, k, v, causal=True, window=L + 7)
+    b = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+
+
+def test_attention_dispatch_passes_window(rng):
+    q, k, v = qkv(rng)
+    with jax.default_matmul_precision("highest"):
+        out = attention(q, k, v, causal=True, window=50, impl="flash")
+        ref = attention_reference(q, k, v, causal=True, window=50)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+        # reference dispatch honors it too
+        out = attention(q, k, v, causal=True, window=50, impl="reference")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=0, atol=0)
