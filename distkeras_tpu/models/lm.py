@@ -34,6 +34,29 @@ from distkeras_tpu.models.transformer import sincos_positions
 from distkeras_tpu.parallel.sequence import attention_reference
 
 
+def rope_angles(maxlen: int, head_dim: int, base: float = 10000.0):
+    """Rotary position-embedding angle table ``[maxlen, head_dim // 2]``
+    (Su et al. 2021): position ``p`` rotates feature pair ``i`` by
+    ``p · base^(-2i/head_dim)``."""
+    inv = base ** (-np.arange(0, head_dim, 2) / head_dim)
+    return (np.arange(maxlen)[:, None] * inv[None, :]).astype(np.float32)
+
+
+def apply_rope(x, angles):
+    """Rotate feature pairs of ``x`` [..., L, H, Dh] by per-position
+    ``angles`` [L, Dh//2] (pairing (x[2i], x[2i+1]), rotation in f32, cast
+    back to x.dtype)."""
+    f32 = x.astype(jnp.float32)
+    x1, x2 = f32[..., 0::2], f32[..., 1::2]
+    # angles broadcast over batch and heads: [L, Dh/2] → [L, 1, Dh/2]
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+    r1 = x1 * cos - x2 * sin
+    r2 = x1 * sin + x2 * cos
+    out = jnp.stack([r1, r2], axis=-1).reshape(f32.shape)
+    return out.astype(x.dtype)
+
+
 class DecoderBlock(nn.Module):
     """Pre-norm causal block with three entry points sharing one parameter
     set: ``__call__`` (training / full forward), ``prefill`` (full forward
@@ -46,23 +69,71 @@ class DecoderBlock(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     attn_impl: str = "reference"
     attn_window: int | None = None  # sliding-window (local) attention span
+    #: grouped-query attention: number of shared K/V heads (None = heads,
+    #: i.e. standard MHA; 1 = MQA). Query head h reads K/V head h // group.
+    #: The KV cache shrinks heads/kv_heads ×, the decode win GQA exists for.
+    kv_heads: int | None = None
+    #: rotary position embeddings: rotate q/k at projection time (the cache
+    #: stores PRE-ROTATED keys); ``maxlen`` bounds the decode angle table
+    rope: bool = False
+    maxlen: int = 0
+
+    @property
+    def _hkv(self) -> int:
+        return self.kv_heads if self.kv_heads is not None else self.heads
+
+    def _rope_qk(self, q, k, pos):
+        """Rotate q and k for RoPE. ``pos`` is the first position the inputs
+        occupy: 0 with a static length-L forward, a traced scalar with the
+        single-position decode step."""
+        if not self.rope:
+            return q, k
+        dh = self.dim // self.heads
+        L = q.shape[1]
+        if isinstance(pos, int) and pos == 0:
+            angles = jnp.asarray(rope_angles(L, dh))
+        else:
+            table = jnp.asarray(rope_angles(self.maxlen, dh))
+            angles = jax.lax.dynamic_slice(table, (pos, 0), (L, dh // 2))
+        return apply_rope(q, angles), apply_rope(k, angles)
 
     def setup(self):
+        if self.rope and self.maxlen < 1:
+            raise ValueError(
+                "DecoderBlock(rope=True) needs maxlen >= 1 for the decode "
+                "angle table (TransformerLM passes its own maxlen)"
+            )
         f32 = jnp.float32
+        dh = self.dim // self.heads
         self.ln_attn = nn.LayerNorm(dtype=f32)
-        self.qkv = nn.Dense(3 * self.dim, dtype=self.dtype)
+        # one fused projection, width (H + 2·Hkv)·Dh; splitting at H·Dh /
+        # (H+Hkv)·Dh reduces to the classic thirds split when Hkv == H, so
+        # MHA checkpoints/params are unchanged by the GQA seam
+        self.qkv = nn.Dense((self.heads + 2 * self._hkv) * dh,
+                            dtype=self.dtype)
         self.attn_out = nn.Dense(self.dim, dtype=self.dtype)
         self.ln_mlp = nn.LayerNorm(dtype=f32)
         self.mlp_up = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype)
         self.mlp_down = nn.Dense(self.dim, dtype=self.dtype)
 
     def _project_qkv(self, x):
+        """→ q [B, L, H, Dh], k/v [B, L, Hkv, Dh]."""
         B, L, _ = x.shape
+        dh = self.dim // self.heads
+        hkv = self._hkv
         h = self.ln_attn(x)
         qkv = self.qkv(h.astype(self.dtype))
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (B, L, self.heads, self.dim // self.heads)
-        return tuple(t.reshape(shape) for t in (q, k, v))
+        q = qkv[..., : self.heads * dh].reshape(B, L, self.heads, dh)
+        k = qkv[..., self.heads * dh: (self.heads + hkv) * dh]
+        v = qkv[..., (self.heads + hkv) * dh:]
+        return q, k.reshape(B, L, hkv, dh), v.reshape(B, L, hkv, dh)
+
+    def _expand_kv(self, t):
+        """[B, L, Hkv, Dh] → [B, L, H, Dh]: query head h uses kv head
+        h // group (jnp.repeat matches the [Hkv, group] reshape used by the
+        grouped decode einsum)."""
+        group = self.heads // self._hkv
+        return t if group == 1 else jnp.repeat(t, group, axis=2)
 
     def _mlp(self, x):
         h = self.ln_mlp(x)
@@ -74,8 +145,10 @@ class DecoderBlock(nn.Module):
     def _attn_full(self, x, mask):
         B, L, _ = x.shape
         q, k, v = self._project_qkv(x)
+        q, k = self._rope_qk(q, k, 0)   # k rotated BEFORE caching/expand
+        kf, vf = self._expand_kv(k), self._expand_kv(v)
         if self.attn_impl == "reference":
-            att = attention_reference(q, k, v, causal=True, key_mask=mask,
+            att = attention_reference(q, kf, vf, causal=True, key_mask=mask,
                                       window=self.attn_window)
         else:
             from distkeras_tpu.ops.flash_attention import attention
@@ -85,7 +158,7 @@ class DecoderBlock(nn.Module):
             # that aren't tile multiples; training shapes (maxlen-derived)
             # stay tile-friendly and keep the kernel
             impl = "auto" if self.attn_impl == "flash" else self.attn_impl
-            att = attention(q, k, v, causal=True, key_mask=mask,
+            att = attention(q, kf, vf, causal=True, key_mask=mask,
                             impl=impl, window=self.attn_window)
         att = att.reshape(B, L, self.dim)
         x = x + self.attn_out(att.astype(self.dtype)).astype(jnp.float32)
@@ -101,31 +174,38 @@ class DecoderBlock(nn.Module):
 
     def step(self, x_t, k_cache, v_cache, pos):
         """One decode position. ``x_t``: [B, 1, dim] residual stream;
-        ``k_cache``/``v_cache``: [B, maxlen, H, Dh] holding positions
+        ``k_cache``/``v_cache``: [B, maxlen, Hkv, Dh] holding positions
         ``< pos``; ``pos`` may be a traced scalar."""
-        q, k, v = self._project_qkv(x_t)  # each [B, 1, H, Dh]
+        q, k, v = self._project_qkv(x_t)  # q [B,1,H,Dh]; k/v [B,1,Hkv,Dh]
+        q, k = self._rope_qk(q, k, pos)   # cache holds pre-rotated keys
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0)
         )
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0)
         )
+        B = x_t.shape[0]
         dh = self.dim // self.heads
+        hkv = self._hkv
+        group = self.heads // hkv
         # same dtype path as attention_reference (parallel/sequence.py:39-52)
         # so cached decode is bit-compatible with the full forward in bf16:
-        # q·k in model dtype, softmax in f32, p·v back in model dtype
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32) \
-            * (dh ** -0.5)
+        # q·k in model dtype, softmax in f32, p·v back in model dtype.
+        # GQA: the [H] head axis factors as [Hkv, group] (group-major match
+        # with _expand_kv's jnp.repeat); the cache stays Hkv-wide.
+        qg = q.reshape(B, 1, hkv, group, dh)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_cache) \
+            .astype(jnp.float32) * (dh ** -0.5)
         kp = jnp.arange(k_cache.shape[1])
         valid = kp <= pos                            # causal: cache ≤ pos
         if self.attn_window is not None:
             valid &= pos - kp < self.attn_window     # sliding-window band
-        s = jnp.where(valid[None, None, None, :], s, -1e30)
+        s = jnp.where(valid[None, None, None, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         att = jnp.einsum(
-            "bhqk,bkhd->bqhd", p.astype(v_cache.dtype), v_cache
+            "bhgqk,bkhd->bqhgd", p.astype(v_cache.dtype), v_cache
         )
-        att = att.reshape(x_t.shape[0], 1, self.dim)
+        att = att.reshape(B, 1, self.dim)
         x_t = x_t + self.attn_out(att.astype(self.dtype)).astype(jnp.float32)
         return self._mlp(x_t), k_cache, v_cache
 
@@ -142,13 +222,31 @@ class TransformerLM(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     attn_impl: str = "reference"
     attn_window: int | None = None  # sliding-window (local) attention span
+    kv_heads: int | None = None     # GQA shared K/V heads (1 = MQA)
+    #: "sincos" (additive table at the embedding, Vaswani et al.) or "rope"
+    #: (rotary q/k rotations in every block, Su et al. — relative positions,
+    #: nothing added to the residual stream)
+    pos_embedding: str = "sincos"
 
     def setup(self):
+        if self.kv_heads is not None and self.heads % self.kv_heads:
+            raise ValueError(
+                f"heads {self.heads} must be a multiple of kv_heads "
+                f"{self.kv_heads}"
+            )
+        if self.pos_embedding not in ("sincos", "rope"):
+            raise ValueError(
+                f"unknown pos_embedding {self.pos_embedding!r}; use "
+                f"'sincos' or 'rope'"
+            )
         self.embed = nn.Embed(self.vocab, self.dim, dtype=self.dtype)
         self.blocks = [
             DecoderBlock(dim=self.dim, heads=self.heads, dtype=self.dtype,
                          attn_impl=self.attn_impl,
-                         attn_window=self.attn_window)
+                         attn_window=self.attn_window,
+                         kv_heads=self.kv_heads,
+                         rope=self.pos_embedding == "rope",
+                         maxlen=self.maxlen)
             for _ in range(self.depth)
         ]
         self.ln_head = nn.LayerNorm(dtype=jnp.float32)
@@ -157,6 +255,8 @@ class TransformerLM(nn.Module):
     def _embed_at(self, tokens, pos0: int | jax.Array = 0):
         """Embed ``tokens`` occupying positions ``pos0 .. pos0+L``."""
         x = self.embed(tokens).astype(jnp.float32)
+        if self.pos_embedding == "rope":
+            return x  # positions enter through the per-block q/k rotations
         table = jnp.asarray(sincos_positions(self.maxlen, self.dim))
         pos = jax.lax.dynamic_slice(
             table, (pos0, 0), (tokens.shape[1], self.dim)
@@ -178,11 +278,12 @@ class TransformerLM(nn.Module):
         per-block maxlen-size K/V buffers holding positions ``< L``."""
         B, L = tokens.shape
         dh = self.dim // self.heads
+        hkv = self.kv_heads if self.kv_heads is not None else self.heads
         x = self._embed_at(tokens)
         caches = []
         for blk in self.blocks:
-            x, k, v = blk.prefill(x, None)
-            kc = jnp.zeros((B, self.maxlen, self.heads, dh), self.dtype)
+            x, k, v = blk.prefill(x, None)   # k/v hold Hkv heads under GQA
+            kc = jnp.zeros((B, self.maxlen, hkv, dh), self.dtype)
             vc = jnp.zeros_like(kc)
             kc = jax.lax.dynamic_update_slice(
                 kc, k.astype(self.dtype), (0, 0, 0, 0)
@@ -297,16 +398,22 @@ def generate(model, params, prompt, max_new_tokens: int, *,
 
 def transformer_lm(vocab=1024, maxlen=256, dim=128, heads=4, depth=2,
                    dtype=jnp.bfloat16, attn_impl="reference",
-                   attn_window=None) -> ModelSpec:
+                   attn_window=None, kv_heads=None,
+                   pos_embedding="sincos") -> ModelSpec:
     """Causal-LM ModelSpec. Train with ``loss="sparse_softmax_cross_entropy"``
     on ``features=tokens [B, L]`` / ``label=tokens shifted left [B, L]``
     (see :func:`next_token_dataset`); decode with :func:`generate`.
     ``attn_window`` enables Mistral-style sliding-window attention (training
     compute O(L·window) on the flash path; decode masks the cache to the
-    window band)."""
+    window band). ``kv_heads`` enables grouped-query attention (``1`` =
+    multi-query): query head ``h`` reads shared K/V head ``h // group``, and
+    the decode KV cache shrinks ``heads / kv_heads`` ×. ``pos_embedding``:
+    "sincos" (additive, the default) or "rope" (rotary q/k rotations —
+    relative positions; composes with GQA and sliding windows)."""
     module = TransformerLM(
         vocab=vocab, maxlen=maxlen, dim=dim, heads=heads, depth=depth,
         dtype=dtype, attn_impl=attn_impl, attn_window=attn_window,
+        kv_heads=kv_heads, pos_embedding=pos_embedding,
     )
     example = jnp.zeros((1, maxlen), jnp.int32)
     return from_flax(module, example, name="transformer_lm")

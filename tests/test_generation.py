@@ -269,3 +269,167 @@ def test_flash_lm_accepts_ragged_prompt():
     prompt = np.arange(10, dtype=np.int32)[None].repeat(2, axis=0)
     out = generate(spec, params, prompt, max_new_tokens=4)
     assert out.shape == (2, 14)
+
+
+def test_gqa_kv_heads_equal_heads_is_mha():
+    """kv_heads == heads is EXACTLY the MHA model: same parameter tree,
+    same logits (the fused qkv split reduces to thirds)."""
+    spec_mha = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM,
+                              heads=HEADS, depth=DEPTH, dtype=jnp.float32)
+    spec_gqa = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM,
+                              heads=HEADS, depth=DEPTH, dtype=jnp.float32,
+                              kv_heads=HEADS)
+    params, _ = spec_mha.init_np(0)
+    pg, _ = spec_gqa.init_np(0)
+    assert jax.tree.structure(params) == jax.tree.structure(pg)
+    toks = np.arange(8, dtype=np.int32)[None].repeat(2, axis=0)
+    a = spec_mha.module.apply({"params": params}, toks)
+    b = spec_gqa.module.apply({"params": params}, toks)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_gqa_decode_matches_full_forward():
+    """GQA (2 kv heads under 4 query heads): prefill + cached decode against
+    the Hkv-wide cache equals the full grouped forward at every position."""
+    spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS,
+                          depth=DEPTH, dtype=jnp.float32, kv_heads=2)
+    params, _ = spec.init_np(0)
+    module = spec.module
+    rng = np.random.default_rng(3)
+    toks = rng.integers(0, VOCAB, size=(2, 12)).astype(np.int32)
+
+    lp = 4
+    logits_pre, caches = module.apply(
+        {"params": params}, toks[:, :lp], method=TransformerLM.prefill
+    )
+    kc, vc = caches[0]
+    assert kc.shape == (2, MAXLEN, 2, DIM // HEADS)  # Hkv-wide cache
+    full = module.apply({"params": params}, toks[:, :lp])
+    np.testing.assert_allclose(np.asarray(logits_pre), np.asarray(full),
+                               rtol=2e-4, atol=2e-4)
+    for pos in range(lp, toks.shape[1]):
+        step_logits, caches = module.apply(
+            {"params": params}, toks[:, pos], caches, pos,
+            method=TransformerLM.decode_step,
+        )
+        full = module.apply({"params": params}, toks[:, : pos + 1])
+        np.testing.assert_allclose(
+            np.asarray(step_logits), np.asarray(full[:, -1]),
+            rtol=2e-4, atol=2e-4, err_msg=f"pos={pos}",
+        )
+
+
+def test_mqa_trains_and_generates():
+    """MQA (kv_heads=1) end to end: the LM learns a deterministic next-token
+    rule through the trainer API and continues it at decode time."""
+    import jax.numpy as jnp2
+
+    from distkeras_tpu.trainers import ADAG
+
+    rng = np.random.default_rng(0)
+    V, Lp1 = 32, 17
+    start = rng.integers(0, V, size=(512, 1))
+    rows = (start + np.arange(Lp1)) % V
+    spec = transformer_lm(vocab=V, maxlen=64, dim=32, heads=4, depth=1,
+                          dtype=jnp2.float32, kv_heads=1)
+    ds = next_token_dataset(rows.astype(np.int32))
+    t = ADAG(spec, loss="sparse_softmax_cross_entropy",
+             worker_optimizer="adam", learning_rate=5e-3, batch_size=64,
+             communication_window=2, num_epoch=6, num_workers=2,
+             label_col="label")
+    params = t.train(ds)
+    losses = t.get_history().losses()
+    assert losses[-1] < losses[0] / 3
+    out = generate(spec, params, rows[:4, :6].astype(np.int32),
+                   max_new_tokens=8)
+    expect = (rows[:4, :1] + np.arange(14)) % V
+    assert (out == expect).mean() > 0.8
+
+
+def test_gqa_validates_head_divisibility():
+    spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=4,
+                          depth=1, dtype=jnp.float32, kv_heads=3)
+    with pytest.raises(ValueError, match="multiple of kv_heads"):
+        spec.init_np(0)
+
+
+def test_rope_decode_matches_full_forward():
+    """RoPE LM: prefill + cached decode (cache holds pre-rotated keys)
+    equals the full rotary forward at every position."""
+    spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS,
+                          depth=DEPTH, dtype=jnp.float32,
+                          pos_embedding="rope")
+    params, _ = spec.init_np(0)
+    module = spec.module
+    rng = np.random.default_rng(4)
+    toks = rng.integers(0, VOCAB, size=(2, 12)).astype(np.int32)
+    lp = 4
+    logits_pre, caches = module.apply(
+        {"params": params}, toks[:, :lp], method=TransformerLM.prefill
+    )
+    full = module.apply({"params": params}, toks[:, :lp])
+    np.testing.assert_allclose(np.asarray(logits_pre), np.asarray(full),
+                               rtol=2e-4, atol=2e-4)
+    for pos in range(lp, toks.shape[1]):
+        step_logits, caches = module.apply(
+            {"params": params}, toks[:, pos], caches, pos,
+            method=TransformerLM.decode_step,
+        )
+        full = module.apply({"params": params}, toks[:, : pos + 1])
+        np.testing.assert_allclose(
+            np.asarray(step_logits), np.asarray(full[:, -1]),
+            rtol=2e-4, atol=2e-4, err_msg=f"pos={pos}",
+        )
+
+
+def test_rope_is_relative():
+    """The defining RoPE property: rotating q and k at positions (p+s, p+s)
+    gives the same attention scores as (p, p) — verify via apply_rope
+    directly: <R(p+s)q, R(k+s)k> == <R(p)q, R(k)k> for aligned shifts."""
+    from distkeras_tpu.models.lm import apply_rope, rope_angles
+
+    rng = np.random.default_rng(5)
+    dh, L, s = 16, 6, 9
+    q = rng.normal(size=(1, L, 1, dh)).astype(np.float32)
+    k = rng.normal(size=(1, L, 1, dh)).astype(np.float32)
+    table = jnp.asarray(rope_angles(64, dh))
+    q0, k0 = apply_rope(q, table[:L]), apply_rope(k, table[:L])
+    qs, ks = apply_rope(q, table[s:s + L]), apply_rope(k, table[s:s + L])
+    s0 = np.einsum("blhd,bmhd->blm", np.asarray(q0), np.asarray(k0))
+    s1 = np.einsum("blhd,bmhd->blm", np.asarray(qs), np.asarray(ks))
+    np.testing.assert_allclose(s1, s0, rtol=1e-4, atol=1e-4)
+
+
+def test_rope_gqa_window_compose_and_train():
+    """The modern-LM combo — RoPE + GQA + sliding window — trains through
+    the trainer API and the cached decode continues the learned rule."""
+    import jax.numpy as jnp2
+
+    from distkeras_tpu.trainers import ADAG
+
+    rng = np.random.default_rng(0)
+    V, Lp1 = 32, 17
+    start = rng.integers(0, V, size=(512, 1))
+    rows = (start + np.arange(Lp1)) % V
+    spec = transformer_lm(vocab=V, maxlen=64, dim=32, heads=4, depth=1,
+                          dtype=jnp2.float32, kv_heads=2, attn_window=8,
+                          pos_embedding="rope")
+    ds = next_token_dataset(rows.astype(np.int32))
+    t = ADAG(spec, loss="sparse_softmax_cross_entropy",
+             worker_optimizer="adam", learning_rate=5e-3, batch_size=64,
+             communication_window=2, num_epoch=6, num_workers=2,
+             label_col="label")
+    params = t.train(ds)
+    losses = t.get_history().losses()
+    assert losses[-1] < losses[0] / 3
+    out = generate(spec, params, rows[:4, :6].astype(np.int32),
+                   max_new_tokens=8)
+    expect = (rows[:4, :1] + np.arange(14)) % V
+    assert (out == expect).mean() > 0.8
+
+
+def test_pos_embedding_validation():
+    spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS,
+                          depth=1, dtype=jnp.float32, pos_embedding="learned")
+    with pytest.raises(ValueError, match="pos_embedding"):
+        spec.init_np(0)
