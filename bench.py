@@ -411,6 +411,60 @@ def run_transformer_config(accel):
     return rec, rec_wide
 
 
+def run_lm_decode_config(accel):
+    """Beyond-reference leg: KV-cached autoregressive decode throughput on
+    the causal-LM family (dim 512 / 8 heads / depth 8, bf16, RoPE, flash
+    prefill), one jitted prefill+scan program per config. Decode is
+    KV-cache-bandwidth-bound — the cache is read end to end every step — so
+    the GQA/MQA legs (kv_heads=2/1: 4x/8x smaller caches) are the
+    performance configurations."""
+    from distkeras_tpu.models import generate, transformer_lm
+
+    B, PROMPT, NEW = 8, 128, 256
+    out = {}
+    for name, kvh in (("lm_decode_mha", None), ("lm_decode_gqa2", 2),
+                      ("lm_decode_mqa", 1)):
+        spec = transformer_lm(vocab=8192, maxlen=2048, dim=512, heads=8,
+                              depth=8, dtype=jax.numpy.bfloat16,
+                              attn_impl="flash", pos_embedding="rope",
+                              kv_heads=kvh)
+        params, _ = spec.init_np(0)
+        params = jax.device_put(params, accel)
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(0, 8192, size=(B, PROMPT)).astype(np.int32)
+        # generate() materializes host tokens, i.e. a full drain; its jitted
+        # prefill+scan program is lru-cached across calls, so only the first
+        # call compiles
+        t0 = time.perf_counter()
+        generate(spec, params, prompt, NEW)
+        log(f"  [{name}] compile+first decode: {time.perf_counter()-t0:.1f}s")
+        ts = []
+        for r in range(3):
+            t0 = time.perf_counter()
+            generate(spec, params, prompt, NEW, seed=r + 1)
+            ts.append(time.perf_counter() - t0)
+        t = float(np.median(ts))
+        rec = {
+            "config": name,
+            "decode_tokens_per_sec": round(B * NEW / t, 1),
+            "ms_per_step": round(1e3 * t / NEW, 3),
+            "batch": B, "new_tokens": NEW, "kv_heads": kvh or 8,
+            "spread": round((max(ts) - min(ts)) / t, 3),
+        }
+        log(json.dumps(rec))
+        out[name] = rec
+    log(json.dumps({
+        "config": "lm_decode_summary",
+        "gqa2_vs_mha": round(out["lm_decode_gqa2"]["decode_tokens_per_sec"]
+                             / out["lm_decode_mha"]["decode_tokens_per_sec"],
+                             2),
+        "mqa_vs_mha": round(out["lm_decode_mqa"]["decode_tokens_per_sec"]
+                            / out["lm_decode_mha"]["decode_tokens_per_sec"],
+                            2),
+    }))
+    return out
+
+
 def run_time_to_accuracy(accel, target=0.99, max_epochs=20):
     """BASELINE primary metric: wall-clock to `target` test accuracy on the
     north-star config (ADAG/LeNet), training time only (eval excluded),
@@ -537,6 +591,8 @@ def main():
         rec_t, rec_tw = run_transformer_config(accel)
         results["transformer_bf16_L2048"] = rec_t
         results["transformer_bf16_L2048_wide_heads"] = rec_tw
+        log("[config 7] causal-LM KV-cached decode (MHA vs GQA vs MQA)")
+        results.update(run_lm_decode_config(accel))
         log("[time-to-accuracy] ADAG/LeNet to 0.99 test accuracy")
         tta = run_time_to_accuracy(accel)
     if args.scaling:

@@ -8,10 +8,11 @@ next-token objective is plain ``sparse_softmax_cross_entropy`` on the
 :func:`generate` path built the TPU way:
 
 - **Static shapes everywhere**: the prompt is one fixed-length prefill, the
-  KV cache is a preallocated ``[B, maxlen, H, Dh]`` buffer per block updated
-  with ``lax.dynamic_update_slice``, and the decode loop is a single
-  ``lax.scan`` over ``max_new_tokens`` steps — one XLA compilation, no
-  per-token Python.
+  KV cache is a preallocated ``[B, maxlen, Hkv, Dh]`` buffer per block
+  (``Hkv = kv_heads`` under grouped-query attention, else ``heads``)
+  updated with ``lax.dynamic_update_slice``, and the decode loop is a
+  single ``lax.scan`` over ``max_new_tokens`` steps — one XLA compilation,
+  no per-token Python.
 - **MXU-friendly**: cache and activations live in the model dtype (bf16 on
   TPU); attention math accumulates in f32 like the training path.
 - The per-block parameter names (``qkv``/``attn_out``/``mlp_up``/
@@ -238,6 +239,11 @@ class TransformerLM(nn.Module):
             raise ValueError(
                 f"unknown pos_embedding {self.pos_embedding!r}; use "
                 f"'sincos' or 'rope'"
+            )
+        if self.pos_embedding == "rope" and (self.dim // self.heads) % 2:
+            raise ValueError(
+                f"RoPE needs an even head dim, got dim//heads = "
+                f"{self.dim // self.heads}"
             )
         self.embed = nn.Embed(self.vocab, self.dim, dtype=self.dtype)
         self.blocks = [

@@ -433,3 +433,10 @@ def test_pos_embedding_validation():
                           depth=1, dtype=jnp.float32, pos_embedding="learned")
     with pytest.raises(ValueError, match="pos_embedding"):
         spec.init_np(0)
+
+
+def test_rope_requires_even_head_dim():
+    spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=36, heads=4,
+                          depth=1, dtype=jnp.float32, pos_embedding="rope")
+    with pytest.raises(ValueError, match="even head dim"):
+        spec.init_np(0)
