@@ -6,10 +6,17 @@ driver-process PS at run end, and a driver failure lost the run (SURVEY.md §5.3
 optimizer state, step) is snapshotted atomically at epoch boundaries and a
 trainer can resume mid-run.
 
-Format: one file per checkpoint — ``utils.serialize_weights`` blob (npz +
-treedef) written to a temp name and atomically renamed, plus a small JSON
-sidecar index. No external checkpoint service needed; works on any POSIX
-filesystem (GCS-fuse on pods).
+Format: single-process runs write one file per checkpoint — a
+``utils.serialize_weights`` blob (npz + treedef) written to a temp name and
+atomically renamed, plus a small JSON sidecar index. Multi-process
+``jax.distributed`` runs dispatch to a **process-sharded** format: every
+controller writes one file holding only the array regions it can address
+(keyed by leaf + global offsets), a cross-process barrier orders the files
+before process 0 publishes the meta, and restore reassembles full global
+arrays on any process count — a 2-process checkpoint resumes on one
+process and vice versa, with exact-coverage validation. No external
+checkpoint service needed; works on any shared POSIX filesystem (GCS-fuse
+on pods).
 
 Compatibility note: checkpoints key params by flax module/layer names, so
 they are tied to the model code that wrote them. In particular the
@@ -24,10 +31,12 @@ from __future__ import annotations
 
 import json
 import os
+import pickle
 from pathlib import Path
 from typing import Any
 
 import jax
+import numpy as np
 
 from distkeras_tpu import utils
 
@@ -35,6 +44,9 @@ Pytree = Any
 
 _PREFIX = "ckpt_"
 _SUFFIX = ".dkc"
+#: process-sharded format (multi-process jax.distributed): one shard file
+#: per process + one meta file, same step namespace as the plain format
+_SHARD_SUFFIX = ".dks"
 
 
 def warn_elastic_resume(ckpt_workers: int, trainer_workers: int) -> None:
@@ -57,15 +69,20 @@ def should_checkpoint(epoch: int, every: int, num_epoch: int) -> bool:
 
 
 def save_checkpoint(directory, tree: Pytree, step: int, keep: int = 3) -> Path:
-    """Atomically write ``tree`` as checkpoint ``step``; prune old ones."""
+    """Atomically write ``tree`` as checkpoint ``step``; prune old ones.
+
+    Under multi-process ``jax.distributed`` this dispatches to the
+    process-sharded writer (each controller can only ``device_get`` its own
+    shards); single-process keeps the plain one-file format.
+    """
+    if jax.process_count() > 1:
+        return _save_sharded(directory, tree, step, keep)
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
     host_tree = jax.tree.map(lambda x: jax.device_get(x), tree)
     blob = utils.serialize_weights(host_tree)
     final = directory / f"{_PREFIX}{step:012d}{_SUFFIX}"
-    tmp = directory / f".tmp_{final.name}"
-    tmp.write_bytes(blob)
-    os.replace(tmp, final)
+    _atomic_write(final, blob)
     (directory / "latest.json").write_text(
         json.dumps({"step": step, "file": final.name})
     )
@@ -75,19 +92,159 @@ def save_checkpoint(directory, tree: Pytree, step: int, keep: int = 3) -> Path:
 
 
 def latest_step(directory) -> int | None:
+    """Newest checkpoint step in ``directory``, across both formats."""
     directory = Path(directory)
-    ckpts = sorted(directory.glob(f"{_PREFIX}*{_SUFFIX}"))
-    if not ckpts:
-        return None
-    return int(ckpts[-1].name[len(_PREFIX) : -len(_SUFFIX)])
+    steps = [
+        int(p.name[len(_PREFIX):].split(".")[0])
+        for p in directory.glob(f"{_PREFIX}*{_SUFFIX}")
+    ] + [
+        int(p.name[len(_PREFIX):].split(".")[0])
+        for p in directory.glob(f"{_PREFIX}*.meta{_SHARD_SUFFIX}")
+    ]
+    return max(steps) if steps else None
 
 
 def restore_checkpoint(directory, step: int | None = None) -> tuple[Pytree, int]:
-    """Load checkpoint ``step`` (default: latest). Returns (tree, step)."""
+    """Load checkpoint ``step`` (default: latest). Returns (tree, step).
+
+    Reads whichever format holds the step — a run checkpointed on a
+    2-process cluster restores on a single process and vice versa (the
+    sharded reader reassembles full global arrays on every process).
+    """
     directory = Path(directory)
     if step is None:
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
-    path = directory / f"{_PREFIX}{step:012d}{_SUFFIX}"
-    return utils.deserialize_weights(path.read_bytes()), step
+    plain = directory / f"{_PREFIX}{step:012d}{_SUFFIX}"
+    if plain.exists():
+        return utils.deserialize_weights(plain.read_bytes()), step
+    return _restore_sharded(directory, step), step
+
+
+# ---------------------------------------------------------------------------
+# Process-sharded format: under multi-process jax.distributed every
+# controller holds only its addressable shards of each global array, so one
+# process cannot snapshot the state. Every process writes ONE file with its
+# shards (keyed by leaf index + global offsets); process 0 writes the
+# treedef/shape/dtype meta after a cross-process barrier. Restore pastes
+# the shard regions back into full host arrays (any process count) and
+# verifies exact coverage.
+#
+# Scale note: SAVE is O(addressable shards) per process, but RESTORE
+# materializes the full global state in host RAM on every process (each
+# reads all shard files) before the engine re-shards it onto the mesh —
+# fine up to host-memory-sized models; a region-selective reader is the
+# upgrade path beyond that.
+# ---------------------------------------------------------------------------
+
+
+def _leaf_shards(leaf):
+    """Yield (starts, np_data) for each distinct addressable shard of
+    ``leaf`` (one entry covering everything for host/replicated leaves)."""
+    if isinstance(leaf, jax.Array):
+        seen = set()
+        for sh in leaf.addressable_shards:
+            starts = tuple(int(s.start or 0) for s in sh.index)
+            if starts in seen:
+                continue  # replicated over devices: one copy is enough
+            seen.add(starts)
+            yield starts, np.asarray(sh.data)
+    else:
+        arr = np.asarray(leaf)
+        yield (0,) * arr.ndim, arr
+
+
+def _shard_file(directory, step, pidx, pcount):
+    return Path(directory) / (
+        f"{_PREFIX}{step:012d}.p{pidx:05d}of{pcount:05d}{_SHARD_SUFFIX}"
+    )
+
+
+def _meta_file(directory, step):
+    return Path(directory) / f"{_PREFIX}{step:012d}.meta{_SHARD_SUFFIX}"
+
+
+def _atomic_write(path: Path, blob: bytes):
+    tmp = path.parent / f".tmp_{path.name}"
+    tmp.write_bytes(blob)
+    os.replace(tmp, path)
+
+
+def _save_sharded(directory, tree: Pytree, step: int, keep: int = 3) -> Path:
+    from jax.experimental import multihost_utils
+
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    pidx, pcount = jax.process_index(), jax.process_count()
+    leaves, treedef = jax.tree.flatten(tree)
+    shards = {}
+    for i, leaf in enumerate(leaves):
+        for starts, data in _leaf_shards(leaf):
+            shards[(i, starts)] = data
+    final = _shard_file(directory, step, pidx, pcount)
+    _atomic_write(final, pickle.dumps({"shards": shards}))
+    # all shard files durable before the meta makes the step discoverable
+    multihost_utils.sync_global_devices(f"distkeras-ckpt-{step}")
+    if pidx == 0:
+        meta = {
+            "treedef": treedef,
+            "shapes": [tuple(np.shape(l)) for l in leaves],
+            "dtypes": [np.asarray(l).dtype if not isinstance(l, jax.Array)
+                       else np.dtype(l.dtype) for l in leaves],
+            "step": step,
+            "processes": pcount,
+        }
+        _atomic_write(_meta_file(directory, step), pickle.dumps(meta))
+        (directory / "latest.json").write_text(
+            json.dumps({"step": step, "file": _meta_file(directory,
+                                                         step).name})
+        )
+        # prune by STEP, any topology: shard files from a previous process
+        # count (elastic restarts) belong to old steps and must not orphan
+        steps = sorted({
+            int(p.name[len(_PREFIX):].split(".")[0])
+            for p in directory.glob(f"{_PREFIX}*{_SHARD_SUFFIX}")
+        })
+        for old_step in steps[:-keep]:
+            for old in directory.glob(
+                f"{_PREFIX}{old_step:012d}*{_SHARD_SUFFIX}"
+            ):
+                old.unlink(missing_ok=True)
+    return final
+
+
+def _restore_sharded(directory, step: int) -> Pytree:
+    directory = Path(directory)
+    meta_path = _meta_file(directory, step)
+    if not meta_path.exists():
+        raise FileNotFoundError(f"no checkpoint {step} under {directory}")
+    meta = pickle.loads(meta_path.read_bytes())
+    leaves = [np.zeros(s, d) for s, d in zip(meta["shapes"], meta["dtypes"])]
+    covered = [0] * len(leaves)
+    seen: set = set()
+    pcount = meta["processes"]
+    for pidx in range(pcount):
+        path = _shard_file(directory, step, pidx, pcount)
+        if not path.exists():
+            raise FileNotFoundError(
+                f"checkpoint {step} is missing shard file {path.name} "
+                f"(wrote from {pcount} processes)"
+            )
+        payload = pickle.loads(path.read_bytes())
+        for (i, starts), data in payload["shards"].items():
+            if (i, starts) in seen:
+                continue  # replicated across processes
+            seen.add((i, starts))
+            region = tuple(
+                slice(st, st + sz) for st, sz in zip(starts, data.shape)
+            )
+            leaves[i][region] = data
+            covered[i] += data.size
+    for i, leaf in enumerate(leaves):
+        if covered[i] != leaf.size:
+            raise ValueError(
+                f"checkpoint {step} leaf {i}: shards cover {covered[i]} of "
+                f"{leaf.size} elements — corrupt or incomplete snapshot"
+            )
+    return jax.tree.unflatten(meta["treedef"], leaves)

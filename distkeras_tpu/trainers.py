@@ -477,15 +477,6 @@ class DistributedTrainer(Trainer):
 
     def train(self, dataset, shuffle: bool = False):
         ds = self._coerce_dataset(dataset)
-        if self.checkpoint_dir and jax.process_count() > 1:
-            # fail fast — a first-save failure after a trained epoch (or a
-            # clean restore followed by a crashing save) would waste the run
-            raise NotImplementedError(
-                "checkpointing under multi-process jax.distributed is not "
-                "supported yet (the snapshot would device_get worker shards "
-                "this process cannot address); checkpoint from a "
-                "single-process mesh"
-            )
         if self.backend == "ps":
             if jax.process_count() > 1:
                 # fail fast — hogwild threads are placed over jax.devices(),
@@ -795,9 +786,11 @@ class MeshTrainer(Trainer):
     activation memory at the same effective batch size.
 
     ``checkpoint_dir``/``checkpoint_every``/``resume`` snapshot the sharded
-    training state (params + optimizer in their mesh layout, gathered to
-    host) at epoch boundaries and restore it back onto the mesh —
-    resume-equality is pinned by tests/test_fsdp.py. ``profile_dir`` wraps
+    training state (params + optimizer in their mesh layout) at epoch
+    boundaries and restore it back onto the mesh — resume-equality is
+    pinned by tests/test_fsdp.py. Under multi-process ``jax.distributed``
+    the snapshot is process-sharded (each controller writes its own shards;
+    tests/test_multihost.py pins cluster resume equality). ``profile_dir`` wraps
     training in ``jax.profiler.trace``. ``input_mode="resident"`` uploads the
     dataset once and runs each epoch as one jitted scan (no per-step host
     round-trip); ``"auto"`` chooses resident when the dataset fits the
@@ -928,13 +921,14 @@ class MeshTrainer(Trainer):
         _reject_worker_axis_model(
             self.spec, "MeshTrainer (single-model GSPMD, no worker axis)"
         )
-        if (self.checkpoint_dir or self.profile_dir) \
-                and jax.process_count() > 1:
+        if self.profile_dir and jax.process_count() > 1:
             raise NotImplementedError(
-                "checkpoint_dir/profile_dir under multi-process "
-                "jax.distributed is not supported yet; run them from a "
-                "single-process mesh"
+                "profile_dir under multi-process jax.distributed is not "
+                "supported yet; profile from a single-process mesh"
             )
+        # checkpoint_dir works multi-process: saves dispatch to the
+        # process-sharded format (checkpoint._save_sharded) and restores
+        # reassemble global arrays on every controller
         ds = self._coerce_dataset(dataset)
         cols = self.features_col + [self.label_col]
         engine, to_engine, from_engine = self._build_engine()
@@ -1058,8 +1052,9 @@ class MeshTrainer(Trainer):
         if not ckpt.should_checkpoint(epoch, self.checkpoint_every,
                                       self.num_epoch):
             return
-        # device_get gathers the sharded leaves to host (single-process);
-        # the engine layout is saved as-is and re-placed on resume
+        # the engine layout is saved as-is and re-placed on resume;
+        # save_checkpoint dispatches per process topology (one host blob
+        # single-process, per-controller shard files under jax.distributed)
         ckpt.save_checkpoint(
             self.checkpoint_dir,
             {"params": params, "nt": nt, "opt": opt, "epoch": epoch},
