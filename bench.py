@@ -422,12 +422,18 @@ def run_lm_decode_config(accel):
 
     B, PROMPT, NEW = 8, 128, 256
     out = {}
-    for name, kvh in (("lm_decode_mha", None), ("lm_decode_gqa2", 2),
-                      ("lm_decode_mqa", 1)):
+    for name, kvh, window in (
+        ("lm_decode_mha", None, None),
+        ("lm_decode_gqa2", 2, None),
+        ("lm_decode_mqa", 1, None),
+        # the other cache lever: a sliding window shrinks the cache LENGTH
+        # (ring buffer of `window` slots instead of maxlen)
+        ("lm_decode_win256", None, 256),
+    ):
         spec = transformer_lm(vocab=8192, maxlen=2048, dim=512, heads=8,
                               depth=8, dtype=jax.numpy.bfloat16,
                               attn_impl="flash", pos_embedding="rope",
-                              kv_heads=kvh)
+                              kv_heads=kvh, attn_window=window)
         params, _ = spec.init_np(0)
         params = jax.device_put(params, accel)
         rng = np.random.default_rng(0)
@@ -449,6 +455,7 @@ def run_lm_decode_config(accel):
             "decode_tokens_per_sec": round(B * NEW / t, 1),
             "ms_per_step": round(1e3 * t / NEW, 3),
             "batch": B, "new_tokens": NEW, "kv_heads": kvh or 8,
+            "window": window,
             "spread": round((max(ts) - min(ts)) / t, 3),
         }
         log(json.dumps(rec))

@@ -86,20 +86,36 @@ def save_checkpoint(directory, tree: Pytree, step: int, keep: int = 3) -> Path:
     (directory / "latest.json").write_text(
         json.dumps({"step": step, "file": final.name})
     )
-    for old in sorted(directory.glob(f"{_PREFIX}*{_SUFFIX}"))[:-keep]:
-        old.unlink(missing_ok=True)
+    _prune_old_steps(directory, keep)
     return final
+
+
+def _all_checkpoint_files(directory):
+    """Every checkpoint file of either format, with its parsed step."""
+    directory = Path(directory)
+    for pattern in (f"{_PREFIX}*{_SUFFIX}", f"{_PREFIX}*{_SHARD_SUFFIX}"):
+        for p in directory.glob(pattern):
+            yield int(p.name[len(_PREFIX):].split(".")[0]), p
+
+
+def _prune_old_steps(directory, keep: int):
+    """Keep the newest ``keep`` steps, deleting older files of BOTH formats
+    — the two formats share one step namespace (a directory can hold both
+    across elastic topology changes), so pruning one suffix only would
+    leave stale other-format files that restore could resurrect."""
+    by_step: dict[int, list[Path]] = {}
+    for step, p in _all_checkpoint_files(directory):
+        by_step.setdefault(step, []).append(p)
+    for step in sorted(by_step)[:-keep]:
+        for p in by_step[step]:
+            p.unlink(missing_ok=True)
 
 
 def latest_step(directory) -> int | None:
     """Newest checkpoint step in ``directory``, across both formats."""
-    directory = Path(directory)
     steps = [
-        int(p.name[len(_PREFIX):].split(".")[0])
-        for p in directory.glob(f"{_PREFIX}*{_SUFFIX}")
-    ] + [
-        int(p.name[len(_PREFIX):].split(".")[0])
-        for p in directory.glob(f"{_PREFIX}*.meta{_SHARD_SUFFIX}")
+        step for step, p in _all_checkpoint_files(directory)
+        if p.suffix == _SUFFIX or p.name.endswith(f".meta{_SHARD_SUFFIX}")
     ]
     return max(steps) if steps else None
 
@@ -117,6 +133,13 @@ def restore_checkpoint(directory, step: int | None = None) -> tuple[Pytree, int]
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
     plain = directory / f"{_PREFIX}{step:012d}{_SUFFIX}"
+    meta = _meta_file(directory, step)
+    if plain.exists() and meta.exists():
+        # both formats hold this step (directory reused across a topology
+        # change without pruning catching up): the newer write wins
+        if meta.stat().st_mtime >= plain.stat().st_mtime:
+            return _restore_sharded(directory, step), step
+        return utils.deserialize_weights(plain.read_bytes()), step
     if plain.exists():
         return utils.deserialize_weights(plain.read_bytes()), step
     return _restore_sharded(directory, step), step
@@ -200,17 +223,10 @@ def _save_sharded(directory, tree: Pytree, step: int, keep: int = 3) -> Path:
             json.dumps({"step": step, "file": _meta_file(directory,
                                                          step).name})
         )
-        # prune by STEP, any topology: shard files from a previous process
-        # count (elastic restarts) belong to old steps and must not orphan
-        steps = sorted({
-            int(p.name[len(_PREFIX):].split(".")[0])
-            for p in directory.glob(f"{_PREFIX}*{_SHARD_SUFFIX}")
-        })
-        for old_step in steps[:-keep]:
-            for old in directory.glob(
-                f"{_PREFIX}{old_step:012d}*{_SHARD_SUFFIX}"
-            ):
-                old.unlink(missing_ok=True)
+        # prune by STEP across both formats: shard files from a previous
+        # process count (elastic restarts) and plain files from a
+        # single-process era belong to old steps and must not orphan
+        _prune_old_steps(directory, keep)
     return final
 
 

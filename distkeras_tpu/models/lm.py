@@ -175,15 +175,21 @@ class DecoderBlock(nn.Module):
 
     def step(self, x_t, k_cache, v_cache, pos):
         """One decode position. ``x_t``: [B, 1, dim] residual stream;
-        ``k_cache``/``v_cache``: [B, maxlen, Hkv, Dh] holding positions
-        ``< pos``; ``pos`` may be a traced scalar."""
+        ``k_cache``/``v_cache``: [B, cache_len, Hkv, Dh]; ``pos`` may be a
+        traced scalar. ``cache_len`` is ``maxlen`` normally, or ``window``
+        for sliding-window models — then the cache is a RING: position
+        ``p`` lives in slot ``p % window`` (decode reads ``window``, not
+        ``maxlen``, keys per step — the bandwidth the window promises)."""
         q, k, v = self._project_qkv(x_t)  # q [B,1,H,Dh]; k/v [B,1,Hkv,Dh]
         q, k = self._rope_qk(q, k, pos)   # cache holds pre-rotated keys
+        cache_len = k_cache.shape[1]
+        ring = cache_len < self.maxlen
+        slot = pos % cache_len if ring else pos
         k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0)
+            k_cache, k.astype(k_cache.dtype), (0, slot, 0, 0)
         )
         v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0)
+            v_cache, v.astype(v_cache.dtype), (0, slot, 0, 0)
         )
         B = x_t.shape[0]
         dh = self.dim // self.heads
@@ -197,10 +203,16 @@ class DecoderBlock(nn.Module):
         qg = q.reshape(B, 1, hkv, group, dh)
         s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_cache) \
             .astype(jnp.float32) * (dh ** -0.5)
-        kp = jnp.arange(k_cache.shape[1])
-        valid = kp <= pos                            # causal: cache ≤ pos
-        if self.attn_window is not None:
-            valid &= pos - kp < self.attn_window     # sliding-window band
+        kp = jnp.arange(cache_len)
+        if ring:
+            # slot s holds absolute position pos - ((pos - s) % window),
+            # automatically causal and in-band; only never-written slots
+            # (absolute < 0, early decode) need masking
+            valid = pos - ((pos - kp) % cache_len) >= 0
+        else:
+            valid = kp <= pos                        # causal: cache ≤ pos
+            if self.attn_window is not None:
+                valid &= pos - kp < self.attn_window  # sliding-window band
         s = jnp.where(valid[None, None, None, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         att = jnp.einsum(
@@ -281,15 +293,34 @@ class TransformerLM(nn.Module):
 
     def prefill(self, tokens):
         """Full forward over the prompt; returns ``(logits, caches)`` with
-        per-block maxlen-size K/V buffers holding positions ``< L``."""
+        per-block K/V buffers holding positions ``< L``. Cache length is
+        ``maxlen``, or ``attn_window`` for sliding-window models — then the
+        buffer is a ring (slot ``p % window``) seeded with the last
+        ``window`` prompt positions; decode never reads beyond the band, so
+        nothing else is needed."""
         B, L = tokens.shape
         dh = self.dim // self.heads
         hkv = self.kv_heads if self.kv_heads is not None else self.heads
+        cache_len = self.maxlen
+        if self.attn_window is not None:
+            cache_len = min(self.maxlen, int(self.attn_window))
         x = self._embed_at(tokens)
         caches = []
+        ring_pos = None
+        if cache_len < self.maxlen:
+            slots = jnp.arange(cache_len)
+            # absolute position living in each slot after prefill; negative
+            # ⇒ never written, masked by step()'s validity
+            ring_pos = (L - 1) - ((L - 1 - slots) % cache_len)
         for blk in self.blocks:
             x, k, v = blk.prefill(x, None)   # k/v hold Hkv heads under GQA
-            kc = jnp.zeros((B, self.maxlen, hkv, dh), self.dtype)
+            if ring_pos is not None:
+                kc = jnp.take(k, jnp.maximum(ring_pos, 0), axis=1)
+                vc = jnp.take(v, jnp.maximum(ring_pos, 0), axis=1)
+                caches.append((kc.astype(self.dtype),
+                               vc.astype(self.dtype)))
+                continue
+            kc = jnp.zeros((B, cache_len, hkv, dh), self.dtype)
             vc = jnp.zeros_like(kc)
             kc = jax.lax.dynamic_update_slice(
                 kc, k.astype(self.dtype), (0, 0, 0, 0)

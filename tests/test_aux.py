@@ -402,3 +402,26 @@ def test_sharded_checkpoint_detects_missing_shard(tmp_path):
     shard_file.write_bytes(pickle.dumps(payload))
     with pytest.raises(ValueError, match="cover"):
         ckpt.restore_checkpoint(tmp_path, step=0)
+
+
+def test_checkpoint_cross_format_step_collision(tmp_path):
+    """Both formats at one step (directory reused across a topology
+    change): the newer write wins restore, and pruning removes old steps
+    of BOTH formats."""
+    import time as _time
+
+    from distkeras_tpu import checkpoint as ckpt
+
+    ckpt.save_checkpoint(tmp_path, {"w": np.zeros(4)}, step=3)
+    _time.sleep(0.05)  # distinct mtimes
+    ckpt._save_sharded(tmp_path, {"w": np.ones(4)}, step=3)
+    got, _ = ckpt.restore_checkpoint(tmp_path, step=3)
+    np.testing.assert_array_equal(got["w"], np.ones(4))  # sharded is newer
+
+    # old plain steps are pruned by the sharded writer too (keep=3)
+    for s in (0, 1):
+        ckpt.save_checkpoint(tmp_path, {"w": np.zeros(1)}, step=s)
+    for s in (4, 5, 6):
+        ckpt._save_sharded(tmp_path, {"w": np.ones(1)}, step=s)
+    remaining = {st for st, _ in ckpt._all_checkpoint_files(tmp_path)}
+    assert remaining == {4, 5, 6}

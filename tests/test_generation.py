@@ -440,3 +440,60 @@ def test_rope_requires_even_head_dim():
                           depth=1, dtype=jnp.float32, pos_embedding="rope")
     with pytest.raises(ValueError, match="even head dim"):
         spec.init_np(0)
+
+
+def test_ring_cache_shape_and_long_wraparound():
+    """Sliding-window LM decode uses a RING cache of length window (not
+    maxlen), and stays equal to the full windowed forward far past the
+    first wrap-around (decode length >> window), composed with GQA+RoPE."""
+    W = 5
+    spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS,
+                          depth=DEPTH, dtype=jnp.float32, attn_window=W,
+                          kv_heads=2, pos_embedding="rope")
+    params, _ = spec.init_np(0)
+    module = spec.module
+    rng = np.random.default_rng(6)
+    toks = rng.integers(0, VOCAB, size=(2, 28)).astype(np.int32)
+
+    lp = 3
+    logits_pre, caches = module.apply(
+        {"params": params}, toks[:, :lp], method=TransformerLM.prefill
+    )
+    kc, vc = caches[0]
+    assert kc.shape == (2, W, 2, DIM // HEADS)   # ring: window, not maxlen
+    for pos in range(lp, toks.shape[1]):          # 25 steps = 5 full wraps
+        step_logits, caches = module.apply(
+            {"params": params}, toks[:, pos], caches, pos,
+            method=TransformerLM.decode_step,
+        )
+        full = module.apply({"params": params}, toks[:, : pos + 1])
+        np.testing.assert_allclose(
+            np.asarray(step_logits), np.asarray(full[:, -1]),
+            rtol=2e-4, atol=2e-4, err_msg=f"pos={pos}",
+        )
+
+
+def test_ring_cache_prompt_longer_than_window():
+    """Prefill with a prompt LONGER than the window seeds the ring with the
+    last `window` positions only; decode continues exactly."""
+    W = 4
+    spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS,
+                          depth=1, dtype=jnp.float32, attn_window=W)
+    params, _ = spec.init_np(0)
+    module = spec.module
+    rng = np.random.default_rng(7)
+    toks = rng.integers(0, VOCAB, size=(2, 16)).astype(np.int32)
+    lp = 11                                       # prompt >> window
+    _, caches = module.apply(
+        {"params": params}, toks[:, :lp], method=TransformerLM.prefill
+    )
+    for pos in range(lp, toks.shape[1]):
+        step_logits, caches = module.apply(
+            {"params": params}, toks[:, pos], caches, pos,
+            method=TransformerLM.decode_step,
+        )
+        full = module.apply({"params": params}, toks[:, : pos + 1])
+        np.testing.assert_allclose(
+            np.asarray(step_logits), np.asarray(full[:, -1]),
+            rtol=2e-4, atol=2e-4, err_msg=f"pos={pos}",
+        )
