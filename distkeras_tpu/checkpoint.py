@@ -86,7 +86,7 @@ def save_checkpoint(directory, tree: Pytree, step: int, keep: int = 3) -> Path:
     (directory / "latest.json").write_text(
         json.dumps({"step": step, "file": final.name})
     )
-    _prune_old_steps(directory, keep)
+    _prune_old_steps(directory, keep, protect=step)
     return final
 
 
@@ -98,15 +98,20 @@ def _all_checkpoint_files(directory):
             yield int(p.name[len(_PREFIX):].split(".")[0]), p
 
 
-def _prune_old_steps(directory, keep: int):
+def _prune_old_steps(directory, keep: int, protect: int | None = None):
     """Keep the newest ``keep`` steps, deleting older files of BOTH formats
     — the two formats share one step namespace (a directory can hold both
     across elastic topology changes), so pruning one suffix only would
-    leave stale other-format files that restore could resurrect."""
+    leave stale other-format files that restore could resurrect.
+    ``protect`` (the step just written) is never deleted even when the
+    directory holds higher-numbered steps — a run resumed from a rollback
+    must not have its own fresh saves pruned by the abandoned future."""
     by_step: dict[int, list[Path]] = {}
     for step, p in _all_checkpoint_files(directory):
         by_step.setdefault(step, []).append(p)
     for step in sorted(by_step)[:-keep]:
+        if step == protect:
+            continue
         for p in by_step[step]:
             p.unlink(missing_ok=True)
 
@@ -136,7 +141,17 @@ def restore_checkpoint(directory, step: int | None = None) -> tuple[Pytree, int]
     meta = _meta_file(directory, step)
     if plain.exists() and meta.exists():
         # both formats hold this step (directory reused across a topology
-        # change without pruning catching up): the newer write wins
+        # change without pruning catching up): latest.json records which
+        # writer ran last — authoritative where shared-filesystem mtime
+        # granularity/clock skew is not; mtime is only the fallback
+        latest = directory / "latest.json"
+        if latest.exists():
+            rec = json.loads(latest.read_text())
+            if rec.get("step") == step:
+                if rec.get("file") == meta.name:
+                    return _restore_sharded(directory, step), step
+                if rec.get("file") == plain.name:
+                    return utils.deserialize_weights(plain.read_bytes()), step
         if meta.stat().st_mtime >= plain.stat().st_mtime:
             return _restore_sharded(directory, step), step
         return utils.deserialize_weights(plain.read_bytes()), step
@@ -226,7 +241,7 @@ def _save_sharded(directory, tree: Pytree, step: int, keep: int = 3) -> Path:
         # prune by STEP across both formats: shard files from a previous
         # process count (elastic restarts) and plain files from a
         # single-process era belong to old steps and must not orphan
-        _prune_old_steps(directory, keep)
+        _prune_old_steps(directory, keep, protect=step)
     return final
 
 

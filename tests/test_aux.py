@@ -425,3 +425,16 @@ def test_checkpoint_cross_format_step_collision(tmp_path):
         ckpt._save_sharded(tmp_path, {"w": np.ones(1)}, step=s)
     remaining = {st for st, _ in ckpt._all_checkpoint_files(tmp_path)}
     assert remaining == {4, 5, 6}
+
+
+def test_checkpoint_rollback_save_not_pruned(tmp_path):
+    """A run resumed from a rollback saves a LOWER step than stale future
+    checkpoints; its fresh save must survive pruning."""
+    from distkeras_tpu import checkpoint as ckpt
+
+    for s in (150, 151, 152):
+        ckpt.save_checkpoint(tmp_path, {"w": np.zeros(1)}, step=s)
+    path = ckpt.save_checkpoint(tmp_path, {"w": np.ones(1)}, step=101)
+    assert path.exists()
+    got, _ = ckpt.restore_checkpoint(tmp_path, step=101)
+    np.testing.assert_array_equal(got["w"], np.ones(1))

@@ -50,3 +50,21 @@ def test_longcontext_example_runs_quick():
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "[flash+remat]" in proc.stdout
     assert "[sp]" in proc.stdout
+
+
+@pytest.mark.slow
+def test_lm_example_runs_and_generates():
+    """Causal-LM example: trains on the cyclic language and the KV-cached
+    generations continue it (the script self-checks accuracy > 0.9)."""
+    proc = run_example("lm.py", "--quick")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK" in proc.stdout, proc.stdout
+
+
+@pytest.mark.slow
+def test_lm_example_modern_decoder_combo():
+    """RoPE + GQA + sliding window through the example CLI."""
+    proc = run_example("lm.py", "--quick", "--pos", "rope",
+                       "--kv-heads", "2", "--window", "16")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK" in proc.stdout, proc.stdout
