@@ -83,10 +83,9 @@ def save_checkpoint(directory, tree: Pytree, step: int, keep: int = 3) -> Path:
     blob = utils.serialize_weights(host_tree)
     final = directory / f"{_PREFIX}{step:012d}{_SUFFIX}"
     _atomic_write(final, blob)
-    (directory / "latest.json").write_text(
-        json.dumps({"step": step, "file": final.name})
-    )
-    _prune_old_steps(directory, keep, protect=step)
+    _atomic_write(directory / "latest.json",
+                  json.dumps({"step": step, "file": final.name}).encode())
+    _prune_old_steps(directory, keep, current=step)
     return final
 
 
@@ -98,20 +97,24 @@ def _all_checkpoint_files(directory):
             yield int(p.name[len(_PREFIX):].split(".")[0]), p
 
 
-def _prune_old_steps(directory, keep: int, protect: int | None = None):
-    """Keep the newest ``keep`` steps, deleting older files of BOTH formats
-    — the two formats share one step namespace (a directory can hold both
-    across elastic topology changes), so pruning one suffix only would
-    leave stale other-format files that restore could resurrect.
-    ``protect`` (the step just written) is never deleted even when the
-    directory holds higher-numbered steps — a run resumed from a rollback
-    must not have its own fresh saves pruned by the abandoned future."""
+def _prune_old_steps(directory, keep: int, current: int | None = None):
+    """Prune after writing step ``current``: files of BOTH formats are in
+    one step namespace (a directory can hold both across elastic topology
+    changes), so pruning one suffix only would leave stale other-format
+    files that restore could resurrect.
+
+    Saving step ``current`` declares the live timeline: any HIGHER steps
+    are an abandoned future (a run resumed from a rollback) and are
+    truncated — otherwise ``latest_step`` would resume the dead timeline
+    and the stale steps would eat the ``keep`` budget forever. Among the
+    remaining steps, the newest ``keep`` survive."""
     by_step: dict[int, list[Path]] = {}
     for step, p in _all_checkpoint_files(directory):
         by_step.setdefault(step, []).append(p)
-    for step in sorted(by_step)[:-keep]:
-        if step == protect:
-            continue
+    doomed = [s for s in by_step if current is not None and s > current]
+    live = sorted(s for s in by_step if s not in set(doomed))
+    doomed += live[:-keep]
+    for step in doomed:
         for p in by_step[step]:
             p.unlink(missing_ok=True)
 
@@ -145,13 +148,17 @@ def restore_checkpoint(directory, step: int | None = None) -> tuple[Pytree, int]
         # writer ran last — authoritative where shared-filesystem mtime
         # granularity/clock skew is not; mtime is only the fallback
         latest = directory / "latest.json"
+        rec = {}
         if latest.exists():
-            rec = json.loads(latest.read_text())
-            if rec.get("step") == step:
-                if rec.get("file") == meta.name:
-                    return _restore_sharded(directory, step), step
-                if rec.get("file") == plain.name:
-                    return utils.deserialize_weights(plain.read_bytes()), step
+            try:
+                rec = json.loads(latest.read_text())
+            except ValueError:
+                rec = {}  # torn/partial index: the mtime fallback decides
+        if rec.get("step") == step:
+            if rec.get("file") == meta.name:
+                return _restore_sharded(directory, step), step
+            if rec.get("file") == plain.name:
+                return utils.deserialize_weights(plain.read_bytes()), step
         if meta.stat().st_mtime >= plain.stat().st_mtime:
             return _restore_sharded(directory, step), step
         return utils.deserialize_weights(plain.read_bytes()), step
@@ -234,14 +241,15 @@ def _save_sharded(directory, tree: Pytree, step: int, keep: int = 3) -> Path:
             "processes": pcount,
         }
         _atomic_write(_meta_file(directory, step), pickle.dumps(meta))
-        (directory / "latest.json").write_text(
-            json.dumps({"step": step, "file": _meta_file(directory,
-                                                         step).name})
+        _atomic_write(
+            directory / "latest.json",
+            json.dumps({"step": step,
+                        "file": _meta_file(directory, step).name}).encode(),
         )
         # prune by STEP across both formats: shard files from a previous
         # process count (elastic restarts) and plain files from a
         # single-process era belong to old steps and must not orphan
-        _prune_old_steps(directory, keep, protect=step)
+        _prune_old_steps(directory, keep, current=step)
     return final
 
 

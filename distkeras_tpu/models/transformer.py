@@ -61,18 +61,16 @@ def attention_sublayer(x, mask, *, dim, heads, causal, dtype,
     if attn_impl == "ring":
         from distkeras_tpu.parallel.sequence import ring_attention_shard
 
-        if attn_window is not None:
-            raise ValueError(
-                "attn_window is not supported with attn_impl='ring' (shard "
-                "the sequence over sp and use flash windows per shard, or "
-                "use a non-ring impl)"
-            )
+        window = attn_window
+        if window is not None and window >= sp_size * L:
+            window = None  # band covers the whole (global) sequence
         # no f32 pre-cast: the ring body casts per block internally, and
-        # rotating K/V in bf16 halves the per-step ICI payload
+        # rotating K/V in bf16 halves the per-step ICI payload; under a
+        # window the ring only rotates through the band's blocks
         att = ring_attention_shard(
             q, k, v, mask,
             axis_name=sp_axis, axis_size=sp_size, causal=causal,
-            scale=(dim // heads) ** -0.5,
+            scale=(dim // heads) ** -0.5, window=window,
         )
     elif attn_impl == "reference":
         att = attention_reference(q, k, v, causal=causal, key_mask=mask,

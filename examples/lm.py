@@ -81,14 +81,15 @@ def main():
         kv_heads=args.kv_heads, attn_window=args.window,
     )
     cls = getattr(trainers, args.trainer)
-    kwargs = ({} if args.trainer == "SingleTrainer"
-              else {"num_workers": args.workers})
-    trainer = cls(
-        spec, loss="sparse_softmax_cross_entropy", worker_optimizer="adam",
+    kwargs = dict(
+        loss="sparse_softmax_cross_entropy", worker_optimizer="adam",
         learning_rate=3e-3, batch_size=args.batch_size,
-        communication_window=2, num_epoch=args.epochs,
-        label_col="label", log_metrics=True, **kwargs,
+        num_epoch=args.epochs, label_col="label",
     )
+    if args.trainer != "SingleTrainer":  # the oracle takes no distrib kwargs
+        kwargs.update(num_workers=args.workers, communication_window=2,
+                      log_metrics=True)
+    trainer = cls(spec, **kwargs)
     params = trainer.train(ds, shuffle=True)
     losses = trainer.get_history().losses()
     print(f"[train] loss {float(losses[0]):.3f} -> {float(losses[-1]):.4f} "

@@ -429,7 +429,7 @@ def test_checkpoint_cross_format_step_collision(tmp_path):
 
 def test_checkpoint_rollback_save_not_pruned(tmp_path):
     """A run resumed from a rollback saves a LOWER step than stale future
-    checkpoints; its fresh save must survive pruning."""
+    checkpoints; its fresh save must survive (and win) pruning."""
     from distkeras_tpu import checkpoint as ckpt
 
     for s in (150, 151, 152):
@@ -438,3 +438,21 @@ def test_checkpoint_rollback_save_not_pruned(tmp_path):
     assert path.exists()
     got, _ = ckpt.restore_checkpoint(tmp_path, step=101)
     np.testing.assert_array_equal(got["w"], np.ones(1))
+
+
+def test_checkpoint_rollback_truncates_abandoned_future(tmp_path):
+    """Saving a LOWER step declares a new timeline: higher (abandoned)
+    steps are truncated, so latest_step tracks the live run and the keep
+    budget isn't eaten by dead checkpoints."""
+    from distkeras_tpu import checkpoint as ckpt
+
+    for s in (150, 151, 152):
+        ckpt.save_checkpoint(tmp_path, {"w": np.zeros(1)}, step=s)
+    ckpt.save_checkpoint(tmp_path, {"w": np.ones(1)}, step=101)
+    assert ckpt.latest_step(tmp_path) == 101        # not the dead 152
+    for s in (102, 103):
+        ckpt.save_checkpoint(tmp_path, {"w": np.ones(1) * s}, step=s)
+    remaining = {st for st, _ in ckpt._all_checkpoint_files(tmp_path)}
+    assert remaining == {101, 102, 103}
+    got, _ = ckpt.restore_checkpoint(tmp_path)
+    np.testing.assert_array_equal(got["w"], np.ones(1) * 103)
