@@ -41,6 +41,7 @@ from distkeras_tpu.models.resnet import ResNetSmall, resnet_small
 from distkeras_tpu.models.transformer import (
     TransformerClassifier,
     pipelined_transformer_forward,
+    sequence_parallel_transformer_forward,
     transformer_classifier,
 )
 
@@ -52,6 +53,7 @@ __all__ = [
     "ResNetSmall", "resnet_small",
     "TransformerClassifier", "transformer_classifier",
     "pipelined_transformer_forward",
+    "sequence_parallel_transformer_forward",
     "MoETransformerClassifier", "moe_transformer_classifier",
     "TransformerLM", "transformer_lm", "generate", "next_token_dataset",
 ]
