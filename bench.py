@@ -623,6 +623,8 @@ def main():
             log("[proxy] ADAG/LeNet on single-process CPU "
                 "(same batch/window, fewer rows)")
             cpu = jax.devices("cpu")[0]
+            # 2048 rows is the MINIMUM at the matched b256/w8 config (one
+            # superbatch); the ~4 min XLA:CPU compile dominates the leg
             train, _ = mnist(n_train=2048, n_test=64)
             baseline = measure(
                 cpu, lenet(dtype=jnp.float32), ADAGMerge(), optax.adam(1e-3),

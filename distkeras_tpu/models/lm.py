@@ -129,13 +129,6 @@ class DecoderBlock(nn.Module):
         v = qkv[..., (self.heads + hkv) * dh:]
         return q, k.reshape(B, L, hkv, dh), v.reshape(B, L, hkv, dh)
 
-    def _expand_kv(self, t):
-        """[B, L, Hkv, Dh] → [B, L, H, Dh]: query head h uses kv head
-        h // group (jnp.repeat matches the [Hkv, group] reshape used by the
-        grouped decode einsum)."""
-        group = self.heads // self._hkv
-        return t if group == 1 else jnp.repeat(t, group, axis=2)
-
     def _mlp(self, x):
         h = self.ln_mlp(x)
         h = self.mlp_up(h.astype(self.dtype))
@@ -146,10 +139,12 @@ class DecoderBlock(nn.Module):
     def _attn_full(self, x, mask):
         B, L, _ = x.shape
         q, k, v = self._project_qkv(x)
-        q, k = self._rope_qk(q, k, 0)   # k rotated BEFORE caching/expand
-        kf, vf = self._expand_kv(k), self._expand_kv(v)
+        q, k = self._rope_qk(q, k, 0)   # k rotated BEFORE caching
+        # GQA needs no expansion: both attention paths read the shared Hkv
+        # heads directly (the flash kernels via index maps — no repeated-KV
+        # tensor is ever materialized)
         if self.attn_impl == "reference":
-            att = attention_reference(q, kf, vf, causal=True, key_mask=mask,
+            att = attention_reference(q, k, v, causal=True, key_mask=mask,
                                       window=self.attn_window)
         else:
             from distkeras_tpu.ops.flash_attention import attention
@@ -159,7 +154,7 @@ class DecoderBlock(nn.Module):
             # that aren't tile multiples; training shapes (maxlen-derived)
             # stay tile-friendly and keep the kernel
             impl = "auto" if self.attn_impl == "flash" else self.attn_impl
-            att = attention(q, kf, vf, causal=True, key_mask=mask,
+            att = attention(q, k, v, causal=True, key_mask=mask,
                             impl=impl, window=self.attn_window)
         att = att.reshape(B, L, self.dim)
         x = x + self.attn_out(att.astype(self.dtype)).astype(jnp.float32)
@@ -199,7 +194,7 @@ class DecoderBlock(nn.Module):
         # so cached decode is bit-compatible with the full forward in bf16:
         # q·k in model dtype, softmax in f32, p·v back in model dtype.
         # GQA: the [H] head axis factors as [Hkv, group] (group-major match
-        # with _expand_kv's jnp.repeat); the cache stays Hkv-wide.
+        # with the kernels' index maps); the cache stays Hkv-wide.
         qg = q.reshape(B, 1, hkv, group, dh)
         s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_cache) \
             .astype(jnp.float32) * (dh ** -0.5)

@@ -232,10 +232,34 @@ def _pick_block_k(L):
     return next(c for c in (BLOCK_K, 384, 256, 128) if L % c == 0)
 
 
+def _gqa_groups(q, k):
+    """Validated GQA group size: q heads per shared k/v head (1 = MHA)."""
+    H, Hkv = q.shape[2], k.shape[2]
+    if H % Hkv:
+        raise ValueError(
+            f"q heads {H} must be a multiple of kv heads {Hkv}"
+        )
+    return H // Hkv
+
+
+def _kv_row(b, H, Hkv):
+    """Grid row (over B·H) → k/v array row (over B·Hkv): query head h
+    reads shared head h // group — the same [Hkv, group] factoring as the
+    LM's cache decode and jnp.repeat expansion."""
+    if H == Hkv:
+        return b
+    return (b // H) * Hkv + (b % H) // (H // Hkv)
+
+
 def _fa_forward(q, k, v, key_mask, *, scale, causal, interpret,
                 window=None):
-    """q/k/v [B, L, H, D] (+ key_mask [B, L]) → (out [B, L, H, D], lse)."""
+    """q [B, L, H, D], k/v [B, L, Hkv, D] with Hkv | H (grouped-query
+    attention reads shared K/V heads straight from the index maps — no
+    repeated-KV materialization), + key_mask [B, L] →
+    (out [B, L, H, D], lse)."""
     B, L, H, D = q.shape
+    Hkv = k.shape[2]
+    _gqa_groups(q, k)
     if L % BLOCK_Q:
         raise ValueError(
             f"sequence length {L} must be a multiple of {BLOCK_Q}"
@@ -243,14 +267,17 @@ def _fa_forward(q, k, v, key_mask, *, scale, causal, interpret,
     bq = _pick_block_q(L)
     bk = _pick_block_k(L)
 
-    def bh(x):  # [B, L, H, D] → [B·H, L, D]
-        return jnp.moveaxis(x, 2, 1).reshape(B * H, L, D)
+    def bh(x):  # [B, L, h, D] → [B·h, L, D]
+        h = x.shape[2]
+        return jnp.moveaxis(x, 2, 1).reshape(B * h, L, D)
 
     nk = L // bk
     nkt, k_tile = _restricted_k_axis(nk, bq, bk, causal, window)
     grid = (B * H, L // bq, nkt)
     qspec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
-    kvspec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, k_tile(i, j), 0))
+    kvspec = pl.BlockSpec(
+        (1, bk, D), lambda b, i, j: (_kv_row(b, H, Hkv), k_tile(i, j), 0)
+    )
     ospec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
     # lse carries a trailing singleton so its block obeys the (8, 128)
     # tile rule (last dim equal to the array dim is allowed)
@@ -390,21 +417,33 @@ def _band_valid_t(jk, qt, *, block_q, block_k, causal, window):
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, *rest,
                        scale, causal, block_q, block_k, window=None,
-                       nq=None):
-    """One (bh, jk, iq) step: rebuild the transposed [bk, bq] probability
-    tile and fold ``pᵀ @ dO`` / ``dsᵀ @ q`` into the dv/dk accumulators;
-    write on this k block's last contributing q step."""
+                       nq=None, gqa_groups=None):
+    """One (bh, jk, iq) step — or (b·hkv, jk, gg, iq) under grouped-query
+    attention, where the extra ``gg`` axis walks the q heads sharing this
+    k/v head and the dk/dv accumulators run across the whole group:
+    rebuild the transposed [bk, bq] probability tile and fold ``pᵀ @ dO``
+    / ``dsᵀ @ q`` into the dv/dk accumulators; write on the group's last
+    contributing q step."""
     if len(rest) == 5:
         km_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
     else:
         km_ref = None
         dk_ref, dv_ref, dk_acc, dv_acc = rest
     jk = pl.program_id(1)
-    iq = pl.program_id(2)
-    if nq is None:
-        nq = pl.num_programs(2)
+    if gqa_groups is None:
+        last_g = None
+        iq = pl.program_id(2)
+        if nq is None:
+            nq = pl.num_programs(2)
+        first_step = iq == 0
+    else:
+        grp = pl.program_id(2)  # in-group q head (gg names the dO tile)
+        iq = pl.program_id(3)
+        assert nq is not None
+        first_step = (grp == 0) & (iq == 0)
+        last_g = grp == gqa_groups - 1
 
-    @pl.when(iq == 0)
+    @pl.when(first_step)
     def _():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -456,7 +495,9 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, *rest,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(qt == last_q)
+    write = qt == last_q if last_g is None else ((qt == last_q) & last_g)
+
+    @pl.when(write)
     def _():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -465,13 +506,20 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, *rest,
 def _fa_backward(q, k, v, key_mask, out, lse, g, *, scale, causal,
                  interpret, window=None):
     """Blockwise flash-attention backward: (dq, dk, dv) via two Pallas
-    kernels, ``O(block_q · block_k)`` on-chip — no [B, H, L, L] tensors."""
+    kernels, ``O(block_q · block_k)`` on-chip — no [B, H, L, L] tensors.
+    Under grouped-query attention (k/v hold Hkv < H heads) dq reads the
+    shared heads through the index maps and the dkv grid gains a group
+    axis whose accumulators sum the whole group — dk/dv come out
+    Hkv-wide, no repeated-KV tensors anywhere."""
     B, L, H, D = q.shape
+    Hkv = k.shape[2]
+    groups = _gqa_groups(q, k)
     bq = _pick_block_q(L)
     bk = _pick_block_k(L)  # same ladders as the forward — keep in lockstep
 
-    def bh(x):  # [B, L, H, D] → [B·H, L, D]
-        return jnp.moveaxis(x, 2, 1).reshape(B * H, L, D)
+    def bh(x):  # [B, L, h, D] → [B·h, L, D]
+        h = x.shape[2]
+        return jnp.moveaxis(x, 2, 1).reshape(B * h, L, D)
 
     qb, kb, vb, gb = bh(q), bh(k), bh(v), bh(g)
     # delta = rowsum(dO · O): one elementwise pass, [B·H, L]
@@ -487,7 +535,9 @@ def _fa_backward(q, k, v, key_mask, out, lse, g, *, scale, causal,
     nqt, q_tile = _restricted_q_axis(nq, bq, bk, causal, window)
 
     qspec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
-    kvspec_q = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, k_tile(i, j), 0))
+    kvspec_q = pl.BlockSpec(
+        (1, bk, D), lambda b, i, j: (_kv_row(b, H, Hkv), k_tile(i, j), 0)
+    )
     colspec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
 
     dq_specs = [qspec, kvspec_q, kvspec_q, qspec, colspec, colspec]
@@ -509,32 +559,59 @@ def _fa_backward(q, k, v, key_mask, out, lse, g, *, scale, causal,
         interpret=interpret,
     )(*dq_args)
 
-    # dk/dv: k blocks on the parallel axis, q innermost
-    kvspec = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
-    qspec2 = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, q_tile(j, i), 0))
-    rowspec = pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, q_tile(j, i)))
+    # dk/dv: k blocks on the parallel axis, q innermost; under GQA the
+    # grid is (B·Hkv, nk, group, nqt) with the group axis outside the q
+    # walk so the accumulators span every q head sharing the k/v head
+    def q_row_of(b, gg):
+        # b over B·Hkv, gg the in-group q head → row over B·H
+        return (b // Hkv) * H + (b % Hkv) * groups + gg
+
+    if groups == 1:
+        grid = (B * H, nk, nqt)
+        kvspec = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
+        qspec2 = pl.BlockSpec(
+            (1, bq, D), lambda b, j, i: (b, q_tile(j, i), 0)
+        )
+        rowspec = pl.BlockSpec(
+            (1, 1, bq), lambda b, j, i: (b, 0, q_tile(j, i))
+        )
+        kmspec = pl.BlockSpec((1, bk, 1), lambda b, j, i: (b // H_, j, 0))
+    else:
+        grid = (B * Hkv, nk, groups, nqt)
+        kvspec = pl.BlockSpec((1, bk, D), lambda b, j, gg, i: (b, j, 0))
+        qspec2 = pl.BlockSpec(
+            (1, bq, D),
+            lambda b, j, gg, i: (q_row_of(b, gg), q_tile(j, i), 0),
+        )
+        rowspec = pl.BlockSpec(
+            (1, 1, bq),
+            lambda b, j, gg, i: (q_row_of(b, gg), 0, q_tile(j, i)),
+        )
+        kmspec = pl.BlockSpec(
+            (1, bk, 1), lambda b, j, gg, i: (b // Hkv, j, 0)
+        )
     dkv_specs = [qspec2, kvspec, kvspec, qspec2, rowspec, rowspec]
     dkv_args = [qb, kb, vb, gb, lse_row, d_row]
     if key_mask is not None:
-        dkv_specs.append(
-            pl.BlockSpec((1, bk, 1), lambda b, j, i: (b // H_, j, 0))
-        )
+        dkv_specs.append(kmspec)
         dkv_args.append(key_mask.astype(jnp.float32)[..., None])
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, window=window, nq=nq),
-        grid=(B * H, nk, nqt),
+                          block_q=bq, block_k=bk, window=window, nq=nq,
+                          gqa_groups=None if groups == 1 else groups),
+        grid=grid,
         in_specs=dkv_specs,
         out_specs=[kvspec, kvspec],
-        out_shape=[jax.ShapeDtypeStruct((B * H, L, D), k.dtype),
-                   jax.ShapeDtypeStruct((B * H, L, D), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((B * Hkv, L, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * Hkv, L, D), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
         interpret=interpret,
     )(*dkv_args)
 
-    def unbh(x):  # [B·H, L, D] → [B, L, H, D]
-        return jnp.moveaxis(x.reshape(B, H, L, D), 1, 2)
+    def unbh(x):  # [B·h, L, D] → [B, L, h, D]
+        h = x.shape[0] // B
+        return jnp.moveaxis(x.reshape(B, h, L, D), 1, 2)
 
     return unbh(dq), unbh(dk), unbh(dv)
 
@@ -542,8 +619,14 @@ def _fa_backward(q, k, v, key_mask, out, lse, g, *, scale, causal,
 def _attention_bwd_math(q, k, v, key_mask, lse, g, *, scale, causal,
                         window=None):
     """Recompute-based backward (plain XLA): p from saved lse, then the
-    standard flash-attention gradient identities."""
+    standard flash-attention gradient identities. GQA: k/v may hold
+    Hkv < H heads — expanded here, with dk/dv group-summed back."""
     B, L, H, D = q.shape
+    Hkv = k.shape[2]
+    groups = _gqa_groups(q, k)
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
     qf = q.astype(jnp.float32) * scale
     s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
     band = band_predicate(jnp.arange(L)[:, None], jnp.arange(L)[None, :],
@@ -568,6 +651,10 @@ def _attention_bwd_math(q, k, v, key_mask, lse, g, *, scale, causal,
     ds = p * (dp - row)
     dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32)) * scale
     dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+    if groups > 1:
+        # sum the group's q-head contributions back onto the shared head
+        dk = dk.reshape(B, L, Hkv, groups, D).sum(axis=3)
+        dv = dv.reshape(B, L, Hkv, groups, D).sum(axis=3)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

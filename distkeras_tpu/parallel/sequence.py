@@ -38,10 +38,17 @@ def attention_reference(q, k, v, causal: bool = False, scale=None,
     query ``i`` sees keys ``(i-window, i]`` when causal, ``|i-j| < window``
     otherwise (same contract as ``ops.flash_attention``).
     """
-    from distkeras_tpu.ops.flash_attention import band_predicate
+    from distkeras_tpu.ops.flash_attention import _gqa_groups, band_predicate
 
     if window is not None and int(window) < 1:
         raise ValueError(f"window must be >= 1, got {window}")
+    rep = _gqa_groups(q, k)  # shared validation with the flash kernels
+    if rep > 1:
+        # grouped-query attention: expand the shared K/V heads (query head
+        # h reads kv head h // group — same convention as the flash
+        # kernels' index maps and the LM cache decode)
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     Lq, Lk = s.shape[-2], s.shape[-1]

@@ -463,3 +463,72 @@ def test_attention_dispatch_passes_window(rng):
         out = attention(q, k, v, causal=True, window=50, impl="reference")
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=0, atol=0)
+
+
+# ---------------------------------------------------------------------------
+# Grouped-query attention (kv heads < q heads) — kernel-native
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("hkv", [2, 1])
+@pytest.mark.parametrize("causal", [False, True])
+def test_gqa_flash_matches_reference(rng, hkv, causal):
+    """k/v with Hkv shared heads go straight into the kernels (index-map
+    head grouping, grouped dk/dv accumulation) — forward and all three
+    gradients equal the expanded-KV reference, with Hkv-shaped dk/dv."""
+    Hq = 4
+    q = rng.normal(0, 1, size=(B, L, Hq, D)).astype(np.float32)
+    k = rng.normal(0, 1, size=(B, L, hkv, D)).astype(np.float32)
+    v = rng.normal(0, 1, size=(B, L, hkv, D)).astype(np.float32)
+    cot = rng.normal(size=(B, L, Hq, D)).astype(np.float32)
+    with jax.default_matmul_precision("highest"):
+        out = flash_attention(q, k, v, causal=causal)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+        g = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, causal=causal) * cot),
+            argnums=(0, 1, 2))(q, k, v)
+        r = jax.grad(
+            lambda q, k, v: jnp.sum(
+                attention_reference(q, k, v, causal=causal) * cot),
+            argnums=(0, 1, 2))(q, k, v)
+        assert g[1].shape == (B, L, hkv, D)  # dk stays Hkv-wide
+        for name, gg, rr in zip("qkv", g, r):
+            np.testing.assert_allclose(np.asarray(gg), np.asarray(rr),
+                                       rtol=5e-3, atol=1e-3, err_msg=name)
+
+
+def test_gqa_flash_with_window_and_mask(rng):
+    """GQA × sliding window × key mask, all three in one kernel program."""
+    Hq, hkv = 4, 2
+    q = rng.normal(0, 1, size=(B, L, Hq, D)).astype(np.float32)
+    k = rng.normal(0, 1, size=(B, L, hkv, D)).astype(np.float32)
+    v = rng.normal(0, 1, size=(B, L, hkv, D)).astype(np.float32)
+    mask = np.ones((B, L), np.float32)
+    mask[:, L - 48:] = 0.0
+    cot = rng.normal(size=(B, L, Hq, D)).astype(np.float32)
+    with jax.default_matmul_precision("highest"):
+        g = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, causal=True, window=40,
+                                key_mask=mask) * cot),
+            argnums=(0, 1, 2))(q, k, v)
+        r = jax.grad(
+            lambda q, k, v: jnp.sum(
+                attention_reference(q, k, v, causal=True, window=40,
+                                    key_mask=mask) * cot),
+            argnums=(0, 1, 2))(q, k, v)
+        for name, gg, rr in zip("qkv", g, r):
+            np.testing.assert_allclose(np.asarray(gg), np.asarray(rr),
+                                       rtol=5e-3, atol=1e-3, err_msg=name)
+
+
+def test_gqa_head_divisibility_validated(rng):
+    q = rng.normal(size=(1, 128, 4, 32)).astype(np.float32)
+    kv = rng.normal(size=(1, 128, 3, 32)).astype(np.float32)
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        flash_attention(q, kv, kv)
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        attention_reference(q, kv, kv)
