@@ -49,6 +49,54 @@ _SUFFIX = ".dkc"
 _SHARD_SUFFIX = ".dks"
 
 
+class AsyncCheckpointer:
+    """Background checkpoint writer: ``save()`` snapshots the state to
+    host ON THE CALLER'S THREAD (the engines donate their state buffers,
+    so a background device_get could read freed HBM once the next epoch
+    dispatches — the D2H must complete before training continues), then
+    the serialize + file write — the slow, compressible parts — run on a
+    worker thread overlapping the next epoch's compute. One save in
+    flight: a newer ``save()`` (or ``wait()``) joins the previous one
+    first and re-raises its error, so failures surface at the next
+    checkpoint boundary instead of silently. Multi-process
+    ``jax.distributed`` saves stay synchronous: the sharded writer's
+    cross-process barrier must not run concurrently with training
+    collectives."""
+
+    def __init__(self):
+        self._thread = None
+        self._err: BaseException | None = None
+
+    def save(self, directory, tree: Pytree, step: int, keep: int = 3):
+        if jax.process_count() > 1:
+            save_checkpoint(directory, tree, step, keep)
+            return
+        self.wait()
+        host_tree = jax.tree.map(jax.device_get, tree)  # donation-safe
+
+        def work():
+            try:
+                save_checkpoint(directory, host_tree, step, keep)
+            except BaseException as e:  # surfaced by the next wait()
+                self._err = e
+
+        import threading
+
+        self._thread = threading.Thread(
+            target=work, name=f"distkeras-ckpt-{step}", daemon=True
+        )
+        self._thread.start()
+
+    def wait(self):
+        """Join the in-flight save (if any) and re-raise its failure."""
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        err, self._err = self._err, None
+        if err is not None:
+            raise err
+
+
 def warn_elastic_resume(ckpt_workers: int, trainer_workers: int) -> None:
     """Shared by both backends' resume paths: elastic resume engaged — the
     center carries over, per-worker optimizer state restarts."""

@@ -261,6 +261,29 @@ class Trainer:
         self.log_metrics = False
         self.metrics_: list[dict] = []
 
+    #: checkpoint defaults shared by the subclasses that expose the kwargs
+    checkpoint_async = False
+    _async_ckpt = None
+
+    def _dispatch_checkpoint(self, payload, epoch: int):
+        """One place for the async-or-sync checkpoint write (shared by the
+        collective and GSPMD trainers)."""
+        from distkeras_tpu import checkpoint as ckpt
+
+        if self.checkpoint_async:
+            if self._async_ckpt is None:
+                self._async_ckpt = ckpt.AsyncCheckpointer()
+            self._async_ckpt.save(self.checkpoint_dir, payload, step=epoch)
+        else:
+            ckpt.save_checkpoint(self.checkpoint_dir, payload, step=epoch)
+
+    def _finish_checkpoints(self):
+        """Join any in-flight async save (re-raising its failure) — runs in
+        a ``finally`` so an aborted run never silently drops or kills a
+        checkpoint mid-write."""
+        if self._async_ckpt is not None:
+            self._async_ckpt.wait()
+
     # -- parity bookkeeping API ------------------------------------------
 
     def record_training_start(self):
@@ -377,7 +400,8 @@ class DistributedTrainer(Trainer):
                  ps_transport: str = "inprocess", ps_port: int = 0,
                  ps_host: str | None = None, worker_id_offset: int = 0,
                  checkpoint_dir=None, checkpoint_every: int = 1,
-                 resume: bool = False, profile_dir=None,
+                 resume: bool = False, checkpoint_async: bool = False,
+                 profile_dir=None,
                  log_metrics: bool = False,
                  tolerate_worker_failures: bool = False,
                  clipnorm=None, clipvalue=None, validation_data=None):
@@ -440,10 +464,14 @@ class DistributedTrainer(Trainer):
         self.device_data = device_data
         self.device_data_budget_bytes = 512 * 1024 * 1024
         # Checkpoint/resume (absent in the reference — SURVEY.md §5.4):
-        # snapshot full TrainState every `checkpoint_every` epochs.
+        # snapshot full TrainState every `checkpoint_every` epochs;
+        # checkpoint_async=True writes on a background thread (the next
+        # epoch's compute overlaps the device_get + serialize + write).
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = int(checkpoint_every)
         self.resume = bool(resume)
+        self.checkpoint_async = bool(checkpoint_async)
+        self._async_ckpt = None
         # Observability (SURVEY.md §5.1/§5.5 build notes — beyond-reference):
         # profile_dir writes a jax.profiler trace of the run; log_metrics
         # streams one JSON line per epoch (loss, samples/sec, updates/sec)
@@ -478,6 +506,12 @@ class DistributedTrainer(Trainer):
     def train(self, dataset, shuffle: bool = False):
         ds = self._coerce_dataset(dataset)
         if self.backend == "ps":
+            if self.checkpoint_async:
+                raise ValueError(
+                    "checkpoint_async is not supported on backend='ps' (the "
+                    "hogwild workers checkpoint at a cross-thread barrier); "
+                    "use the collective backend or synchronous checkpoints"
+                )
             if jax.process_count() > 1:
                 # fail fast — hogwild threads are placed over jax.devices(),
                 # which under jax.distributed includes devices this process
@@ -495,10 +529,15 @@ class DistributedTrainer(Trainer):
             jax.profiler.trace(str(self.profile_dir))
             if self.profile_dir else contextlib.nullcontext()
         )
-        with ctx:
-            if self.backend == "ps":
-                return self._train_ps(ds, shuffle)
-            return self._train_collective(ds, shuffle)
+        try:
+            with ctx:
+                if self.backend == "ps":
+                    return self._train_ps(ds, shuffle)
+                return self._train_collective(ds, shuffle)
+        finally:
+            # idempotent join: an aborted run must neither drop the
+            # in-flight async checkpoint nor swallow its failure
+            self._finish_checkpoints()
 
     def _train_collective(self, ds: Dataset, shuffle: bool):
         engine = LocalSGDEngine(
@@ -607,6 +646,7 @@ class DistributedTrainer(Trainer):
                     )
                 self._maybe_checkpoint(state, epoch)
         jax.block_until_ready(state.center)
+        self._finish_checkpoints()
         self.record_training_end()
         self._materialize_history()
         return self._finalize(
@@ -643,9 +683,7 @@ class DistributedTrainer(Trainer):
         if not ckpt.should_checkpoint(epoch, self.checkpoint_every,
                                       self.num_epoch):
             return
-        ckpt.save_checkpoint(
-            self.checkpoint_dir, {"state": state, "epoch": epoch}, step=epoch
-        )
+        self._dispatch_checkpoint({"state": state, "epoch": epoch}, epoch)
 
 class AsynchronousDistributedTrainer(DistributedTrainer):
     """Parity alias: the reference's base class for the five asynchronous
@@ -810,7 +848,8 @@ class MeshTrainer(Trainer):
                  label_col: str = "label", num_epoch: int = 1, seed: int = 0,
                  log_metrics: bool = False,
                  checkpoint_dir=None, checkpoint_every: int = 1,
-                 resume: bool = False, profile_dir=None,
+                 resume: bool = False, checkpoint_async: bool = False,
+                 profile_dir=None,
                  input_mode: str = "auto",
                  clipnorm=None, clipvalue=None, validation_data=None):
         from distkeras_tpu.parallel.strategies import STRATEGIES
@@ -855,6 +894,8 @@ class MeshTrainer(Trainer):
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = int(checkpoint_every)
         self.resume = bool(resume)
+        self.checkpoint_async = bool(checkpoint_async)
+        self._async_ckpt = None
         self.profile_dir = profile_dir
         if input_mode not in ("auto", "stream", "resident"):
             raise ValueError(
@@ -918,6 +959,14 @@ class MeshTrainer(Trainer):
         return engine, to_engine, from_engine
 
     def train(self, dataset, shuffle: bool = False):
+        try:
+            return self._train_impl(dataset, shuffle)
+        finally:
+            # idempotent join: an aborted run must neither drop the
+            # in-flight async checkpoint nor swallow its failure
+            self._finish_checkpoints()
+
+    def _train_impl(self, dataset, shuffle: bool = False):
         _reject_worker_axis_model(
             self.spec, "MeshTrainer (single-model GSPMD, no worker axis)"
         )
@@ -1031,6 +1080,7 @@ class MeshTrainer(Trainer):
                     run_validation(epoch)
                     self._maybe_checkpoint(params, nt, opt, epoch)
         jax.block_until_ready(jax.tree.leaves(params)[0])
+        self._finish_checkpoints()
         self.record_training_end()
         self._materialize_history()
         if jax.process_count() > 1:
@@ -1055,10 +1105,8 @@ class MeshTrainer(Trainer):
         # the engine layout is saved as-is and re-placed on resume;
         # save_checkpoint dispatches per process topology (one host blob
         # single-process, per-controller shard files under jax.distributed)
-        ckpt.save_checkpoint(
-            self.checkpoint_dir,
-            {"params": params, "nt": nt, "opt": opt, "epoch": epoch},
-            step=epoch,
+        self._dispatch_checkpoint(
+            {"params": params, "nt": nt, "opt": opt, "epoch": epoch}, epoch
         )
 
 

@@ -456,3 +456,89 @@ def test_checkpoint_rollback_truncates_abandoned_future(tmp_path):
     assert remaining == {101, 102, 103}
     got, _ = ckpt.restore_checkpoint(tmp_path)
     np.testing.assert_array_equal(got["w"], np.ones(1) * 103)
+
+
+def test_async_checkpoint_resume_equals_sync(tmp_path):
+    """checkpoint_async=True writes on a background thread; the resulting
+    checkpoints resume identically to synchronous ones (jax arrays are
+    immutable, so the in-flight snapshot stays consistent while the next
+    epoch trains)."""
+    import jax
+    from distkeras_tpu import ADAG
+
+    ds = blobs_dataset(n=512)
+    common = dict(loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+                  learning_rate=0.05, num_workers=4, batch_size=16,
+                  communication_window=2, seed=9)
+
+    full = ADAG(model_spec(), num_epoch=2, **common)
+    p_full = full.train(ds)
+
+    d = tmp_path / "ck"
+    t1 = ADAG(model_spec(), num_epoch=1, checkpoint_dir=d,
+              checkpoint_async=True, **common)
+    t1.train(ds)  # train() joins the in-flight save before returning
+    from distkeras_tpu import checkpoint as ckpt
+
+    assert ckpt.latest_step(d) == 0
+    t2 = ADAG(model_spec(), num_epoch=2, checkpoint_dir=d, resume=True,
+              checkpoint_async=True, **common)
+    p_resumed = t2.train(ds)
+    for a, b in zip(jax.tree.leaves(p_full), jax.tree.leaves(p_resumed)):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_async_checkpoint_mesh_trainer(tmp_path):
+    """MeshTrainer async checkpoints: FSDP resume equality, async vs sync."""
+    import jax
+    import jax.numpy as jnp
+    from distkeras_tpu.models import mlp
+    from distkeras_tpu.trainers import MeshTrainer
+
+    from tests.test_trainers import blobs_dataset
+
+    ds = blobs_dataset(n=256)
+    common = dict(loss="sparse_softmax_cross_entropy",
+                  worker_optimizer="adam", learning_rate=1e-3,
+                  mesh_shape={"dp": 8}, parameter_sharding="fsdp",
+                  batch_size=32, seed=5, input_mode="stream")
+    spec = lambda: mlp(input_shape=(16,), hidden=(32,), num_classes=3,
+                       dtype=jnp.float32)
+    p_full = MeshTrainer(spec(), num_epoch=2, **common).train(ds)
+
+    d = tmp_path / "ck"
+    MeshTrainer(spec(), num_epoch=1, checkpoint_dir=d,
+                checkpoint_async=True, **common).train(ds)
+    p_res = MeshTrainer(spec(), num_epoch=2, checkpoint_dir=d, resume=True,
+                        checkpoint_async=True, **common).train(ds)
+    for a, b in zip(jax.tree.leaves(p_full), jax.tree.leaves(p_res)):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_async_checkpoint_error_surfaces(tmp_path):
+    """A failing background save must raise (at the next boundary or at
+    train end), never pass silently."""
+    from distkeras_tpu import checkpoint as ckpt
+
+    ac = ckpt.AsyncCheckpointer()
+    target = tmp_path / "not_a_dir"
+    target.write_text("file, not directory")  # mkdir(parents=True) fails
+    ac.save(target / "sub", {"w": np.ones(2)}, step=0)
+    with pytest.raises((OSError, FileExistsError, NotADirectoryError)):
+        ac.wait()
+    # a later successful save still works on the same checkpointer
+    ac.save(tmp_path / "ok", {"w": np.ones(2)}, step=1)
+    ac.wait()
+    assert ckpt.latest_step(tmp_path / "ok") == 1
+
+
+def test_async_checkpoint_rejected_on_ps_backend():
+    from distkeras_tpu import DOWNPOUR
+
+    ds = blobs_dataset(n=256)
+    t = DOWNPOUR(model_spec(), loss="sparse_softmax_cross_entropy",
+                 worker_optimizer="sgd", learning_rate=0.02, num_workers=2,
+                 batch_size=16, communication_window=2, backend="ps",
+                 checkpoint_dir="/tmp/nope", checkpoint_async=True)
+    with pytest.raises(ValueError, match="checkpoint_async"):
+        t.train(ds)
