@@ -1,0 +1,408 @@
+// dkps — native parameter-server transport core.
+//
+// Parity context: the reference's PS hot loop (reference
+// distkeras/parameter_servers.py :: SocketParameterServer.run and
+// distkeras/networking.py :: send_data/recv_data) served every worker from
+// Python handler threads that pickled/unpickled the full weight set per
+// round-trip while holding the GIL — SURVEY.md §3.3 calls the driver-side
+// loop "GIL-contended" and names it the scalability choke point. This file
+// is the rebuild's native equivalent for the genuinely-asynchronous
+// parameter-server backend (ps_transport="native"): a C++ TCP service whose
+// commit fold is a vectorized saxpy on a contiguous float32 center, with no
+// interpreter, no pickle, and no GIL anywhere on the wire path. The Python
+// side (distkeras_tpu/native_ps.py) only flattens pytrees to one f32 vector
+// at the boundary.
+//
+// Fold semantics are the SAME linear forms MergeRule.fold defines
+// (distkeras_tpu/parallel/merge_rules.py): every built-in rule folds one
+// commit as center += scale * commit, where
+//   ADAG                 scale = 1 / num_workers
+//   DOWNPOUR / elastic   scale = 1
+//   DynSGD               scale = 1 / (tau + 1), tau = center updates since
+//                        that worker's last pull (tracked here, per worker)
+// so MODE_FIXED covers the first three and MODE_INV_STALENESS the last.
+//
+// Wire protocol (little-endian, fixed-size frames — the payload length is
+// pinned by the handshake, so a hostile frame can never trigger an
+// attacker-sized allocation):
+//   handshake: 6-byte magic "DKPS1\n" + u32 worker_id + u64 n_floats
+//              server replies u8 (1 = accepted, 0 = length mismatch)
+//   request:   u8 action; 1=PULL, 2=COMMIT (followed by n*4 payload bytes),
+//              3=BYE
+//   reply:     PULL -> u64 center_version + n*4 bytes; COMMIT -> u8 ack
+//
+// Concurrency model matches the reference: accept loop + one handler thread
+// per connection + one mutex around the center. The difference is what runs
+// inside the lock: a memcpy or an auto-vectorized fused multiply-add over
+// the flat center, not a Python bytecode loop.
+
+#include <algorithm>
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[6] = {'D', 'K', 'P', 'S', '1', '\n'};
+constexpr int MODE_FIXED = 0;
+constexpr int MODE_INV_STALENESS = 1;
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k <= 0) {
+      if (k < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) {
+      if (k < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+void set_nodelay(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+struct Server {
+  std::vector<float> center;
+  uint64_t n = 0;
+  int mode = MODE_FIXED;
+  double fixed_scale = 1.0;
+  std::mutex mu;
+  uint64_t num_updates = 0;
+  std::unordered_map<uint32_t, uint64_t> pull_versions;
+
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> running{false};
+  std::thread accept_thread;
+  std::mutex conn_mu;
+  std::vector<int> conn_fds;
+  std::vector<std::thread> handlers;
+
+  void handle(int fd) {
+    std::vector<float> buf(n);
+    for (;;) {
+      uint8_t action;
+      if (!recv_all(fd, &action, 1)) break;
+      if (action == 1) {  // PULL
+        uint64_t version;
+        {
+          // copy under the lock, send outside it: a slow client must not
+          // serialize every other worker's fold behind its TCP window
+          std::lock_guard<std::mutex> g(mu);
+          version = num_updates;
+          // staleness bookkeeping, exactly the Python PS's pull():
+          // tau at the next commit = center updates since this pull
+          pull_versions[conn_wid_] = num_updates;
+          std::memcpy(buf.data(), center.data(), n * sizeof(float));
+        }
+        if (!send_all(fd, &version, 8)) break;
+        if (!send_all(fd, buf.data(), n * sizeof(float))) break;
+      } else if (action == 2) {  // COMMIT
+        if (!recv_all(fd, buf.data(), n * sizeof(float))) break;
+        uint8_t ack = 1;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          float s = static_cast<float>(fixed_scale);
+          if (mode == MODE_INV_STALENESS) {
+            uint64_t pv = 0;
+            auto it = pull_versions.find(conn_wid_);
+            if (it != pull_versions.end()) pv = it->second;
+            uint64_t tau = num_updates - pv;
+            s = static_cast<float>(1.0 / (static_cast<double>(tau) + 1.0));
+          }
+          float* c = center.data();
+          const float* d = buf.data();
+          for (uint64_t i = 0; i < n; ++i) c[i] += d[i] * s;
+          num_updates += 1;
+        }
+        if (!send_all(fd, &ack, 1)) break;
+      } else {  // BYE or garbage: drop the connection either way
+        break;
+      }
+    }
+    {
+      // prune BEFORE closing: stop() must never shutdown() a descriptor
+      // number the kernel has already reused for something else
+      std::lock_guard<std::mutex> g(conn_mu);
+      conn_fds.erase(std::remove(conn_fds.begin(), conn_fds.end(), fd),
+                     conn_fds.end());
+    }
+    ::close(fd);
+  }
+
+  // per-handler worker id — set via the thread entry, see serve_conn
+  static thread_local uint32_t conn_wid_;
+
+  void serve_conn(int fd, uint32_t wid) {
+    conn_wid_ = wid;
+    handle(fd);
+  }
+
+  void record_pull_version(uint32_t wid) {
+    std::lock_guard<std::mutex> g(mu);
+    pull_versions[wid] = num_updates;
+  }
+};
+
+thread_local uint32_t Server::conn_wid_ = 0;
+
+struct Client {
+  int fd = -1;
+  uint64_t n = 0;
+  uint32_t wid = 0;
+};
+
+int connect_to(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  set_nodelay(fd);
+  return fd;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- server --
+
+void* dkps_server_create(const float* init, uint64_t n, int mode,
+                         double fixed_scale, const char* host, int port) {
+  auto* s = new Server();
+  s->center.assign(init, init + n);
+  s->n = n;
+  s->mode = mode;
+  s->fixed_scale = fixed_scale;
+
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      ::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+          0 ||
+      ::listen(s->listen_fd, 64) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  sockaddr_in bound{};
+  socklen_t blen = sizeof(bound);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&bound), &blen);
+  s->port = ntohs(bound.sin_port);
+  return s;
+}
+
+int dkps_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+int dkps_server_start(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->running = true;
+  s->accept_thread = std::thread([s] {
+    while (s->running) {
+      int fd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (s->running && (errno == EINTR || errno == ECONNABORTED)) continue;
+        break;
+      }
+      if (!s->running) {
+        ::close(fd);
+        break;
+      }
+      set_nodelay(fd);
+      // handshake: magic + worker_id + n; reject on any mismatch
+      char magic[6];
+      uint32_t wid;
+      uint64_t cn;
+      if (!recv_all(fd, magic, 6) || std::memcmp(magic, kMagic, 6) != 0 ||
+          !recv_all(fd, &wid, 4) || !recv_all(fd, &cn, 8)) {
+        ::close(fd);
+        continue;
+      }
+      uint8_t ok = (cn == s->n) ? 1 : 0;
+      if (!send_all(fd, &ok, 1) || !ok) {
+        ::close(fd);
+        continue;
+      }
+      std::lock_guard<std::mutex> g(s->conn_mu);
+      s->conn_fds.push_back(fd);
+      s->handlers.emplace_back([s, fd, wid] { s->serve_conn(fd, wid); });
+    }
+  });
+  return 0;
+}
+
+void dkps_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  if (!s->running.exchange(false)) return;
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> g(s->conn_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : s->handlers)
+    if (t.joinable()) t.join();
+}
+
+void dkps_server_destroy(void* h) {
+  auto* s = static_cast<Server*>(h);
+  dkps_server_stop(s);
+  delete s;
+}
+
+uint64_t dkps_server_num_updates(void* h) {
+  auto* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->num_updates;
+}
+
+void dkps_server_set_num_updates(void* h, uint64_t v) {
+  auto* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  s->num_updates = v;
+}
+
+void dkps_server_get_center(void* h, float* out) {
+  auto* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  std::memcpy(out, s->center.data(), s->n * sizeof(float));
+}
+
+void dkps_server_set_center(void* h, const float* in) {
+  auto* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  std::memcpy(s->center.data(), in, s->n * sizeof(float));
+}
+
+// record a pull version server-side (used by the in-process owner when it
+// folds without the wire; wire pulls record via the PULL action below)
+void dkps_server_record_pull(void* h, uint32_t wid) {
+  static_cast<Server*>(h)->record_pull_version(wid);
+}
+
+// ---------------------------------------------------------------- client --
+
+static void* client_handshake(int fd, uint32_t wid, uint64_t n) {
+  char hello[6 + 4 + 8];
+  std::memcpy(hello, kMagic, 6);
+  std::memcpy(hello + 6, &wid, 4);
+  std::memcpy(hello + 10, &n, 8);
+  uint8_t ok = 0;
+  if (!send_all(fd, hello, sizeof(hello)) || !recv_all(fd, &ok, 1) || !ok) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* c = new Client();
+  c->fd = fd;
+  c->n = n;
+  c->wid = wid;
+  return c;
+}
+
+void* dkps_client_connect(const char* host, int port, uint32_t wid,
+                          uint64_t n) {
+  int fd = connect_to(host, port);
+  if (fd < 0) return nullptr;
+  return client_handshake(fd, wid, n);
+}
+
+// Adopt an already-connected (blocking-mode) socket — DNS resolution,
+// IPv6, and connect timeouts stay the caller's (Python's) problem; the
+// hot-path framing stays native. Closes fd on handshake failure.
+void* dkps_client_from_fd(int fd, uint32_t wid, uint64_t n) {
+  set_nodelay(fd);
+  return client_handshake(fd, wid, n);
+}
+
+// Bound every subsequent pull/commit round-trip: a wedged server makes the
+// call fail with a transport error instead of hanging the caller forever.
+int dkps_client_set_timeout_ms(void* h, int ms) {
+  auto* c = static_cast<Client*>(h);
+  timeval tv{};
+  tv.tv_sec = ms / 1000;
+  tv.tv_usec = (ms % 1000) * 1000;
+  if (::setsockopt(c->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv)) != 0)
+    return -1;
+  return ::setsockopt(c->fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
+// pull: returns the center version (>= 0) or -1 on transport failure
+int64_t dkps_client_pull(void* h, float* out) {
+  auto* c = static_cast<Client*>(h);
+  uint8_t action = 1;
+  uint64_t version;
+  if (!send_all(c->fd, &action, 1) || !recv_all(c->fd, &version, 8) ||
+      !recv_all(c->fd, out, c->n * sizeof(float)))
+    return -1;
+  return static_cast<int64_t>(version);
+}
+
+int dkps_client_commit(void* h, const float* buf) {
+  auto* c = static_cast<Client*>(h);
+  uint8_t action = 2;
+  uint8_t ack = 0;
+  if (!send_all(c->fd, &action, 1) ||
+      !send_all(c->fd, buf, c->n * sizeof(float)) ||
+      !recv_all(c->fd, &ack, 1) || ack != 1)
+    return -1;
+  return 0;
+}
+
+void dkps_client_close(void* h) {
+  auto* c = static_cast<Client*>(h);
+  uint8_t action = 3;
+  send_all(c->fd, &action, 1);
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

@@ -1,0 +1,254 @@
+"""Native parameter-server transport — C++ service, flat-f32 wire, no GIL.
+
+Parity context: the reference's socket PS (reference
+``distkeras/parameter_servers.py :: SocketParameterServer`` +
+``distkeras/networking.py``) pickled the full weight set per round-trip and
+folded commits in Python handler threads holding the GIL — SURVEY.md §3.3
+names that loop the scalability choke point. ``ps_transport="native"`` swaps
+the whole wire path for the C++ core in ``native/dkps.cpp``: weights travel
+as one contiguous float32 vector (no pickle; frame sizes pinned at
+handshake, so no attacker-sized allocations either), the commit fold is a
+vectorized ``center += scale * commit`` under a C++ mutex, and every ctypes
+call releases the GIL — worker threads pull/commit truly concurrently.
+
+The fold math is the SAME linear form every built-in ``MergeRule.fold``
+defines (``parallel/merge_rules.py``): ADAG scales commits by
+``1/num_workers``, DOWNPOUR and the elastic rules by ``1``, DynSGD by
+``1/(τ+1)`` with τ tracked per worker server-side — so both socket and
+native transports are pinned to the same oracle by the tests. Custom merge
+rules with non-linear folds must use ``ps_transport="socket"``; the
+constructor rejects them.
+
+Pytree ↔ wire translation happens once per call at the Python boundary
+(:class:`FlatSpec`): leaves are raveled C-order into one float32 vector in
+canonical ``jax.tree.flatten`` order, and restored to their original shapes
+and dtypes on the way out.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import Any
+
+import numpy as np
+
+from distkeras_tpu.native import load_dkps
+from distkeras_tpu.parallel.merge_rules import (
+    ADAGMerge,
+    DownpourMerge,
+    DynSGDMerge,
+    ElasticAverageMerge,
+    MergeRule,
+)
+
+Pytree = Any
+
+_MODE_FIXED = 0
+_MODE_INV_STALENESS = 1
+
+
+def fold_mode(rule: MergeRule, num_workers: int) -> tuple[int, float]:
+    """Map a built-in merge rule to the server's (mode, fixed_scale).
+
+    Mirrors each rule's ``fold``: ADAG ``c + d/W``; DOWNPOUR/elastic
+    ``c + d``; DynSGD ``c + d/(τ+1)``.
+    """
+    if isinstance(rule, DynSGDMerge):
+        return _MODE_INV_STALENESS, 1.0
+    if isinstance(rule, ADAGMerge):
+        return _MODE_FIXED, 1.0 / float(num_workers)
+    if isinstance(rule, (DownpourMerge, ElasticAverageMerge)):
+        return _MODE_FIXED, 1.0
+    raise ValueError(
+        f"ps_transport='native' supports the built-in linear merge rules "
+        f"(ADAG/DOWNPOUR/elastic/DynSGD); {type(rule).__name__} defines an "
+        f"arbitrary fold — use ps_transport='socket'"
+    )
+
+
+class FlatSpec:
+    """Shape/dtype spec translating a numpy pytree ↔ one float32 vector."""
+
+    def __init__(self, template: Pytree):
+        import jax
+
+        leaves, self.treedef = jax.tree.flatten(template)
+        self.shapes = [np.shape(l) for l in leaves]
+        self.dtypes = [np.asarray(l).dtype for l in leaves]
+        self.sizes = [int(np.prod(s, dtype=np.int64)) for s in self.shapes]
+        self.n = int(sum(self.sizes))
+
+    def flatten(self, tree: Pytree) -> np.ndarray:
+        import jax
+
+        leaves = jax.tree.leaves(tree)
+        if len(leaves) != len(self.sizes):
+            raise ValueError(
+                f"tree has {len(leaves)} leaves, spec expects {len(self.sizes)}"
+            )
+        out = np.empty(self.n, dtype=np.float32)
+        off = 0
+        for leaf, size in zip(leaves, self.sizes):
+            out[off:off + size] = np.ravel(
+                np.asarray(leaf, dtype=np.float32), order="C"
+            )
+            off += size
+        return out
+
+    def unflatten(self, vec: np.ndarray) -> Pytree:
+        import jax
+
+        leaves = []
+        off = 0
+        for shape, dtype, size in zip(self.shapes, self.dtypes, self.sizes):
+            leaves.append(
+                vec[off:off + size].reshape(shape).astype(dtype, copy=False)
+            )
+            off += size
+        return jax.tree.unflatten(self.treedef, leaves)
+
+
+def _f32p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class NativeSocketParameterServer:
+    """C++ TCP parameter server with the ``SocketParameterServer`` surface.
+
+    ``initialize()`` binds (resolving an ephemeral port), ``start()`` runs
+    the C++ accept loop, ``stop()`` shuts it down and joins every handler.
+    ``get_model()``/``num_updates`` read the center under the C++ mutex.
+    """
+
+    def __init__(self, center: Pytree, rule: MergeRule, num_workers: int,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._lib = load_dkps(required=True)
+        self.spec = FlatSpec(center)
+        self.rule = rule
+        self.num_workers = int(num_workers)
+        self.host = host
+        self.port = int(port)
+        self._requested_port = int(port)
+        self._handle = None
+        self._init_vec = self.spec.flatten(center)
+
+    def initialize(self) -> None:
+        mode, scale = fold_mode(self.rule, self.num_workers)
+        h = self._lib.dkps_server_create(
+            _f32p(self._init_vec), self.spec.n, mode, scale,
+            self.host.encode(), self._requested_port,
+        )
+        if not h:
+            raise OSError(
+                f"dkps server failed to bind {self.host}:{self._requested_port}"
+            )
+        self._handle = h
+        self.port = int(self._lib.dkps_server_port(h))
+
+    def start(self) -> None:
+        self._lib.dkps_server_start(self._handle)
+
+    def run(self) -> None:  # surface parity; the accept loop is a C++ thread
+        self.start()
+
+    def stop(self) -> None:
+        if self._handle is not None:
+            self._lib.dkps_server_stop(self._handle)
+
+    def __del__(self):
+        if getattr(self, "_handle", None) is not None:
+            self._lib.dkps_server_destroy(self._handle)
+            self._handle = None
+
+    # -- center access -------------------------------------------------------
+
+    @property
+    def num_updates(self) -> int:
+        if self._handle is None:
+            return 0
+        return int(self._lib.dkps_server_num_updates(self._handle))
+
+    @num_updates.setter
+    def num_updates(self, v: int) -> None:
+        self._lib.dkps_server_set_num_updates(self._handle, int(v))
+
+    def get_model(self) -> Pytree:
+        out = np.empty(self.spec.n, dtype=np.float32)
+        self._lib.dkps_server_get_center(self._handle, _f32p(out))
+        return self.spec.unflatten(out)
+
+    def set_model(self, tree: Pytree) -> None:
+        vec = np.ascontiguousarray(self.spec.flatten(tree))
+        self._lib.dkps_server_set_center(self._handle, _f32p(vec))
+
+
+class NativePSClient:
+    """Worker-side proxy over the C ABI — same call surface as
+    ``ParameterServerClient``, GIL released for the whole round-trip."""
+
+    def __init__(self, host: str, port: int, worker_id: int, spec: FlatSpec,
+                 connect_timeout: float = 30.0):
+        import socket as _socket
+
+        self._lib = load_dkps(required=True)
+        self.worker_id = int(worker_id)
+        self.spec = spec
+        # Python owns connection establishment (DNS names, IPv6, connect
+        # timeout — same semantics as networking.connect); C adopts the fd
+        # for the hot-path framing. Blocking mode must be restored before
+        # the handover: a create_connection timeout leaves O_NONBLOCK set.
+        try:
+            sock = _socket.create_connection(
+                (host, int(port)), timeout=connect_timeout
+            )
+        except OSError as e:
+            raise ConnectionError(
+                f"dkps client could not connect to {host}:{port}: {e}"
+            ) from e
+        sock.settimeout(None)  # clear O_NONBLOCK before the C side recv()s
+        # …but keep the handshake itself bounded (a silent listener must not
+        # hang us): SO_RCVTIMEO survives the fd handover, unlike settimeout
+        sec = max(1, int(connect_timeout))
+        tv = struct.pack("ll", sec, 0)
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVTIMEO, tv)
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDTIMEO, tv)
+        self._handle = self._lib.dkps_client_from_fd(
+            sock.detach(), self.worker_id, spec.n
+        )
+        if not self._handle:
+            raise ConnectionError(
+                f"dkps handshake with {host}:{port} failed (is it a dkps "
+                f"server, and does its vector length match {spec.n}?)"
+            )
+        # blocking round-trips by default, like ParameterServerClient (a
+        # pull may legitimately wait behind many commits)
+        self.set_timeout(None)
+
+    def pull(self, worker_id: int | None = None) -> Pytree:
+        out = np.empty(self.spec.n, dtype=np.float32)
+        version = self._lib.dkps_client_pull(self._handle, _f32p(out))
+        if version < 0:
+            raise ConnectionError("dkps pull failed (server gone?)")
+        return self.spec.unflatten(out)
+
+    def commit(self, worker_id: int | None, payload: Pytree) -> None:
+        vec = np.ascontiguousarray(self.spec.flatten(payload))
+        if self._lib.dkps_client_commit(self._handle, _f32p(vec)) != 0:
+            raise ConnectionError("dkps commit failed (server gone?)")
+
+    def set_timeout(self, seconds: float | None) -> None:
+        """Bound every subsequent round-trip (0/None = block forever)."""
+        ms = 0 if seconds is None else max(1, int(seconds * 1000))
+        self._lib.dkps_client_set_timeout_ms(self._handle, ms)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.dkps_client_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -429,12 +429,14 @@ class DistributedTrainer(Trainer):
         if backend not in ("collective", "ps"):
             raise ValueError(f"backend must be 'collective' or 'ps', got {backend!r}")
         self.backend = backend
-        # PS-backend options: in-process shared-memory PS (single host) or a
-        # TCP socket PS (the DCN/multi-slice story).
-        if ps_transport not in ("inprocess", "socket"):
+        # PS-backend options: in-process shared-memory PS (single host), a
+        # TCP socket PS (the DCN/multi-slice story), or the C++ native PS
+        # (same TCP story with a pickle-free flat-f32 wire and a GIL-free
+        # fold — distkeras_tpu/native_ps.py).
+        if ps_transport not in ("inprocess", "socket", "native"):
             raise ValueError(
-                f"ps_transport must be 'inprocess' or 'socket', got "
-                f"{ps_transport!r}"
+                f"ps_transport must be 'inprocess', 'socket', or 'native', "
+                f"got {ps_transport!r}"
             )
         self.ps_transport = ps_transport
         self.ps_port = ps_port
@@ -443,10 +445,10 @@ class DistributedTrainer(Trainer):
         # remote executors, reference ``distkeras/parameter_servers.py ::
         # SocketParameterServer``). The PS owner decides the global worker
         # count; worker_id_offset de-conflicts ids across trainer processes.
-        if ps_host is not None and ps_transport != "socket":
+        if ps_host is not None and ps_transport not in ("socket", "native"):
             raise ValueError(
-                "ps_host requires ps_transport='socket' (an external PS is "
-                "only reachable over TCP)"
+                "ps_host requires ps_transport='socket' or 'native' (an "
+                "external PS is only reachable over TCP)"
             )
         self.ps_host = ps_host
         self.worker_id_offset = int(worker_id_offset)

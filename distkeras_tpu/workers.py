@@ -244,11 +244,38 @@ def run_async_training(trainer, ds, shuffle: bool):
                 "the center lives in the PS owner's process"
             )
         ps = None
+        if transport == "native":
+            from distkeras_tpu.native_ps import FlatSpec, NativePSClient
+
+            flat_spec = FlatSpec(params)
+            clients = [
+                NativePSClient(
+                    external_host, int(getattr(trainer, "ps_port", 0)),
+                    offset + i, flat_spec,
+                )
+                for i in range(W)
+            ]
+        else:
+            clients = [
+                ParameterServerClient(
+                    external_host, int(getattr(trainer, "ps_port", 0)),
+                    offset + i,
+                )
+                for i in range(W)
+            ]
+    elif transport == "native":
+        from distkeras_tpu.native_ps import (
+            NativePSClient,
+            NativeSocketParameterServer,
+        )
+
+        ps = NativeSocketParameterServer(
+            params, rule, W, port=getattr(trainer, "ps_port", 0)
+        )
+        ps.initialize()
+        ps.start()
         clients = [
-            ParameterServerClient(
-                external_host, int(getattr(trainer, "ps_port", 0)), offset + i
-            )
-            for i in range(W)
+            NativePSClient("127.0.0.1", ps.port, i, ps.spec) for i in range(W)
         ]
     elif transport == "socket":
         ps = SocketParameterServer(
@@ -362,7 +389,10 @@ def run_async_training(trainer, ds, shuffle: bool):
         # external PS: the final center belongs to its owner — take a last
         # snapshot over the wire (bounded: training is done, a stuck server
         # must not hang the driver), leave the server running
-        clients[0]._sock.settimeout(60)
+        if hasattr(clients[0], "_sock"):
+            clients[0]._sock.settimeout(60)
+        else:
+            clients[0].set_timeout(60.0)  # native client: same bound
         try:
             final_center = clients[0].pull()
         except OSError as e:
@@ -370,7 +400,7 @@ def run_async_training(trainer, ds, shuffle: bool):
                 f"training finished but the external PS at {external_host} "
                 f"stopped answering the final pull: {e}"
             ) from e
-    if transport == "socket":
+    if transport in ("socket", "native"):
         for c in clients:
             c.close()
     if ps is not None:

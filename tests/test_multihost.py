@@ -143,12 +143,14 @@ def test_two_process_mesh_trainer_fsdp_matches_single_process(tmp_path):
 
 
 @pytest.mark.slow
-def test_cross_process_socket_ps_downpour(tmp_path):
-    """The socket PS really serves REMOTE workers: two LocalRunner worker
-    processes train DOWNPOUR over TCP against a PS in THIS process (the
-    reference's driver-hosted PS serving Spark executors — reference
+@pytest.mark.parametrize("transport", ["socket", "native"])
+def test_cross_process_ps_downpour(tmp_path, transport):
+    """The socket/native PS really serves REMOTE workers: two LocalRunner
+    worker processes train DOWNPOUR over TCP against a PS in THIS process
+    (the reference's driver-hosted PS serving Spark executors — reference
     ``distkeras/parameter_servers.py :: SocketParameterServer``). Pins the
-    DCN/multi-slice claim: every pull/commit crosses a process boundary.
+    DCN/multi-slice claim: every pull/commit crosses a process boundary —
+    for both the Python pickle wire and the C++ flat-f32 wire.
     """
     import jax.numpy as jnp
 
@@ -161,9 +163,19 @@ def test_cross_process_socket_ps_downpour(tmp_path):
     spec = mlp(input_shape=(28,), hidden=(32,), num_classes=2,
                dtype=jnp.float32)
     params0, _ = spec.init_np(7)
-    ps = SocketParameterServer(
-        params0, DownpourMerge(), W_PER * N_PROC, host="127.0.0.1"
-    )
+    if transport == "native":
+        from distkeras_tpu.native import load_dkps
+        from distkeras_tpu.native_ps import NativeSocketParameterServer
+
+        if load_dkps() is None:
+            pytest.skip("no C++ toolchain to build libdkps")
+        ps = NativeSocketParameterServer(
+            params0, DownpourMerge(), W_PER * N_PROC, host="127.0.0.1"
+        )
+    else:
+        ps = SocketParameterServer(
+            params0, DownpourMerge(), W_PER * N_PROC, host="127.0.0.1"
+        )
     ps.initialize()
     ps.start()
     try:
@@ -191,7 +203,7 @@ def test_cross_process_socket_ps_downpour(tmp_path):
                 loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
                 learning_rate=0.05, num_workers={W_PER}, batch_size={BATCH},
                 communication_window={WINDOW}, num_epoch=1, seed=7 + pid,
-                backend="ps", ps_transport="socket", ps_host="127.0.0.1",
+                backend="ps", ps_transport={transport!r}, ps_host="127.0.0.1",
                 ps_port=int(os.environ["DK_PS_PORT"]),
                 worker_id_offset=pid * {W_PER},
             )
