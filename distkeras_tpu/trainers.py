@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import time
 from typing import Any, Callable, Sequence
 
@@ -49,7 +50,7 @@ from distkeras_tpu.parallel.merge_rules import (
     ElasticAverageMerge,
     MergeRule,
 )
-from distkeras_tpu.parallel.mesh import get_mesh
+from distkeras_tpu.parallel.mesh import get_mesh, put_global
 
 
 def _with_clipping(base, clipnorm, clipvalue):
@@ -147,6 +148,22 @@ def _fits_device_budget(ds: Dataset, cols, budget_bytes: int) -> bool:
     return len(ds) * row_bytes <= budget_bytes
 
 
+def _profile_trace_ctx(profile_dir):
+    """``jax.profiler.trace`` context for a training run (or a no-op).
+
+    Under multi-process ``jax.distributed`` each controller traces into its
+    own ``process{i}/`` subdirectory: jax profiler traces are per-process,
+    and two controllers on one host writing the same directory would
+    interleave their session files.
+    """
+    if not profile_dir:
+        return contextlib.nullcontext()
+    path = str(profile_dir)
+    if jax.process_count() > 1:
+        path = os.path.join(path, f"process{jax.process_index()}")
+    return jax.profiler.trace(path)
+
+
 class _Validator:
     """Per-epoch held-out evaluation (beyond-reference; the reference only
     ever evaluated after training, via ``evaluators.py`` — SURVEY.md §2b #17).
@@ -166,10 +183,12 @@ class _Validator:
     """
 
     def __init__(self, spec: ModelSpec, loss_fn: Callable, ds: Dataset,
-                 features_col: list[str], label_col: str, batch_size: int):
+                 features_col: list[str], label_col: str, batch_size: int,
+                 mesh=None):
         if len(ds) == 0:
             raise ValueError("validation_data has 0 rows")
         self.ds = ds
+        self.mesh = mesh
         self.cols = list(features_col) + [label_col]
         self.bs = int(batch_size)
         n_feat = len(features_col)
@@ -203,10 +222,28 @@ class _Validator:
     def __call__(self, params, nt) -> dict:
         n = len(self.ds)
         cols = [np.asarray(self.ds[c]) for c in self.cols]
+        # Multi-controller SPMD: when the params being scored span devices
+        # this process cannot address, the jitted eval is a GLOBAL program —
+        # host batches must enter as global (replicated) arrays, and every
+        # controller runs the same chunk loop in lockstep (the framework's
+        # standard multi-host data plane; see parallel.mesh.put_global).
+        # Host-resident params (e.g. a gathered pipeline layout) keep the
+        # plain process-local eval.
+        rep = None
+        if self.mesh is not None and jax.process_count() > 1 and any(
+            isinstance(l, jax.Array) and not l.is_fully_addressable
+            for l in jax.tree.leaves((params, nt))
+        ):
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(self.mesh, PartitionSpec())
         loss_sum, correct_sum, acc_defined = 0.0, 0.0, True
         for chunk, real in padded_chunks(cols, self.bs):
             mask = np.zeros(self.bs, np.float32)
             mask[:real] = 1.0
+            if rep is not None:
+                chunk = [put_global(c, rep) for c in chunk]
+                mask = put_global(mask, rep)
             ls, cs = self._eval(params, nt, tuple(chunk), mask)
             loss_sum += float(ls)
             cs = float(cs)
@@ -327,6 +364,7 @@ class Trainer:
             self.spec, self.loss_fn,
             self._coerce_dataset(self.validation_data),
             self.features_col, self.label_col, self.batch_size,
+            mesh=getattr(self, "mesh", None),
         )
 
     def _validate_epoch(self, validator, params, nt, epoch):
@@ -399,6 +437,7 @@ class DistributedTrainer(Trainer):
                  device_data: bool | None = None,
                  ps_transport: str = "inprocess", ps_port: int = 0,
                  ps_host: str | None = None, worker_id_offset: int = 0,
+                 compression=None,
                  checkpoint_dir=None, checkpoint_every: int = 1,
                  resume: bool = False, checkpoint_async: bool = False,
                  profile_dir=None,
@@ -452,6 +491,25 @@ class DistributedTrainer(Trainer):
             )
         self.ps_host = ps_host
         self.worker_id_offset = int(worker_id_offset)
+        # Lossy commit compression for the PS/DCN path ("int8" / "topk" /
+        # a parallel.compression.Codec) with worker-side error feedback —
+        # see parallel/compression.py. The collective backend's merges are
+        # XLA psums over ICI, where compression has nothing to buy.
+        if compression is not None:
+            from distkeras_tpu.parallel.compression import resolve_codec
+
+            resolve_codec(compression)  # fail fast on bad values
+            if backend != "ps":
+                raise ValueError(
+                    "compression applies to backend='ps' only (collective "
+                    "merges ride ICI psums, not a wire)"
+                )
+            if ps_transport == "native":
+                raise ValueError(
+                    "compression is not supported on ps_transport='native' "
+                    "(its C++ wire is flat f32); use 'socket' or 'inprocess'"
+                )
+        self.compression = compression
         # device_data=True stages each epoch in HBM and scans all windows in
         # one dispatch; None = auto (on when the epoch fits the budget).
         # NOTE on shuffle semantics: with shuffle=False the two paths are
@@ -527,10 +585,7 @@ class DistributedTrainer(Trainer):
             _reject_worker_axis_model(
                 self.spec, "backend='ps' (independent hogwild host threads)"
             )
-        ctx = (
-            jax.profiler.trace(str(self.profile_dir))
-            if self.profile_dir else contextlib.nullcontext()
-        )
+        ctx = _profile_trace_ctx(self.profile_dir)
         try:
             with ctx:
                 if self.backend == "ps":
@@ -972,23 +1027,14 @@ class MeshTrainer(Trainer):
         _reject_worker_axis_model(
             self.spec, "MeshTrainer (single-model GSPMD, no worker axis)"
         )
-        if self.profile_dir and jax.process_count() > 1:
-            raise NotImplementedError(
-                "profile_dir under multi-process jax.distributed is not "
-                "supported yet; profile from a single-process mesh"
-            )
         # checkpoint_dir works multi-process: saves dispatch to the
         # process-sharded format (checkpoint._save_sharded) and restores
-        # reassemble global arrays on every controller
+        # reassemble global arrays on every controller.  profile_dir and
+        # validation_data work multi-process too: per-process trace subdirs
+        # (_profile_trace_ctx) and global-array eval batches (_Validator).
         ds = self._coerce_dataset(dataset)
         cols = self.features_col + [self.label_col]
         engine, to_engine, from_engine = self._build_engine()
-        if self.validation_data is not None and jax.process_count() > 1:
-            raise NotImplementedError(
-                "validation_data under multi-process jax.distributed is "
-                "not supported yet (the per-epoch gather would device_get "
-                "shards this process cannot address)"
-            )
         validator = self._make_validator()
 
         def run_validation(epoch):
@@ -1002,12 +1048,20 @@ class MeshTrainer(Trainer):
                 return
             # pipeline/sequence/expert layouts need the from_engine
             # re-layout, which today goes through host (full-pytree gather
-            # per epoch — fine for models these strategies train here)
-            p_std = from_engine(
-                jax.tree.map(np.asarray, jax.device_get(params))
-            )
-            nt_std = jax.tree.map(np.asarray, jax.device_get(nt))
-            self._validate_epoch(validator, p_std, nt_std, epoch)
+            # per epoch — fine for models these strategies train here);
+            # under jax.distributed the gather must be the cross-process
+            # allgather (some shards live on devices this controller
+            # cannot address), after which eval runs process-locally
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                host_p = multihost_utils.process_allgather(params, tiled=True)
+                host_nt = multihost_utils.process_allgather(nt, tiled=True)
+            else:
+                host_p = jax.tree.map(np.asarray, jax.device_get(params))
+                host_nt = jax.tree.map(np.asarray, jax.device_get(nt))
+            self._validate_epoch(validator, from_engine(host_p), host_nt,
+                                 epoch)
 
         start_epoch = 0
         restored = None
@@ -1035,10 +1089,7 @@ class MeshTrainer(Trainer):
             ),
         }[self.input_mode]
 
-        ctx = (
-            jax.profiler.trace(str(self.profile_dir))
-            if self.profile_dir else contextlib.nullcontext()
-        )
+        ctx = _profile_trace_ctx(self.profile_dir)
         self.record_training_start()
         with ctx:
             if use_resident:
