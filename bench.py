@@ -418,7 +418,7 @@ def run_lm_decode_config(accel):
     KV-cache-bandwidth-bound — the cache is read end to end every step — so
     the GQA/MQA legs (kv_heads=2/1: 4x/8x smaller caches) are the
     performance configurations."""
-    from distkeras_tpu.models import generate, transformer_lm
+    from distkeras_tpu.models import generate, quantize_lm, transformer_lm
 
     B, PROMPT, NEW = 8, 128, 256
     out = {}
@@ -429,12 +429,19 @@ def run_lm_decode_config(accel):
         # the other cache lever: a sliding window shrinks the cache LENGTH
         # (ring buffer of `window` slots instead of maxlen)
         ("lm_decode_win256", None, 256),
+        # the WEIGHT lever: int8 weight-only serving (ops/quant.py Pallas
+        # kernel — int8 HBM reads, in-VMEM dequant). MQA already shrank the
+        # cache 8x, so per-step bytes are weight-dominated — exactly the
+        # regime quantization halves.
+        ("lm_decode_mqa_int8", 1, None),
     ):
         spec = transformer_lm(vocab=8192, maxlen=2048, dim=512, heads=8,
                               depth=8, dtype=jax.numpy.bfloat16,
                               attn_impl="flash", pos_embedding="rope",
                               kv_heads=kvh, attn_window=window)
         params, _ = spec.init_np(0)
+        if name.endswith("_int8"):
+            spec, params = quantize_lm(spec, params)
         params = jax.device_put(params, accel)
         rng = np.random.default_rng(0)
         prompt = rng.integers(0, 8192, size=(B, PROMPT)).astype(np.int32)
@@ -468,6 +475,9 @@ def run_lm_decode_config(accel):
         "mqa_vs_mha": round(out["lm_decode_mqa"]["decode_tokens_per_sec"]
                             / out["lm_decode_mha"]["decode_tokens_per_sec"],
                             2),
+        "int8_vs_mqa": round(
+            out["lm_decode_mqa_int8"]["decode_tokens_per_sec"]
+            / out["lm_decode_mqa"]["decode_tokens_per_sec"], 2),
     }))
     return out
 

@@ -35,6 +35,7 @@ from distkeras_tpu.models.lm import (
     TransformerLM,
     generate,
     next_token_dataset,
+    quantize_lm,
     transformer_lm,
 )
 from distkeras_tpu.models.resnet import ResNetSmall, resnet_small
@@ -56,4 +57,5 @@ __all__ = [
     "sequence_parallel_transformer_forward",
     "MoETransformerClassifier", "moe_transformer_classifier",
     "TransformerLM", "transformer_lm", "generate", "next_token_dataset",
+    "quantize_lm",
 ]

@@ -58,6 +58,38 @@ def apply_rope(x, angles):
     return out.astype(x.dtype)
 
 
+class QDense(nn.Module):
+    """Dense over an int8 weight-only-quantized kernel (``ops.quant``).
+
+    Param set: ``kernel_q`` int8 ``[in, features]``, per-output-channel
+    ``scale`` f32, ``bias`` in the activation dtype — exactly what
+    :func:`distkeras_tpu.ops.quant.quantize_dense_tree` produces from a
+    trained ``nn.Dense`` subtree. The matmul streams int8 from HBM and
+    dequantizes in VMEM (Pallas), which is the decode bandwidth win.
+    """
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+    impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x):
+        from distkeras_tpu.ops.quant import QTensor, q_matmul
+
+        k = x.shape[-1]
+        q = self.param("kernel_q", nn.initializers.zeros,
+                       (k, self.features), jnp.int8)
+        s = self.param("scale", nn.initializers.ones,
+                       (self.features,), jnp.float32)
+        b = self.param("bias", nn.initializers.zeros,
+                       (self.features,), self.dtype)
+        out = q_matmul(x, QTensor(q, s), impl=self.impl, out_dtype=x.dtype)
+        # trained biases arrive f32 (flax master params); add in the
+        # activation dtype like nn.Dense(dtype=...) does — a bare f32 add
+        # would silently promote the whole downstream block to f32
+        return out + b.astype(out.dtype)
+
+
 class DecoderBlock(nn.Module):
     """Pre-norm causal block with three entry points sharing one parameter
     set: ``__call__`` (training / full forward), ``prefill`` (full forward
@@ -78,6 +110,9 @@ class DecoderBlock(nn.Module):
     #: stores PRE-ROTATED keys); ``maxlen`` bounds the decode angle table
     rope: bool = False
     maxlen: int = 0
+    #: int8 weight-only serving: every Dense becomes a QDense (params from
+    #: quantize_lm); architecture and entry points are otherwise identical
+    quant: bool = False
 
     @property
     def _hkv(self) -> int:
@@ -106,16 +141,17 @@ class DecoderBlock(nn.Module):
             )
         f32 = jnp.float32
         dh = self.dim // self.heads
+        dense = QDense if self.quant else nn.Dense
         self.ln_attn = nn.LayerNorm(dtype=f32)
         # one fused projection, width (H + 2·Hkv)·Dh; splitting at H·Dh /
         # (H+Hkv)·Dh reduces to the classic thirds split when Hkv == H, so
         # MHA checkpoints/params are unchanged by the GQA seam
-        self.qkv = nn.Dense((self.heads + 2 * self._hkv) * dh,
-                            dtype=self.dtype)
-        self.attn_out = nn.Dense(self.dim, dtype=self.dtype)
+        self.qkv = dense((self.heads + 2 * self._hkv) * dh,
+                         dtype=self.dtype)
+        self.attn_out = dense(self.dim, dtype=self.dtype)
         self.ln_mlp = nn.LayerNorm(dtype=f32)
-        self.mlp_up = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype)
-        self.mlp_down = nn.Dense(self.dim, dtype=self.dtype)
+        self.mlp_up = dense(self.mlp_ratio * self.dim, dtype=self.dtype)
+        self.mlp_down = dense(self.dim, dtype=self.dtype)
 
     def _project_qkv(self, x):
         """→ q [B, L, H, Dh], k/v [B, L, Hkv, Dh]."""
@@ -235,6 +271,8 @@ class TransformerLM(nn.Module):
     #: (rotary q/k rotations in every block, Su et al. — relative positions,
     #: nothing added to the residual stream)
     pos_embedding: str = "sincos"
+    #: int8 weight-only serving mode — see :func:`quantize_lm`
+    quant: bool = False
 
     def setup(self):
         if self.kv_heads is not None and self.heads % self.kv_heads:
@@ -259,11 +297,13 @@ class TransformerLM(nn.Module):
                          attn_window=self.attn_window,
                          kv_heads=self.kv_heads,
                          rope=self.pos_embedding == "rope",
-                         maxlen=self.maxlen)
+                         maxlen=self.maxlen,
+                         quant=self.quant)
             for _ in range(self.depth)
         ]
         self.ln_head = nn.LayerNorm(dtype=jnp.float32)
-        self.lm_head = nn.Dense(self.vocab, dtype=self.dtype)
+        head = QDense if self.quant else nn.Dense
+        self.lm_head = head(self.vocab, dtype=self.dtype)
 
     def _embed_at(self, tokens, pos0: int | jax.Array = 0):
         """Embed ``tokens`` occupying positions ``pos0 .. pos0+L``."""
@@ -449,6 +489,33 @@ def transformer_lm(vocab=1024, maxlen=256, dim=128, heads=4, depth=2,
     )
     example = jnp.zeros((1, maxlen), jnp.int32)
     return from_flax(module, example, name="transformer_lm")
+
+
+def quantize_lm(model, params) -> tuple[ModelSpec, dict]:
+    """Post-training int8 weight-only quantization of a trained LM.
+
+    ``(spec, trained_params) → (int8 spec, int8 params)``: every Dense
+    kernel (qkv/attn_out/mlp_up/mlp_down/lm_head in every block) becomes an
+    int8 matrix + per-output-channel f32 scale served by :class:`QDense`;
+    embeddings and LayerNorms stay in their trained dtypes. The returned
+    pair drops into :func:`generate` and ``predictors.GeneratorPredictor``
+    unchanged — same architecture, same entry points, ~half the weight
+    bytes per decode step (see ``ops/quant.py`` for the TPU rationale).
+    """
+    from distkeras_tpu.ops.quant import quantize_dense_tree
+
+    module = model.module if isinstance(model, ModelSpec) else model
+    if not isinstance(module, TransformerLM):
+        raise TypeError(
+            f"quantize_lm() needs a TransformerLM (or its ModelSpec), got "
+            f"{type(module)}"
+        )
+    if module.quant:
+        raise ValueError("model is already quantized")
+    qmodule = module.clone(quant=True)
+    example = jnp.zeros((1, module.maxlen), jnp.int32)
+    qspec = from_flax(qmodule, example, name="transformer_lm_int8")
+    return qspec, quantize_dense_tree(params)
 
 
 def next_token_dataset(tokens: np.ndarray):

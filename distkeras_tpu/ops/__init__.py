@@ -8,12 +8,16 @@ from distkeras_tpu.ops.metrics import accuracy
 
 
 def __getattr__(name):
-    # pallas_kernels imports jax.experimental.pallas; keep it lazy so plain
+    # pallas modules import jax.experimental.pallas; keep them lazy so plain
     # loss/metric users never pay for it
     if name == "pallas_kernels":
         from distkeras_tpu.ops import pallas_kernels
 
         return pallas_kernels
+    if name == "quant":
+        from distkeras_tpu.ops import quant
+
+        return quant
     raise AttributeError(f"module 'distkeras_tpu.ops' has no attribute {name!r}")
 
 

@@ -1,0 +1,192 @@
+"""Int8 weight-only quantization: TPU-native serving for trained models.
+
+Beyond-reference (the Spark-era reference served float32 Keras weights and
+nothing else — SURVEY.md §2b #15): symmetric per-output-channel int8
+post-training quantization, built for the TPU memory system.
+
+Why weight-only, and why a Pallas kernel:
+
+- **Autoregressive decode is HBM-bandwidth-bound.** Every decode step
+  streams every weight matrix once to multiply a tiny ``[B, 1, d]``
+  activation. Int8 weights halve the bytes per step, which is directly
+  ~2× decode throughput for the weight-dominated regime (small batch,
+  cache smaller than the weights).
+- **The dequant must happen AFTER the HBM read.** An XLA-level
+  ``q.astype(bf16) * scale`` before the matmul is loop-invariant inside
+  the decode ``lax.scan`` — the compiler may hoist it and materialize a
+  full bf16 copy in HBM, forfeiting the entire win. The Pallas kernel
+  makes the schedule explicit: int8 tiles stream HBM→VMEM, are widened to
+  bf16 in-register, hit the MXU, and the per-channel scale is applied to
+  the f32 accumulator. No bf16 weight tensor ever exists in HBM.
+- **Activations stay bf16.** v5e's MXU runs int8×int8 at 2× bf16 peak,
+  but decode is nowhere near compute-bound — weight-only takes the
+  bandwidth win and keeps activation precision (no calibration needed).
+
+Accuracy: symmetric absmax per output channel; the scale is exact in f32
+and applied after the f32 accumulation, so ``q_matmul`` equals the exact
+``x @ (q · scale)`` product up to matmul dtype rounding (pinned by
+tests/test_quant.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LANES = 128
+
+
+class QTensor(NamedTuple):
+    """An int8-quantized matrix: ``q [K, N] int8`` with per-output-channel
+    ``scale [N] f32``; the represented value is ``q.astype(f32) * scale``."""
+
+    q: jax.Array
+    scale: jax.Array
+
+
+def quantize(w, axis: int = 0) -> QTensor:
+    """Symmetric absmax int8 quantization of a 2-D weight.
+
+    ``axis`` is the reduction (input) dimension of the matmul the weight
+    feeds — scales are per *output* channel, so dequantization commutes
+    with the contraction and can be applied to the accumulator.
+    """
+    w = jnp.asarray(w)
+    if w.ndim != 2:
+        raise ValueError(f"quantize expects a 2-D weight, got {w.shape}")
+    if axis not in (0, 1):
+        raise ValueError(f"axis must be 0 or 1, got {axis}")
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.round(wf / jnp.expand_dims(scale, axis))
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale)
+
+
+def dequantize(qt: QTensor, axis: int = 0, dtype=jnp.float32):
+    """Materialize the represented weight (test/debug path — the runtime
+    paths never do this in HBM)."""
+    return (qt.q.astype(jnp.float32)
+            * jnp.expand_dims(qt.scale, axis)).astype(dtype)
+
+
+def _q_matmul_xla(x, qt: QTensor, out_dtype):
+    """Reference lowering: widen-in-graph matmul, scale on the f32 result.
+
+    Matches the kernel bit-for-bit in f32 and is the fallback wherever the
+    kernel's tiling constraints don't hold. (Inside a decode scan XLA may
+    hoist the widening — that is exactly what the Pallas path prevents.)
+    """
+    acc = jax.lax.dot_general(
+        x, qt.q.astype(x.dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return (acc * qt.scale).astype(out_dtype)
+
+
+def _q_matmul_kernel(x_ref, q_ref, s_ref, o_ref):
+    """One output tile: int8 weight tile → bf16 in-register → MXU → scale."""
+    w = q_ref[...].astype(x_ref.dtype)
+    acc = jnp.dot(x_ref[...], w, preferred_element_type=jnp.float32)
+    o_ref[...] = (acc * s_ref[...]).astype(o_ref.dtype)
+
+
+def _pad_to(n: int, mult: int) -> int:
+    return -(-n // mult) * mult
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "out_dtype",
+                                             "interpret"))
+def _q_matmul_pallas(x2, q, scale, *, bm, bn, out_dtype, interpret):
+    m, k = x2.shape
+    n = q.shape[1]
+    mp = _pad_to(m, bm)
+    xp = jnp.pad(x2, ((0, mp - m), (0, 0)))
+    out = pl.pallas_call(
+        _q_matmul_kernel,
+        grid=(mp // bm, n // bn),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, n), out_dtype),
+        interpret=interpret,
+    )(xp, q, scale.reshape(1, n))
+    return out[:m]
+
+
+def q_matmul(x, qt: QTensor, *, impl: str = "auto", out_dtype=None,
+             interpret: bool | None = None):
+    """``x [..., K] @ dequant(qt) [K, N] → [..., N]``.
+
+    ``impl``: ``"pallas"`` (fused in-VMEM dequant kernel), ``"xla"``
+    (widen-in-graph fallback), or ``"auto"`` — the kernel whenever its
+    tiling constraints hold (K and N multiples of 128, K small enough for
+    a full-depth VMEM tile). ``interpret`` defaults to "kernel on TPU,
+    interpreter elsewhere" so CI exercises the same code path on CPU.
+    """
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"impl must be 'auto', 'pallas', or 'xla', "
+                         f"got {impl!r}")
+    k, n = qt.q.shape
+    if x.shape[-1] != k:
+        raise ValueError(f"x trailing dim {x.shape[-1]} != weight rows {k}")
+    out_dtype = out_dtype or x.dtype
+    tileable = (k % _LANES == 0 and n % _LANES == 0 and k <= 8192)
+    if impl == "auto":
+        impl = "pallas" if tileable else "xla"
+    if impl == "xla":
+        return _q_matmul_xla(x, qt, out_dtype)
+    if not tileable:
+        raise ValueError(
+            f"impl='pallas' needs K, N multiples of {_LANES} and K <= 8192; "
+            f"got K={k}, N={n} (use impl='auto' to fall back)"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lead = x.shape[:-1]
+    m = 1
+    for d in lead:
+        m *= d
+    x2 = x.reshape(m, k)
+    # one output tile spans the full contraction: K<=8192 bf16 rows fit a
+    # [bm, K] + [K, bn] VMEM working set comfortably inside 16 MiB
+    bm = min(_pad_to(max(m, 1), 16), 256)
+    bn = min(n, 512)
+    while n % bn:
+        bn //= 2
+    out = _q_matmul_pallas(x2, qt.q, qt.scale, bm=bm, bn=bn,
+                           out_dtype=out_dtype, interpret=bool(interpret))
+    return out.reshape(*lead, n)
+
+
+def quantize_dense_tree(params):
+    """Walk a flax param tree and quantize every Dense-shaped leaf pair.
+
+    A subtree ``{"kernel": [K, N] float, "bias": ...}`` (exactly the param
+    set ``nn.Dense`` creates) becomes ``{"kernel_q": int8, "scale": f32,
+    "bias": ...}`` — the param set ``models.lm.QDense`` reads. Everything
+    else (embeddings, LayerNorm scales/biases, conv kernels) passes through
+    unchanged.
+    """
+    from collections.abc import Mapping
+
+    def rec(node):
+        if isinstance(node, Mapping):
+            if (set(node) == {"kernel", "bias"}
+                    and getattr(node["kernel"], "ndim", 0) == 2):
+                qt = quantize(node["kernel"], axis=0)
+                return {"kernel_q": qt.q, "scale": qt.scale,
+                        "bias": node["bias"]}
+            return {k: rec(v) for k, v in node.items()}
+        return node
+
+    return rec(params)

@@ -109,6 +109,18 @@ def main():
     if acc < 0.9:
         print("FAILED: generations diverge from the cyclic language")
         return 1
+
+    # int8 weight-only serving: quantize the trained model and decode again
+    # — same API, ~half the weight bytes per step (ops/quant.py)
+    from distkeras_tpu.models import quantize_lm
+
+    qspec, qparams = quantize_lm(spec, params)
+    qout = generate(qspec, qparams, prompts, max_new_tokens=n_new)
+    qacc = float((qout[:, n_prompt:] == expect[:, n_prompt:]).mean())
+    print(f"[generate:int8] continuation accuracy: {qacc:.3f}")
+    if qacc < 0.9:
+        print("FAILED: int8 generations diverge from the cyclic language")
+        return 1
     print("OK")
     return 0
 

@@ -1,0 +1,169 @@
+"""Int8 weight-only quantization: ops.quant + the LM serving path.
+
+Beyond-reference (SURVEY.md §2b #15 covers float serving only). The
+kernel-level contracts: symmetric per-channel quantization error is
+bounded by half a step, the XLA lowering equals the exact dequantized
+matmul, and the Pallas kernel (interpret mode here, real on TPU) equals
+the XLA lowering. The model-level contract: quantize_lm preserves the
+architecture (param structure pins against the quant module's own init)
+and the decode path produces near-identical generations.
+"""
+
+import chex
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.ops.quant import (
+    QTensor,
+    dequantize,
+    q_matmul,
+    quantize,
+    quantize_dense_tree,
+)
+
+
+def test_quantize_roundtrip_error_bound(rng):
+    w = rng.normal(size=(64, 96)).astype(np.float32)
+    qt = quantize(w)
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (96,)
+    deq = np.asarray(dequantize(qt))
+    step = np.asarray(qt.scale)
+    assert np.all(np.abs(deq - w) <= 0.5 * step[None, :] + 1e-7)
+
+
+def test_quantize_zero_channel_is_exact(rng):
+    w = rng.normal(size=(8, 4)).astype(np.float32)
+    w[:, 2] = 0.0  # absmax 0 would divide by zero without the guard
+    qt = quantize(w)
+    deq = np.asarray(dequantize(qt))
+    np.testing.assert_array_equal(deq[:, 2], 0.0)
+
+
+def test_q_matmul_xla_matches_exact_dequant(rng):
+    w = rng.normal(size=(128, 256)).astype(np.float32)
+    x = rng.normal(size=(4, 128)).astype(np.float32)
+    qt = quantize(w)
+    got = np.asarray(q_matmul(jnp.asarray(x), qt, impl="xla"))
+    want = x @ np.asarray(dequantize(qt))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("lead", [(1,), (5,), (2, 3)])
+def test_q_matmul_pallas_matches_xla(rng, lead):
+    w = rng.normal(size=(256, 384)).astype(np.float32)
+    qt = quantize(w)
+    x = rng.normal(size=lead + (256,)).astype(np.float32)
+    a = np.asarray(q_matmul(jnp.asarray(x), qt, impl="pallas",
+                            interpret=True))
+    b = np.asarray(q_matmul(jnp.asarray(x), qt, impl="xla"))
+    assert a.shape == lead + (384,)
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_q_matmul_auto_falls_back_on_untileable_shapes(rng):
+    w = rng.normal(size=(100, 96)).astype(np.float32)  # K%128 != 0
+    x = rng.normal(size=(3, 100)).astype(np.float32)
+    qt = quantize(w)
+    got = np.asarray(q_matmul(jnp.asarray(x), qt))  # auto → xla, no error
+    want = x @ np.asarray(dequantize(qt))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="multiples"):
+        q_matmul(jnp.asarray(x), qt, impl="pallas")
+
+
+def test_quantize_dense_tree_converts_only_dense_pairs(rng):
+    tree = {
+        "dense": {"kernel": rng.normal(size=(8, 4)).astype(np.float32),
+                  "bias": np.zeros(4, np.float32)},
+        "ln": {"scale": np.ones(8, np.float32),
+               "bias": np.zeros(8, np.float32)},
+        "embed": {"embedding": rng.normal(size=(16, 8)).astype(np.float32)},
+    }
+    out = quantize_dense_tree(tree)
+    assert set(out["dense"]) == {"kernel_q", "scale", "bias"}
+    assert out["dense"]["kernel_q"].dtype == jnp.int8
+    assert set(out["ln"]) == {"scale", "bias"}          # untouched
+    assert set(out["embed"]) == {"embedding"}           # untouched
+
+
+@pytest.fixture(scope="module")
+def lm_pair():
+    """A small f32 LM + its int8 quantization (module-scoped: compile once)."""
+    from distkeras_tpu.models import quantize_lm, transformer_lm
+
+    spec = transformer_lm(vocab=64, maxlen=32, dim=64, heads=4, depth=2,
+                          dtype=jnp.float32)
+    params, _ = spec.init_np(3)
+    qspec, qparams = quantize_lm(spec, params)
+    return spec, params, qspec, qparams
+
+
+def test_quantize_lm_param_structure_matches_quant_module(lm_pair):
+    _, _, qspec, qparams = lm_pair
+    # the converted tree must be exactly what the quant=True module expects
+    q0, _ = qspec.init_np(0)
+    chex.assert_trees_all_equal_structs(q0, qparams)
+    jax.tree.map(lambda a, b: chex.assert_equal_shape((a, b)), q0,
+                 jax.tree.map(jnp.asarray, qparams))
+
+
+def test_quantize_lm_logits_track_fp32(lm_pair, rng):
+    spec, params, qspec, qparams = lm_pair
+    tokens = jnp.asarray(rng.integers(0, 64, size=(2, 32)), jnp.int32)
+    base, _ = spec.apply(params, {}, tokens, False)
+    qout, _ = qspec.apply(qparams, {}, tokens, False)
+    rel = (np.linalg.norm(np.asarray(qout) - np.asarray(base))
+           / np.linalg.norm(np.asarray(base)))
+    assert rel < 0.05, f"int8 logits diverged: rel error {rel:.4f}"
+
+
+def test_quantized_generate_matches_fp32_greedy(lm_pair, rng):
+    from distkeras_tpu.models import generate
+
+    spec, params, qspec, qparams = lm_pair
+    prompt = jnp.asarray(rng.integers(0, 64, size=(2, 8)), jnp.int32)
+    base = generate(spec, params, prompt, max_new_tokens=16)
+    qout = generate(qspec, qparams, prompt, max_new_tokens=16)
+    assert qout.shape == base.shape == (2, 24)
+    agree = float(np.mean(base[:, 8:] == qout[:, 8:]))
+    # greedy decode over near-identical logits: occasional argmax flips at
+    # ties are expected, wholesale divergence is not
+    assert agree >= 0.75, f"greedy agreement only {agree:.2f}"
+
+
+def test_qdense_keeps_activation_dtype_bf16():
+    """A bf16 quantized model must stay bf16 through QDense: the trained
+    f32 bias is cast before the add, matching nn.Dense(dtype=bf16) — a
+    bare f32 add would promote every downstream tensor."""
+    from distkeras_tpu.models.lm import QDense
+
+    mod = QDense(features=128, dtype=jnp.bfloat16)
+    params = mod.init(jax.random.PRNGKey(0), jnp.zeros((2, 128), jnp.bfloat16))
+    params = {"params": {**params["params"],
+                         "bias": np.zeros(128, np.float32)}}  # trained-style
+    out = mod.apply(params, jnp.ones((2, 128), jnp.bfloat16))
+    assert out.dtype == jnp.bfloat16
+
+
+def test_quantize_lm_rejects_double_quant(lm_pair):
+    from distkeras_tpu.models import quantize_lm
+
+    _, _, qspec, qparams = lm_pair
+    with pytest.raises(ValueError, match="already quantized"):
+        quantize_lm(qspec, qparams)
+
+
+def test_generator_predictor_serves_quantized_lm(lm_pair, rng):
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.predictors import GeneratorPredictor
+
+    _, _, qspec, qparams = lm_pair
+    prompts = rng.integers(0, 64, size=(5, 8)).astype(np.int32)
+    ds = Dataset({"features": prompts})
+    out = GeneratorPredictor(qspec, qparams, max_new_tokens=4,
+                             batch_size=4).predict(ds)
+    assert out["generated"].shape == (5, 4)
+    assert out["generated"].dtype == np.int32
