@@ -1,0 +1,160 @@
+"""Commit-payload compression for the asynchronous PS/DCN path.
+
+Beyond-reference (the reference shipped full-precision pickled weight deltas
+over TCP — reference ``distkeras/networking.py :: send_data``): the async
+backend's pull/commit traffic is the one part of this framework that rides
+DCN instead of ICI, so its bytes are the scarce resource. Two classic lossy
+codecs compress the *commit* direction (worker → PS), combined with
+worker-side **error feedback** (Seide et al. 2014; Karimireddy et al. 2019
+— see PAPERS.md): the part of each window delta the codec dropped is
+remembered and added to the next window's delta, so the transmitted stream
+telescopes to the true update stream and convergence is preserved.
+
+- :class:`Int8Codec` — symmetric per-leaf absmax int8: 4× fewer payload
+  bytes, error bounded by half a quantization step per element.
+- :class:`TopKCodec` — magnitude top-k sparsification per leaf (default 5%):
+  ~10-20× fewer bytes; error feedback is what makes this converge.
+
+Codecs encode a pytree into a **wire-safe** blob: plain dicts/lists of numpy
+arrays and primitives, so it travels the existing restricted-pickle frames
+(``networking.py``) unchanged, and the PS decodes before folding
+(``ParameterServer.commit`` calls :func:`maybe_decode`). The pull direction
+stays exact: a lossily-compressed center would inject persistent error the
+worker-side feedback loop cannot see.
+
+Select with ``compression="int8"`` / ``"topk"`` / ``TopKCodec(0.01)`` on any
+async trainer (PS backend; the collective backend's merges are XLA psums
+over ICI, where compression has nothing to buy).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+Pytree = Any
+
+#: blob key marking an encoded commit (never a param name in any model tree)
+_MARK = "__dk_codec__"
+_LEAF = "__dk_leaf__"
+
+
+class Codec:
+    """Commit-payload codec: ``encode(tree) → wire blob``, ``decode`` back.
+
+    ``decode(encode(t))`` is the *transmitted* (lossy) tree — workers use it
+    to compute the error-feedback residual; the PS folds exactly it.
+    """
+
+    name: str = "identity"
+
+    def encode_leaf(self, arr: np.ndarray) -> dict:
+        raise NotImplementedError
+
+    def decode_leaf(self, blob: dict) -> np.ndarray:
+        raise NotImplementedError
+
+    # -- tree plumbing (structure travels as plain containers) --------------
+
+    def encode(self, tree: Pytree) -> dict:
+        def rec(node):
+            if isinstance(node, dict):
+                return {k: rec(v) for k, v in node.items()}
+            if isinstance(node, (list, tuple)):
+                enc = [rec(v) for v in node]
+                return enc if isinstance(node, list) else tuple(enc)
+            arr = np.asarray(node)
+            if arr.dtype == np.float32 and arr.size >= 16:
+                return {_LEAF: self.name, **self.encode_leaf(arr)}
+            return arr  # tiny/integer leaves: not worth a codec round-trip
+        return {_MARK: self.name, "tree": rec(tree)}
+
+    def decode(self, blob: dict) -> Pytree:
+        def rec(node):
+            if isinstance(node, dict):
+                if _LEAF in node:
+                    return self.decode_leaf(node)
+                return {k: rec(v) for k, v in node.items()}
+            if isinstance(node, (list, tuple)):
+                # commit trees are dicts-of-dicts in every model family here;
+                # lists appear only for stacked/tuple params — preserve type
+                return type(node)(rec(v) for v in node) \
+                    if isinstance(node, tuple) else [rec(v) for v in node]
+            return node
+        return rec(blob["tree"])
+
+
+class Int8Codec(Codec):
+    """Symmetric per-leaf absmax int8 (~4× smaller commits)."""
+
+    name = "int8"
+
+    def encode_leaf(self, arr: np.ndarray) -> dict:
+        amax = float(np.max(np.abs(arr)))
+        scale = amax / 127.0 if amax > 0 else 1.0
+        q = np.clip(np.rint(arr / scale), -127, 127).astype(np.int8)
+        return {"q": q, "s": scale}
+
+    def decode_leaf(self, blob: dict) -> np.ndarray:
+        return blob["q"].astype(np.float32) * np.float32(blob["s"])
+
+
+class TopKCodec(Codec):
+    """Magnitude top-k per leaf (values + flat indices; ~``1/frac``× smaller
+    at small ``frac``). Error feedback reinjects the dropped mass later."""
+
+    name = "topk"
+
+    def __init__(self, frac: float = 0.05):
+        if not 0.0 < frac <= 1.0:
+            raise ValueError(f"frac must be in (0, 1], got {frac}")
+        self.frac = float(frac)
+
+    def encode_leaf(self, arr: np.ndarray) -> dict:
+        flat = arr.reshape(-1)
+        k = max(1, int(np.ceil(self.frac * flat.size)))
+        idx = np.argpartition(np.abs(flat), flat.size - k)[-k:]
+        idx = idx.astype(np.int64 if flat.size > 2**31 else np.int32)
+        return {"v": flat[idx], "i": idx, "n": list(arr.shape)}
+
+    def decode_leaf(self, blob: dict) -> np.ndarray:
+        shape = tuple(int(d) for d in blob["n"])
+        out = np.zeros(int(np.prod(shape)), np.float32)
+        out[blob["i"]] = blob["v"]
+        return out.reshape(shape)
+
+
+_REGISTRY = {"int8": Int8Codec, "topk": TopKCodec}
+
+
+def resolve_codec(compression) -> Codec | None:
+    """Trainer kwarg → codec: ``None``, a name, or a Codec instance."""
+    if compression is None:
+        return None
+    if isinstance(compression, Codec):
+        return compression
+    if isinstance(compression, str):
+        if compression in _REGISTRY:
+            return _REGISTRY[compression]()
+        raise ValueError(
+            f"unknown compression {compression!r}; expected "
+            f"{sorted(_REGISTRY)} or a Codec instance"
+        )
+    raise TypeError(f"compression must be None, str, or Codec, "
+                    f"got {type(compression)}")
+
+
+def is_encoded(payload) -> bool:
+    return isinstance(payload, dict) and _MARK in payload
+
+
+def maybe_decode(payload: Pytree) -> Pytree:
+    """PS-side seam: decode an encoded commit, pass a raw tree through."""
+    if not is_encoded(payload):
+        return payload
+    name = payload[_MARK]
+    if name not in _REGISTRY:
+        raise ValueError(f"commit encoded with unknown codec {name!r}")
+    codec = _REGISTRY[name]()
+    return codec.decode(payload)

@@ -28,6 +28,7 @@ from typing import Any
 import numpy as np
 
 from distkeras_tpu import networking, utils
+from distkeras_tpu.parallel.compression import is_encoded, maybe_decode
 from distkeras_tpu.parallel.merge_rules import MergeRule
 
 Pytree = Any
@@ -69,7 +70,13 @@ class ParameterServer:
             return jax_tree_copy(self.center)
 
     def commit(self, worker_id: int, payload: Pytree) -> None:
-        """Fold one worker's commit into the center under the lock."""
+        """Fold one worker's commit into the center under the lock.
+
+        Commits may arrive codec-compressed (``parallel.compression`` —
+        int8 / top-k wire blobs); the fold always sees the decoded dense
+        tree, so merge-rule semantics are codec-independent.
+        """
+        payload = maybe_decode(payload)
         with self._lock:
             staleness = self.num_updates - self._pull_versions.get(worker_id, 0)
             self.center = utils.tree_to_numpy(
@@ -209,12 +216,16 @@ class ParameterServerClient:
         return networking.recv_data(self._sock)["weights"]
 
     def commit(self, worker_id: int | None, payload: Pytree) -> None:
+        # codec blobs are already wire-shaped (and carry non-array fields
+        # like the codec name) — only raw trees get the numpy coercion
+        if not is_encoded(payload):
+            payload = utils.tree_to_numpy(payload)
         networking.send_data(
             self._sock,
             {
                 "action": "commit",
                 "worker_id": self.worker_id,
-                "payload": utils.tree_to_numpy(payload),
+                "payload": payload,
             },
         )
         networking.recv_data(self._sock)  # ack

@@ -70,7 +70,7 @@ class AsyncWorker:
                  barrier: threading.Barrier | None = None,
                  ckpt_pred=None,
                  restore: dict | None = None, start_epoch: int = 0,
-                 tolerant: bool = False):
+                 tolerant: bool = False, codec=None):
         self.worker_id = worker_id
         self.device = device
         self.window_fn = window_fn
@@ -93,8 +93,26 @@ class AsyncWorker:
         self.restore = restore
         self.start_epoch = int(start_epoch)
         self.tolerant = bool(tolerant)
+        # Lossy commit compression (parallel.compression) with error
+        # feedback: the residual the codec dropped is added to the next
+        # window's commit, so the transmitted stream telescopes to the true
+        # one. Residual state is per-worker and intentionally NOT
+        # checkpointed (restarting feedback at zero is harmless).
+        self.codec = codec
+        self._resid = None
         self.snapshot: dict | None = None
         self.error: BaseException | None = None
+
+    def _compress(self, tree):
+        """→ (wire payload, transmitted tree); updates the residual."""
+        if self.codec is None:
+            return tree, tree
+        if self._resid is not None:
+            tree = jax.tree.map(np.add, tree, self._resid)
+        blob = self.codec.encode(tree)
+        sent = self.codec.decode(blob)
+        self._resid = jax.tree.map(np.subtract, tree, sent)
+        return blob, sent
 
     def train(self, index: int, shard_cols: tuple, num_epoch: int,
               shuffle: bool, seed: int) -> None:
@@ -148,13 +166,16 @@ class AsyncWorker:
                 if elastic:
                     # pull a FRESH center at exchange time (reference EASGD
                     # semantics), commit the elastic difference, keep own
-                    # variable moved toward the center
+                    # variable moved toward the center — by the TRANSMITTED
+                    # difference, so worker and center stay symmetric under
+                    # lossy compression
                     center = self.ps.pull(self.worker_id)
                     host_params = utils.tree_to_numpy(params)
                     diff = self.rule.worker_commit(host_params, center)
-                    self.ps.commit(self.worker_id, diff)
+                    blob, sent = self._compress(diff)
+                    self.ps.commit(self.worker_id, blob)
                     params = jax.device_put(
-                        jax.tree.map(lambda p, d: p - d, host_params, diff),
+                        jax.tree.map(lambda p, d: p - d, host_params, sent),
                         self.device,
                     )
                 else:
@@ -163,7 +184,8 @@ class AsyncWorker:
                         lambda p, c: np.asarray(p) - c,
                         utils.tree_to_numpy(params), center,
                     )
-                    self.ps.commit(self.worker_id, delta)
+                    blob, _ = self._compress(delta)
+                    self.ps.commit(self.worker_id, blob)
                     center = self.ps.pull(self.worker_id)
                     params = jax.device_put(center, self.device)
 
@@ -231,9 +253,17 @@ def run_async_training(trainer, ds, shuffle: bool):
             restored_updates = int(payload.get("num_updates", 0))
             start_epoch = int(payload["epoch"]) + 1
 
+    from distkeras_tpu.parallel.compression import resolve_codec
+
     transport = getattr(trainer, "ps_transport", "inprocess")
     external_host = getattr(trainer, "ps_host", None)
     offset = int(getattr(trainer, "worker_id_offset", 0))
+    codec = resolve_codec(getattr(trainer, "compression", None))
+    if codec is not None and transport == "native":
+        raise ValueError(
+            "compression is not supported on ps_transport='native' (its "
+            "C++ wire is flat f32); use 'socket' or 'inprocess'"
+        )
     if external_host is not None:
         # External PS (another process/host — the reference's driver-hosted
         # PS serving remote executors): this process contributes W workers;
@@ -342,6 +372,7 @@ def run_async_training(trainer, ds, shuffle: bool):
             barrier=barrier, ckpt_pred=ckpt_pred,
             restore=restores[i], start_epoch=start_epoch,
             tolerant=getattr(trainer, "tolerate_worker_failures", False),
+            codec=codec,
         )
         for i in range(W)
     ]

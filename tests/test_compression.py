@@ -1,0 +1,189 @@
+"""Commit compression for the async PS path (parallel.compression).
+
+Codec-level contracts (error bounds, wire-size reduction, restricted-pickle
+safety), the error-feedback telescoping identity, and end-to-end: hogwild
+trainers still learn with int8 and top-k commits over both the in-process
+and the real TCP transport.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.parallel.compression import (
+    Int8Codec,
+    TopKCodec,
+    is_encoded,
+    maybe_decode,
+    resolve_codec,
+)
+from tests.test_trainers import blobs_dataset, final_loss, model_spec
+
+
+def _tree(rng, scale=1.0):
+    return {
+        "dense": {"kernel": (scale * rng.normal(size=(64, 32))).astype(np.float32),
+                  "bias": (scale * rng.normal(size=32)).astype(np.float32)},
+        "head": {"kernel": (scale * rng.normal(size=(32, 4))).astype(np.float32),
+                 "bias": (scale * rng.normal(size=4)).astype(np.float32)},
+    }
+
+
+def test_int8_roundtrip_error_bound(rng):
+    tree = _tree(rng)
+    codec = Int8Codec()
+    blob = codec.encode(tree)
+    assert is_encoded(blob)
+    out = codec.decode(blob)
+    for k in ("dense", "head"):
+        w = tree[k]["kernel"]
+        step = np.max(np.abs(w)) / 127.0
+        assert np.max(np.abs(out[k]["kernel"] - w)) <= 0.5 * step + 1e-7
+
+
+def test_topk_keeps_exactly_the_largest(rng):
+    codec = TopKCodec(frac=0.1)
+    arr = rng.normal(size=(20, 10)).astype(np.float32)
+    out = codec.decode(codec.encode({"w": arr}))["w"]
+    k = 20  # ceil(0.1 * 200)
+    nz = np.flatnonzero(out)
+    assert len(nz) == k
+    # the kept entries are exact and are the k largest magnitudes
+    flat = arr.reshape(-1)
+    top = np.argsort(np.abs(flat))[-k:]
+    assert set(nz) == set(top)
+    np.testing.assert_array_equal(out.reshape(-1)[nz], flat[nz])
+
+
+def test_wire_bytes_shrink(rng):
+    tree = _tree(rng)
+    dense_bytes = len(pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL))
+    int8_bytes = len(pickle.dumps(Int8Codec().encode(tree),
+                                  protocol=pickle.HIGHEST_PROTOCOL))
+    topk_bytes = len(pickle.dumps(TopKCodec(0.05).encode(tree),
+                                  protocol=pickle.HIGHEST_PROTOCOL))
+    assert int8_bytes < 0.35 * dense_bytes, (int8_bytes, dense_bytes)
+    assert topk_bytes < 0.25 * dense_bytes, (topk_bytes, dense_bytes)
+
+
+def test_blob_survives_the_restricted_wire(rng):
+    """Encoded commits are plain containers + ndarrays — the restricted
+    unpickler (networking.py) must pass them untouched."""
+    import socket
+
+    from distkeras_tpu import networking
+
+    blob = TopKCodec(0.1).encode(_tree(rng))
+    a, b = socket.socketpair()
+    networking.send_data(a, {"action": "commit", "payload": blob})
+    got = networking.recv_data(b)["payload"]
+    a.close(); b.close()
+    out, want = maybe_decode(got), maybe_decode(blob)
+    for k in ("dense", "head"):
+        np.testing.assert_array_equal(out[k]["kernel"], want[k]["kernel"])
+
+
+def test_tuple_structured_trees_roundtrip(rng):
+    """Container types must survive encode→decode exactly: the worker's
+    error-feedback tree.map and the PS fold both require identical
+    treedefs."""
+    import jax
+
+    tree = {"stack": (rng.normal(size=(8, 8)).astype(np.float32),
+                      rng.normal(size=(8, 8)).astype(np.float32)),
+            "lst": [rng.normal(size=24).astype(np.float32)]}
+    for codec in (Int8Codec(), TopKCodec(0.5)):
+        out = codec.decode(codec.encode(tree))
+        assert (jax.tree.structure(out) == jax.tree.structure(tree)), codec.name
+
+
+def test_maybe_decode_passthrough_and_unknown(rng):
+    raw = _tree(rng)
+    assert maybe_decode(raw) is raw          # dense commits untouched
+    with pytest.raises(ValueError, match="unknown codec"):
+        maybe_decode({"__dk_codec__": "nope", "tree": {}})
+
+
+def test_resolve_codec():
+    assert resolve_codec(None) is None
+    assert isinstance(resolve_codec("int8"), Int8Codec)
+    assert isinstance(resolve_codec("topk"), TopKCodec)
+    c = TopKCodec(0.01)
+    assert resolve_codec(c) is c
+    with pytest.raises(ValueError, match="unknown compression"):
+        resolve_codec("gzip")
+
+
+def test_error_feedback_telescopes(rng):
+    """Transmitted stream + final residual == true delta stream, exactly."""
+    from distkeras_tpu.workers import AsyncWorker
+
+    w = AsyncWorker.__new__(AsyncWorker)  # codec plumbing only
+    w.codec = TopKCodec(0.05)
+    w._resid = None
+    deltas = [_tree(np.random.default_rng(i)) for i in range(5)]
+    sent_total = None
+    for d in deltas:
+        _, sent = w._compress(d)
+        sent_total = (sent if sent_total is None else
+                      {k: {kk: sent_total[k][kk] + sent[k][kk]
+                           for kk in sent[k]} for k in sent})
+    for k in ("dense", "head"):
+        for kk in ("kernel", "bias"):
+            true = sum(d[k][kk] for d in deltas)
+            np.testing.assert_allclose(
+                sent_total[k][kk] + w._resid[k][kk], true,
+                rtol=1e-5, atol=1e-5,
+            )
+
+
+@pytest.mark.parametrize("compression", ["int8", "topk"])
+def test_downpour_learns_with_compressed_commits(compression):
+    from distkeras_tpu import DOWNPOUR
+
+    ds = blobs_dataset(n=2048)
+    t = DOWNPOUR(model_spec(), loss="sparse_softmax_cross_entropy",
+                 worker_optimizer="sgd", learning_rate=0.02, num_workers=4,
+                 batch_size=32, communication_window=2, num_epoch=3,
+                 backend="ps", compression=compression)
+    t.train(ds, shuffle=True)
+    assert final_loss(t) < 0.6, f"{compression}: {final_loss(t)}"
+
+
+def test_aeasgd_learns_with_compressed_elastic_commits():
+    from distkeras_tpu import AEASGD
+
+    ds = blobs_dataset(n=2048)
+    t = AEASGD(model_spec(), loss="sparse_softmax_cross_entropy",
+               worker_optimizer="sgd", learning_rate=0.05, rho=0.5,
+               num_workers=4, batch_size=32, communication_window=4,
+               num_epoch=3, backend="ps", compression="int8")
+    t.train(ds, shuffle=True)
+    assert final_loss(t) < 0.6, final_loss(t)
+
+
+def test_compressed_commits_over_real_tcp():
+    """Server-side decode across the actual socket transport."""
+    from distkeras_tpu import ADAG
+
+    ds = blobs_dataset(n=1024)
+    t = ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+             worker_optimizer="sgd", learning_rate=0.1, num_workers=2,
+             batch_size=32, communication_window=2, num_epoch=2,
+             backend="ps", ps_transport="socket", compression="topk")
+    t.train(ds, shuffle=True)
+    assert final_loss(t) < 0.6
+
+
+def test_compression_rejected_off_the_ps_backend():
+    from distkeras_tpu import ADAG, DOWNPOUR
+
+    with pytest.raises(ValueError, match="backend='ps'"):
+        ADAG(model_spec(), num_workers=2, compression="int8")
+    with pytest.raises(ValueError, match="native"):
+        DOWNPOUR(model_spec(), num_workers=2, backend="ps",
+                 ps_transport="native", compression="int8")
+    with pytest.raises(ValueError, match="unknown compression"):
+        DOWNPOUR(model_spec(), num_workers=2, backend="ps",
+                 compression="gzip")
