@@ -418,7 +418,7 @@ def run_lm_decode_config(accel):
     KV-cache-bandwidth-bound — the cache is read end to end every step — so
     the GQA/MQA legs (kv_heads=2/1: 4x/8x smaller caches) are the
     performance configurations."""
-    from distkeras_tpu.models import generate, quantize_lm, transformer_lm
+    from distkeras_tpu.models import generate, transformer_lm
 
     B, PROMPT, NEW = 8, 128, 256
     out = {}
@@ -429,19 +429,12 @@ def run_lm_decode_config(accel):
         # the other cache lever: a sliding window shrinks the cache LENGTH
         # (ring buffer of `window` slots instead of maxlen)
         ("lm_decode_win256", None, 256),
-        # the WEIGHT lever: int8 weight-only serving (ops/quant.py Pallas
-        # kernel — int8 HBM reads, in-VMEM dequant). MQA already shrank the
-        # cache 8x, so per-step bytes are weight-dominated — exactly the
-        # regime quantization halves.
-        ("lm_decode_mqa_int8", 1, None),
     ):
         spec = transformer_lm(vocab=8192, maxlen=2048, dim=512, heads=8,
                               depth=8, dtype=jax.numpy.bfloat16,
                               attn_impl="flash", pos_embedding="rope",
                               kv_heads=kvh, attn_window=window)
         params, _ = spec.init_np(0)
-        if name.endswith("_int8"):
-            spec, params = quantize_lm(spec, params)
         params = jax.device_put(params, accel)
         rng = np.random.default_rng(0)
         prompt = rng.integers(0, 8192, size=(B, PROMPT)).astype(np.int32)
@@ -475,9 +468,60 @@ def run_lm_decode_config(accel):
         "mqa_vs_mha": round(out["lm_decode_mqa"]["decode_tokens_per_sec"]
                             / out["lm_decode_mha"]["decode_tokens_per_sec"],
                             2),
-        "int8_vs_mqa": round(
-            out["lm_decode_mqa_int8"]["decode_tokens_per_sec"]
-            / out["lm_decode_mqa"]["decode_tokens_per_sec"], 2),
+    }))
+    out.update(run_lm_decode_int8(accel))
+    return out
+
+
+def run_lm_decode_int8(accel):
+    """Int8 weight-only serving (ops/quant.py), measured where it applies:
+    a 400M-param MQA decoder whose per-step bytes are WEIGHT-dominated
+    (~810 MB bf16 weights vs a ~17 MB MQA cache), i.e. decode is on the
+    HBM-bandwidth roofline. The dim-512 config above is per-step
+    overhead-bound (~0.5 ms against an ~80 µs byte roofline), where
+    halving weight bytes cannot show — measured and rejected, 0.84×; the
+    quantization win needs bandwidth-bound decode, and at 400M params it
+    gets one."""
+    from distkeras_tpu.models import generate, quantize_lm, transformer_lm
+
+    B, PROMPT, NEW = 8, 128, 128
+    out = {}
+    spec = transformer_lm(vocab=16384, maxlen=1024, dim=2048, heads=16,
+                          depth=8, dtype=jax.numpy.bfloat16,
+                          attn_impl="flash", pos_embedding="rope",
+                          kv_heads=1)
+    params, _ = spec.init_np(0)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 16384, size=(B, PROMPT)).astype(np.int32)
+    for name, s, p in (
+        ("lm_decode_400m_bf16", spec, params),
+        ("lm_decode_400m_int8", *quantize_lm(spec, params)),
+    ):
+        p = jax.device_put(p, accel)
+        t0 = time.perf_counter()
+        generate(s, p, prompt, NEW)
+        log(f"  [{name}] compile+first decode: {time.perf_counter()-t0:.1f}s")
+        ts = []
+        for r in range(5):  # ~0.2 s each; medians ride out tunnel hiccups
+            t0 = time.perf_counter()
+            generate(s, p, prompt, NEW, seed=r + 1)
+            ts.append(time.perf_counter() - t0)
+        t = float(np.median(ts))
+        rec = {
+            "config": name,
+            "decode_tokens_per_sec": round(B * NEW / t, 1),
+            "ms_per_step": round(1e3 * t / NEW, 3),
+            "batch": B, "new_tokens": NEW,
+            "spread": round((max(ts) - min(ts)) / t, 3),
+        }
+        log(json.dumps(rec))
+        out[name] = rec
+        del p
+    log(json.dumps({
+        "config": "lm_decode_int8_summary",
+        "int8_vs_bf16_400m": round(
+            out["lm_decode_400m_int8"]["decode_tokens_per_sec"]
+            / out["lm_decode_400m_bf16"]["decode_tokens_per_sec"], 2),
     }))
     return out
 

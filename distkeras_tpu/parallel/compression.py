@@ -65,8 +65,15 @@ class Codec:
                 enc = [rec(v) for v in node]
                 return enc if isinstance(node, list) else tuple(enc)
             arr = np.asarray(node)
-            if arr.dtype == np.float32 and arr.size >= 16:
-                return {_LEAF: self.name, **self.encode_leaf(arr)}
+            # any floating dtype compresses (bf16/f16 via an f32 staging
+            # cast; the original dtype is restored on decode so the PS fold
+            # and the worker's feedback math see the dtypes they expect)
+            if np.issubdtype(arr.dtype, np.floating) or arr.dtype.name in (
+                "bfloat16", "float8_e4m3fn", "float8_e5m2"
+            ):
+                if arr.size >= 16:
+                    return {_LEAF: self.name, "dt": arr.dtype.name,
+                            **self.encode_leaf(arr.astype(np.float32))}
             return arr  # tiny/integer leaves: not worth a codec round-trip
         return {_MARK: self.name, "tree": rec(tree)}
 
@@ -74,15 +81,28 @@ class Codec:
         def rec(node):
             if isinstance(node, dict):
                 if _LEAF in node:
-                    return self.decode_leaf(node)
+                    return self.decode_leaf(node).astype(
+                        _resolve_dtype(node.get("dt", "float32"))
+                    )
                 return {k: rec(v) for k, v in node.items()}
             if isinstance(node, (list, tuple)):
-                # commit trees are dicts-of-dicts in every model family here;
-                # lists appear only for stacked/tuple params — preserve type
-                return type(node)(rec(v) for v in node) \
-                    if isinstance(node, tuple) else [rec(v) for v in node]
+                # preserve container types exactly: the worker's feedback
+                # tree.map and the PS fold require identical treedefs
+                enc = [rec(v) for v in node]
+                return enc if isinstance(node, list) else tuple(enc)
             return node
         return rec(blob["tree"])
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Dtype from its wire name; extended floats resolve via ml_dtypes
+    (jax's numpy extension — present wherever this framework runs)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
 
 
 class Int8Codec(Codec):
@@ -128,11 +148,36 @@ class TopKCodec(Codec):
 _REGISTRY = {"int8": Int8Codec, "topk": TopKCodec}
 
 
+def register_codec(cls: type[Codec]) -> type[Codec]:
+    """Register a custom codec class under ``cls.name`` (usable as a
+    decorator). The PS decodes commits by name with a fresh ``cls()``, so
+    a codec's ``decode_leaf`` must not depend on constructor configuration
+    (the built-ins obey this: top-k's ``frac`` only shapes *encoding*) —
+    and the registration must run in the PS owner's process too when the
+    server is external (nothing but the name crosses the wire)."""
+    if not (isinstance(cls, type) and issubclass(cls, Codec)):
+        raise TypeError(f"register_codec expects a Codec subclass, got {cls}")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
 def resolve_codec(compression) -> Codec | None:
-    """Trainer kwarg → codec: ``None``, a name, or a Codec instance."""
+    """Trainer kwarg → codec: ``None``, a registered name, or a Codec
+    instance (auto-registered by name so the in-process PS can decode;
+    external PS processes must :func:`register_codec` themselves)."""
     if compression is None:
         return None
     if isinstance(compression, Codec):
+        cls = type(compression)
+        reg = _REGISTRY.get(cls.name)
+        if reg is None:
+            _REGISTRY[cls.name] = cls
+        elif reg is not cls:
+            raise ValueError(
+                f"codec name {cls.name!r} is already registered to "
+                f"{reg.__name__}; give your codec a unique `name` (decode "
+                f"dispatches by name on the PS side)"
+            )
         return compression
     if isinstance(compression, str):
         if compression in _REGISTRY:

@@ -12,10 +12,12 @@ import numpy as np
 import pytest
 
 from distkeras_tpu.parallel.compression import (
+    Codec,
     Int8Codec,
     TopKCodec,
     is_encoded,
     maybe_decode,
+    register_codec,
     resolve_codec,
 )
 from tests.test_trainers import blobs_dataset, final_loss, model_spec
@@ -113,6 +115,51 @@ def test_resolve_codec():
     assert resolve_codec(c) is c
     with pytest.raises(ValueError, match="unknown compression"):
         resolve_codec("gzip")
+
+
+def test_bf16_leaves_compress_and_keep_dtype(rng):
+    """bf16 commit trees (bf16-param models) must actually compress —
+    a silent dense passthrough would fake the wire savings — and decode
+    back to bf16 so the PS fold and feedback math keep their dtypes."""
+    import jax.numpy as jnp
+
+    arr = np.asarray(jnp.asarray(rng.normal(size=(32, 32)), jnp.bfloat16))
+    blob = Int8Codec().encode({"w": arr})
+    leaf = blob["tree"]["w"]
+    assert "__dk_leaf__" in leaf and leaf["q"].dtype == np.int8
+    out = Int8Codec().decode(blob)["w"]
+    assert out.dtype == arr.dtype
+    step = float(np.max(np.abs(arr.astype(np.float32)))) / 127.0
+    err = np.abs(out.astype(np.float32) - arr.astype(np.float32))
+    # half a quantization step + bf16 representation granularity
+    assert float(np.max(err)) <= 0.5 * step + 0.01
+
+
+def test_custom_codec_registers_and_decodes_at_the_ps(rng):
+    """The documented 'or a Codec instance' API end-to-end: a user codec
+    resolves, auto-registers by name, and the PS-side maybe_decode finds
+    it; a name collision with a different class is rejected loudly."""
+    class HalfCodec(Codec):
+        name = "half-test"
+
+        def encode_leaf(self, arr):
+            return {"h": arr.astype(np.float16)}
+
+        def decode_leaf(self, blob):
+            return blob["h"].astype(np.float32)
+
+    c = resolve_codec(HalfCodec())
+    tree = {"w": rng.normal(size=(8, 8)).astype(np.float32)}
+    out = maybe_decode(c.encode(tree))  # PS-side dispatch by name
+    np.testing.assert_allclose(out["w"], tree["w"], atol=1e-2)
+
+    class Impostor(Codec):
+        name = "half-test"
+
+    with pytest.raises(ValueError, match="already registered"):
+        resolve_codec(Impostor())
+    with pytest.raises(TypeError, match="Codec subclass"):
+        register_codec(object)
 
 
 def test_error_feedback_telescopes(rng):
