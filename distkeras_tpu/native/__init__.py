@@ -89,6 +89,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dkps_client_pull.argtypes = [ctypes.c_void_p, f32p]
     lib.dkps_client_commit.restype = ctypes.c_int
     lib.dkps_client_commit.argtypes = [ctypes.c_void_p, f32p]
+    lib.dkps_client_commit_int8.restype = ctypes.c_int
+    lib.dkps_client_commit_int8.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int8),
+        ctypes.POINTER(ctypes.c_uint64), f32p, ctypes.c_uint32,
+    ]
     lib.dkps_client_close.restype = None
     lib.dkps_client_close.argtypes = [ctypes.c_void_p]
     return lib

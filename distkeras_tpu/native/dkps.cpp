@@ -28,7 +28,11 @@
 //   handshake: 6-byte magic "DKPS1\n" + u32 worker_id + u64 n_floats
 //              server replies u8 (1 = accepted, 0 = length mismatch)
 //   request:   u8 action; 1=PULL, 2=COMMIT (followed by n*4 payload bytes),
-//              3=BYE
+//              3=BYE, 4=COMMIT_INT8 (u32 S segments, then S x (u64 len +
+//              f32 scale) headers with sum(len) validated == n, then n int8
+//              bytes — the compressed-commit wire: 4x fewer payload bytes,
+//              dequantized per segment into the fold, matching
+//              parallel/compression.py's Int8Codec per-leaf scales)
 //   reply:     PULL -> u64 center_version + n*4 bytes; COMMIT -> u8 ack
 //
 // Concurrency model matches the reference: accept loop + one handler thread
@@ -109,8 +113,26 @@ struct Server {
   std::vector<int> conn_fds;
   std::vector<std::thread> handlers;
 
+  // fold scale for one commit from conn_wid_'s staleness — call under mu
+  float fold_scale_locked() {
+    float s = static_cast<float>(fixed_scale);
+    if (mode == MODE_INV_STALENESS) {
+      uint64_t pv = 0;
+      auto it = pull_versions.find(conn_wid_);
+      if (it != pull_versions.end()) pv = it->second;
+      uint64_t tau = num_updates - pv;
+      s = static_cast<float>(1.0 / (static_cast<double>(tau) + 1.0));
+    }
+    return s;
+  }
+
   void handle(int fd) {
     std::vector<float> buf(n);
+    // int8-commit scratch, sized lazily on first use and reused across
+    // commits (the wire hot path must not heap-allocate per message)
+    std::vector<int8_t> qbuf;
+    std::vector<uint64_t> lens;
+    std::vector<float> scales;
     for (;;) {
       uint8_t action;
       if (!recv_all(fd, &action, 1)) break;
@@ -133,17 +155,51 @@ struct Server {
         uint8_t ack = 1;
         {
           std::lock_guard<std::mutex> g(mu);
-          float s = static_cast<float>(fixed_scale);
-          if (mode == MODE_INV_STALENESS) {
-            uint64_t pv = 0;
-            auto it = pull_versions.find(conn_wid_);
-            if (it != pull_versions.end()) pv = it->second;
-            uint64_t tau = num_updates - pv;
-            s = static_cast<float>(1.0 / (static_cast<double>(tau) + 1.0));
-          }
+          const float s = fold_scale_locked();
           float* c = center.data();
           const float* d = buf.data();
           for (uint64_t i = 0; i < n; ++i) c[i] += d[i] * s;
+          num_updates += 1;
+        }
+        if (!send_all(fd, &ack, 1)) break;
+      } else if (action == 4) {  // COMMIT_INT8: per-segment scaled int8
+        uint32_t segs;
+        if (!recv_all(fd, &segs, 4)) break;
+        // segment count and lengths are validated against the pinned n
+        // BEFORE any allocation beyond n bytes — a hostile header cannot
+        // oversize the payload or overflow the fold loop's bounds
+        if (segs == 0 || segs > (1u << 20) || segs > n) break;
+        lens.resize(segs);
+        scales.resize(segs);
+        uint64_t total = 0;
+        bool bad = false;
+        for (uint32_t i = 0; i < segs; ++i) {
+          if (!recv_all(fd, &lens[i], 8) || !recv_all(fd, &scales[i], 4)) {
+            bad = true;
+            break;
+          }
+          if (lens[i] > n || total + lens[i] > n) {  // no u64 wrap possible
+            bad = true;
+            break;
+          }
+          total += lens[i];
+        }
+        if (bad || total != n) break;
+        if (qbuf.size() != n) qbuf.resize(n);
+        if (!recv_all(fd, qbuf.data(), n)) break;
+        uint8_t ack = 1;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          const float s = fold_scale_locked();
+          float* c = center.data();
+          uint64_t off = 0;
+          for (uint32_t seg = 0; seg < segs; ++seg) {
+            const float ss = s * scales[seg];
+            const int8_t* d = qbuf.data() + off;
+            for (uint64_t i = 0; i < lens[seg]; ++i)
+              c[off + i] += ss * static_cast<float>(d[i]);
+            off += lens[seg];
+          }
           num_updates += 1;
         }
         if (!send_all(fd, &ack, 1)) break;
@@ -393,6 +449,28 @@ int dkps_client_commit(void* h, const float* buf) {
   if (!send_all(c->fd, &action, 1) ||
       !send_all(c->fd, buf, c->n * sizeof(float)) ||
       !recv_all(c->fd, &ack, 1) || ack != 1)
+    return -1;
+  return 0;
+}
+
+// int8 commit: `q` is the full n-byte quantized vector, segmented into
+// `segs` runs of `lens[i]` values sharing `scales[i]` (per-leaf scales on
+// the Python side). One gathered header buffer, then the payload.
+int dkps_client_commit_int8(void* h, const int8_t* q, const uint64_t* lens,
+                            const float* scales, uint32_t segs) {
+  auto* c = static_cast<Client*>(h);
+  std::vector<char> header(1 + 4 + static_cast<size_t>(segs) * 12);
+  header[0] = 4;
+  std::memcpy(header.data() + 1, &segs, 4);
+  char* p = header.data() + 5;
+  for (uint32_t i = 0; i < segs; ++i) {
+    std::memcpy(p, &lens[i], 8);
+    std::memcpy(p + 8, &scales[i], 4);
+    p += 12;
+  }
+  uint8_t ack = 0;
+  if (!send_all(c->fd, header.data(), header.size()) ||
+      !send_all(c->fd, q, c->n) || !recv_all(c->fd, &ack, 1) || ack != 1)
     return -1;
   return 0;
 }

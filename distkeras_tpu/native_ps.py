@@ -233,9 +233,74 @@ class NativePSClient:
         return self.spec.unflatten(out)
 
     def commit(self, worker_id: int | None, payload: Pytree) -> None:
+        from distkeras_tpu.parallel.compression import is_encoded
+
+        if is_encoded(payload):
+            return self._commit_int8(payload)
         vec = np.ascontiguousarray(self.spec.flatten(payload))
         if self._lib.dkps_client_commit(self._handle, _f32p(vec)) != 0:
             raise ConnectionError("dkps commit failed (server gone?)")
+
+    def _commit_int8(self, blob: dict) -> None:
+        """Ship an Int8Codec blob on the segmented-int8 wire (action 4):
+        4× fewer payload bytes; the C++ fold dequantizes per segment with
+        the same per-leaf scales, so the center sees exactly the tree
+        ``Int8Codec.decode`` yields (the worker's feedback residual is
+        computed against that same tree)."""
+        import jax
+
+        from distkeras_tpu.parallel.compression import _LEAF, _MARK
+
+        if blob[_MARK] != "int8":
+            raise ValueError(
+                f"ps_transport='native' carries compression='int8' only; "
+                f"got codec {blob[_MARK]!r} (use ps_transport='socket')"
+            )
+        leaves = jax.tree.flatten(
+            blob["tree"],
+            is_leaf=lambda x: isinstance(x, dict) and _LEAF in x,
+        )[0]
+        if len(leaves) != len(self.spec.sizes):
+            raise ValueError(
+                f"blob has {len(leaves)} leaves, spec expects "
+                f"{len(self.spec.sizes)}"
+            )
+        segs = len(leaves)
+        qv = np.empty(self.spec.n, np.int8)
+        scales = np.empty(segs, np.float32)
+        off = 0
+        for i, (leaf, size) in enumerate(zip(leaves, self.spec.sizes)):
+            if not (isinstance(leaf, dict) and _LEAF in leaf):
+                raise ValueError(
+                    "native int8 commits need every float leaf encoded "
+                    "(Int8Codec(min_size=1) — run_async_training sets this)"
+                )
+            if leaf.get("dt", "float32") != "float32":
+                # the C++ fold applies q*scale in f32; a non-f32 wire dtype
+                # would make the center differ from Int8Codec.decode (which
+                # rounds back to the leaf dtype) and break the feedback
+                # invariant — bf16-param models use the pickle wire
+                raise ValueError(
+                    f"leaf {i}: native int8 wire carries float32 leaves "
+                    f"only, got {leaf['dt']!r}; use ps_transport='socket'"
+                )
+            q = np.ravel(leaf["q"], order="C")
+            if q.size != size:
+                raise ValueError(
+                    f"leaf {i}: blob size {q.size} != spec size {size}"
+                )
+            qv[off:off + size] = q
+            scales[i] = leaf["s"]
+            off += size
+        lens = np.asarray(self.spec.sizes, np.uint64)
+        rc = self._lib.dkps_client_commit_int8(
+            self._handle,
+            qv.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            _f32p(scales), segs,
+        )
+        if rc != 0:
+            raise ConnectionError("dkps int8 commit failed (server gone?)")
 
     def set_timeout(self, seconds: float | None) -> None:
         """Bound every subsequent round-trip (0/None = block forever)."""

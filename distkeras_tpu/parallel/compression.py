@@ -48,6 +48,10 @@ class Codec:
     """
 
     name: str = "identity"
+    #: leaves smaller than this pass through uncompressed (header overhead
+    #: beats the savings); the native int8 wire sets 1 — every float leaf
+    #: must ride the segmented wire
+    min_size: int = 16
 
     def encode_leaf(self, arr: np.ndarray) -> dict:
         raise NotImplementedError
@@ -71,7 +75,7 @@ class Codec:
             if np.issubdtype(arr.dtype, np.floating) or arr.dtype.name in (
                 "bfloat16", "float8_e4m3fn", "float8_e5m2"
             ):
-                if arr.size >= 16:
+                if arr.size >= self.min_size:
                     return {_LEAF: self.name, "dt": arr.dtype.name,
                             **self.encode_leaf(arr.astype(np.float32))}
             return arr  # tiny/integer leaves: not worth a codec round-trip
@@ -82,7 +86,8 @@ class Codec:
             if isinstance(node, dict):
                 if _LEAF in node:
                     return self.decode_leaf(node).astype(
-                        _resolve_dtype(node.get("dt", "float32"))
+                        _resolve_dtype(node.get("dt", "float32")),
+                        copy=False,  # f32 (the common case) is a no-op
                     )
                 return {k: rec(v) for k, v in node.items()}
             if isinstance(node, (list, tuple)):
@@ -109,6 +114,9 @@ class Int8Codec(Codec):
     """Symmetric per-leaf absmax int8 (~4× smaller commits)."""
 
     name = "int8"
+
+    def __init__(self, min_size: int = 16):
+        self.min_size = int(min_size)
 
     def encode_leaf(self, arr: np.ndarray) -> dict:
         amax = float(np.max(np.abs(arr)))
@@ -171,6 +179,15 @@ def resolve_codec(compression) -> Codec | None:
         cls = type(compression)
         reg = _REGISTRY.get(cls.name)
         if reg is None:
+            try:
+                cls()  # the PS decodes with a fresh cls() — fail HERE,
+            except TypeError as e:  # not mid-training in a handler thread
+                raise ValueError(
+                    f"codec class {cls.__name__} must be constructible "
+                    f"with no arguments for PS-side decode (got: {e}); "
+                    f"give constructor params defaults that leave decode "
+                    f"semantics unchanged"
+                ) from e
             _REGISTRY[cls.name] = cls
         elif reg is not cls:
             raise ValueError(

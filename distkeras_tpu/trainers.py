@@ -496,18 +496,22 @@ class DistributedTrainer(Trainer):
         # see parallel/compression.py. The collective backend's merges are
         # XLA psums over ICI, where compression has nothing to buy.
         if compression is not None:
-            from distkeras_tpu.parallel.compression import resolve_codec
+            from distkeras_tpu.parallel.compression import (
+                Int8Codec,
+                resolve_codec,
+            )
 
-            resolve_codec(compression)  # fail fast on bad values
+            codec = resolve_codec(compression)  # fail fast on bad values
             if backend != "ps":
                 raise ValueError(
                     "compression applies to backend='ps' only (collective "
                     "merges ride ICI psums, not a wire)"
                 )
-            if ps_transport == "native":
+            if ps_transport == "native" and type(codec) is not Int8Codec:
                 raise ValueError(
-                    "compression is not supported on ps_transport='native' "
-                    "(its C++ wire is flat f32); use 'socket' or 'inprocess'"
+                    "ps_transport='native' supports the stock "
+                    "compression='int8' only (its C++ fold is that codec); "
+                    "use 'socket' for other codecs"
                 )
         self.compression = compression
         # device_data=True stages each epoch in HBM and scans all windows in

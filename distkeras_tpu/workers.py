@@ -253,17 +253,25 @@ def run_async_training(trainer, ds, shuffle: bool):
             restored_updates = int(payload.get("num_updates", 0))
             start_epoch = int(payload["epoch"]) + 1
 
-    from distkeras_tpu.parallel.compression import resolve_codec
+    from distkeras_tpu.parallel.compression import Int8Codec, resolve_codec
 
     transport = getattr(trainer, "ps_transport", "inprocess")
     external_host = getattr(trainer, "ps_host", None)
     offset = int(getattr(trainer, "worker_id_offset", 0))
     codec = resolve_codec(getattr(trainer, "compression", None))
     if codec is not None and transport == "native":
-        raise ValueError(
-            "compression is not supported on ps_transport='native' (its "
-            "C++ wire is flat f32); use 'socket' or 'inprocess'"
-        )
+        # exact type, not isinstance: the C++ fold implements the STOCK
+        # Int8Codec semantics — silently swapping a subclass's custom
+        # encode/decode for them would train with the wrong quantizer
+        if type(codec) is not Int8Codec:
+            raise ValueError(
+                f"ps_transport='native' supports the stock compression="
+                f"'int8' only (its C++ fold IS that codec); "
+                f"{type(codec).__name__} needs ps_transport='socket'"
+            )
+        # every float leaf must ride the segmented wire: the flat frame has
+        # no raw-passthrough representation for tiny leaves
+        codec = Int8Codec(min_size=1)
     if external_host is not None:
         # External PS (another process/host — the reference's driver-hosted
         # PS serving remote executors): this process contributes W workers;

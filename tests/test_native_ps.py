@@ -269,3 +269,78 @@ def test_native_vs_socket_transport_same_result():
     for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
                                    rtol=5e-5, atol=1e-6)
+
+
+def test_native_int8_commit_wire_matches_codec_decode(rng):
+    """Action 4 (segmented int8): the C++ fold must see exactly the tree
+    Int8Codec.decode yields — per-leaf scales applied per segment — so
+    worker-side error feedback matches what the center received."""
+    from distkeras_tpu.parallel.compression import Int8Codec
+
+    center = {"dense": {"kernel": np.zeros((16, 8), np.float32),
+                        "bias": np.zeros(8, np.float32)},
+              "gain": np.zeros(3, np.float32)}
+    ps = make_server(center, DownpourMerge(), num_workers=1)
+    try:
+        c = make_client(ps, 0)
+        codec = Int8Codec(min_size=1)
+        delta = {"dense": {"kernel": rng.normal(size=(16, 8)).astype(np.float32),
+                           "bias": rng.normal(size=8).astype(np.float32)},
+                 "gain": rng.normal(size=3).astype(np.float32)}
+        blob = codec.encode(delta)
+        c.pull()
+        c.commit(0, blob)           # rides the int8 wire
+        got = ps.get_model()
+        want = codec.decode(blob)   # DOWNPOUR fold: center += decoded
+        import jax
+
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+        assert ps.num_updates == 1
+        c.close()
+    finally:
+        ps.stop()
+
+
+def test_native_int8_rejects_malformed_segments(rng):
+    """Hostile/garbled segment headers (lengths not summing to the pinned
+    n) drop the connection without folding or oversizing anything."""
+    import ctypes
+
+    from distkeras_tpu.native_ps import _f32p
+
+    center = {"w": np.zeros(64, np.float32)}
+    ps = make_server(center, DownpourMerge(), num_workers=1)
+    try:
+        c = make_client(ps, 0)
+        qv = np.ones(64, np.int8)
+        lens = np.asarray([100], np.uint64)  # != n: must be rejected
+        scales = np.ones(1, np.float32)
+        rc = c._lib.dkps_client_commit_int8(
+            c._handle,
+            qv.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            _f32p(scales), 1,
+        )
+        assert rc != 0                      # no ack: connection dropped
+        assert ps.num_updates == 0
+        np.testing.assert_array_equal(ps.get_model()["w"], 0.0)
+        c.close()
+    finally:
+        ps.stop()
+
+
+def test_native_transport_trains_with_int8_compression():
+    """End-to-end: DOWNPOUR over the native transport with
+    compression='int8' — commits ride the segmented wire (4x fewer
+    payload bytes) and training still converges."""
+    from distkeras_tpu import DOWNPOUR
+
+    ds = blobs_dataset(n=2048)
+    t = DOWNPOUR(model_spec(), loss="sparse_softmax_cross_entropy",
+                 worker_optimizer="sgd", learning_rate=0.02, num_workers=4,
+                 batch_size=32, communication_window=2, num_epoch=3,
+                 backend="ps", ps_transport="native", compression="int8")
+    t.train(ds, shuffle=True)
+    assert final_loss(t) < 0.6, final_loss(t)
