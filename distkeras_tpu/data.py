@@ -226,6 +226,80 @@ class Dataset:
         return f"Dataset({len(self)} rows; {cols})"
 
 
+def prefetch_to_device(iterable, place, depth: int = 2):
+    """Run ``place`` (host→device placement) ``depth`` items ahead of the
+    consumer, on a background thread.
+
+    The streaming input pipeline (SURVEY.md §7.3 hard part #4 — "sharded
+    per-chip streams that don't bottleneck the chip"): JAX dispatch is
+    already asynchronous, so what a naive feed loop serializes with the
+    device is the HOST work per step — numpy slicing/assembly in
+    ``superbatches`` and the ``device_put`` staging copy. This generator
+    moves that work off the consumer's critical path: a bounded queue of
+    already-placed batches stays ``depth`` deep, so the device never waits
+    for batch ``k+1``'s host prep while ``k`` computes.
+
+    Exceptions from the producer (bad batch, placement failure) re-raise in
+    the consumer; an early-exiting consumer (e.g. a raised training error)
+    unblocks and joins the thread via generator close. Ordering is exactly
+    the source iterable's, so prefetched training is bit-identical to the
+    plain loop.
+
+    Memory: up to ``depth + 1`` placed batches are resident at once (the
+    queue plus the producer's in-flight one) on top of the consumer's —
+    size ``depth`` for the device-memory headroom you have. Depth 1
+    (double buffering) already hides the host prep; more only helps when
+    step times vary a lot.
+    """
+    import queue
+    import threading
+
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1, got {depth}")
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    _END, _ERR = object(), object()
+
+    def put_until_stopped(item) -> bool:
+        """Deliver unless the consumer already left; never give up early —
+        a dropped _END/_ERR sentinel would strand the consumer on q.get()."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in iterable:
+                if not put_until_stopped(place(item)):
+                    return
+            put_until_stopped(_END)
+        except BaseException as e:  # surface in the consumer, don't die silent
+            put_until_stopped((_ERR, e))
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+                raise item[1]
+            yield item
+    finally:
+        stop.set()
+        while not q.empty():  # unblock a producer stuck on a full queue
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        t.join(timeout=5)
+
+
 def padded_chunks(
     cols: Sequence[np.ndarray], batch_size: int
 ) -> Iterator[tuple[list[np.ndarray], int]]:

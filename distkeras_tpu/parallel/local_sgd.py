@@ -199,12 +199,19 @@ class LocalSGDEngine:
         )
         self._batch_sharding = self._shard
 
-    def run_window(self, state: TrainState, batch_arrays: tuple):
-        """Run one communication window. ``batch_arrays``: [W, window, B, …]."""
-        batch = tuple(
+    def place_batch(self, batch_arrays: tuple) -> tuple:
+        """Host superbatch → worker-sharded global arrays (run_window's
+        placement, exposed for the prefetching input pipeline)."""
+        return tuple(
             put_global(a, self._batch_sharding) for a in batch_arrays
         )
-        return self._window_step(state, batch)
+
+    def run_window(self, state: TrainState, batch_arrays: tuple):
+        """Run one communication window. ``batch_arrays``: [W, window, B, …]
+        host arrays, or already-placed arrays from :meth:`place_batch`."""
+        if not isinstance(batch_arrays[0], jax.Array):
+            batch_arrays = self.place_batch(batch_arrays)
+        return self._window_step(state, batch_arrays)
 
     # -- device-resident dataset (upload once, shuffle on device) ------------
 

@@ -278,13 +278,21 @@ class SPMDEngine:
                 f"{self.grad_accum} × dp {dp} = {self.grad_accum * dp}"
             )
 
-    def run_step(self, params, nt, opt_state, batch_arrays: tuple):
-        """One global-batch step; ``batch_arrays`` host arrays ``[B, …]``."""
-        self._check_batch(batch_arrays[0].shape[0])
-        batch = tuple(
+    def place_batch(self, batch_arrays: tuple) -> tuple:
+        """Host batch → dp-sharded global arrays (run_step's placement,
+        exposed so the prefetching input pipeline can do it ahead of time
+        on a background thread — ``data.prefetch_to_device``)."""
+        return tuple(
             put_global(a, self._batch_sharding) for a in batch_arrays
         )
-        return self._step(params, nt, opt_state, batch)
+
+    def run_step(self, params, nt, opt_state, batch_arrays: tuple):
+        """One global-batch step; ``batch_arrays`` host arrays ``[B, …]``
+        (or already-placed global arrays from :meth:`place_batch`)."""
+        self._check_batch(batch_arrays[0].shape[0])
+        if not isinstance(batch_arrays[0], jax.Array):
+            batch_arrays = self.place_batch(batch_arrays)
+        return self._step(params, nt, opt_state, batch_arrays)
 
     # -- device-resident epoch (upload once, whole epoch in one dispatch) ----
 

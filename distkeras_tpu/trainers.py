@@ -39,7 +39,7 @@ import numpy as np
 import optax
 
 from distkeras_tpu import utils
-from distkeras_tpu.data import Dataset, padded_chunks
+from distkeras_tpu.data import Dataset, padded_chunks, prefetch_to_device
 from distkeras_tpu.model import ModelSpec, from_keras, keras_weights_to_model
 from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.parallel.local_sgd import LocalSGDEngine
@@ -443,6 +443,7 @@ class DistributedTrainer(Trainer):
                  profile_dir=None,
                  log_metrics: bool = False,
                  tolerate_worker_failures: bool = False,
+                 prefetch: int = 1,
                  clipnorm=None, clipvalue=None, validation_data=None):
         super().__init__(keras_model, loss, worker_optimizer,
                          learning_rate=learning_rate, seed=seed,
@@ -527,6 +528,14 @@ class DistributedTrainer(Trainer):
         # regime matters.
         self.device_data = device_data
         self.device_data_budget_bytes = 512 * 1024 * 1024
+        # Streaming input pipeline depth (SURVEY.md §7.3 #4): superbatches
+        # are assembled and placed on device `prefetch` windows ahead on a
+        # background thread; 0 = plain synchronous feed. Bit-identical
+        # either way (ordering preserved); resident mode makes it moot.
+        # Default 1 (double buffering): hides the host prep while keeping
+        # only ~2 extra placed superbatches resident — raise it only with
+        # HBM headroom to spare.
+        self.prefetch = int(prefetch)
         # Checkpoint/resume (absent in the reference — SURVEY.md §5.4):
         # snapshot full TrainState every `checkpoint_every` epochs;
         # checkpoint_async=True writes on a background thread (the next
@@ -687,10 +696,15 @@ class DistributedTrainer(Trainer):
                 seed = (self.seed + epoch) if shuffle else None
                 t0 = time.perf_counter() if self.log_metrics else 0.0
                 n_windows = 0
-                for batch in ds.superbatches(
+                batch_iter = ds.superbatches(
                     self.num_workers, self.batch_size,
                     self.communication_window, cols, seed=seed,
-                ):
+                )
+                if self.prefetch:
+                    batch_iter = prefetch_to_device(
+                        batch_iter, engine.place_batch, depth=self.prefetch
+                    )
+                for batch in batch_iter:
                     state, loss = engine.run_window(state, batch)
                     self.history.append(loss=loss, epoch=epoch)
                     n_windows += 1
@@ -911,7 +925,7 @@ class MeshTrainer(Trainer):
                  checkpoint_dir=None, checkpoint_every: int = 1,
                  resume: bool = False, checkpoint_async: bool = False,
                  profile_dir=None,
-                 input_mode: str = "auto",
+                 input_mode: str = "auto", prefetch: int = 1,
                  clipnorm=None, clipvalue=None, validation_data=None):
         from distkeras_tpu.parallel.strategies import STRATEGIES
         from distkeras_tpu.parallel.tensor import get_mesh_nd
@@ -964,6 +978,8 @@ class MeshTrainer(Trainer):
                 f"'resident'"
             )
         self.input_mode = input_mode
+        # streaming prefetch depth (see DistributedTrainer.prefetch)
+        self.prefetch = int(prefetch)
 
     def _build_engine(self):
         """Construct the strategy's engine + params re-layout callables."""
@@ -1122,7 +1138,13 @@ class MeshTrainer(Trainer):
                     seed = (self.seed + epoch) if shuffle else None
                     t0 = time.perf_counter() if self.log_metrics else 0.0
                     n_steps = 0
-                    for b in ds.batches(self.batch_size, cols, seed=seed):
+                    batch_iter = ds.batches(self.batch_size, cols, seed=seed)
+                    if self.prefetch:
+                        batch_iter = prefetch_to_device(
+                            batch_iter, engine.place_batch,
+                            depth=self.prefetch,
+                        )
+                    for b in batch_iter:
                         params, nt, opt, loss = engine.run_step(
                             params, nt, opt, b
                         )

@@ -148,3 +148,118 @@ def test_worker_shards_cover_all_wraps_tail():
     shards = ds.worker_shards(2, 8, 2, ["features"], seed=1, cover_all=True)
     rows = (shards[0][..., 0].reshape(-1) / 4).astype(int)
     assert set(rows.tolist()) == set(range(100))  # every row present
+
+
+# -- streaming input pipeline (prefetch_to_device) --------------------------
+
+
+def test_prefetch_preserves_order_and_applies_place():
+    from distkeras_tpu.data import prefetch_to_device
+
+    items = [np.full((4,), i, np.float32) for i in range(10)]
+    out = list(prefetch_to_device(iter(items), lambda x: x + 1, depth=3))
+    assert len(out) == 10
+    for i, o in enumerate(out):
+        np.testing.assert_array_equal(o, np.full((4,), i + 1, np.float32))
+
+
+def test_prefetch_propagates_producer_errors():
+    from distkeras_tpu.data import prefetch_to_device
+
+    def gen():
+        yield np.zeros(2)
+        raise RuntimeError("boom mid-epoch")
+
+    it = prefetch_to_device(gen(), lambda x: x, depth=2)
+    next(it)
+    with pytest.raises(RuntimeError, match="boom mid-epoch"):
+        next(it)
+
+
+def test_prefetch_early_close_unblocks_producer():
+    import threading
+    import time
+
+    from distkeras_tpu.data import prefetch_to_device
+
+    before = set(threading.enumerate())
+    it = prefetch_to_device(iter(range(10_000)), lambda x: x, depth=1)
+    assert next(it) == 0
+    spawned = [t for t in threading.enumerate() if t not in before]
+    assert len(spawned) == 1  # exactly the producer thread
+    it.close()  # consumer bails early: producer must unblock and exit
+    deadline = time.time() + 5
+    while spawned[0].is_alive() and time.time() < deadline:
+        time.sleep(0.05)
+    assert not spawned[0].is_alive()
+
+
+def test_prefetch_error_delivery_outlives_slow_consumers():
+    """A producer error with a FULL queue must still reach a consumer that
+    drains slowly (>1s per step) — the sentinel may never be dropped."""
+    import time
+
+    from distkeras_tpu.data import prefetch_to_device
+
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("late boom")
+
+    it = prefetch_to_device(gen(), lambda x: x, depth=1)
+    got = [next(it)]
+    time.sleep(1.3)  # queue full + error pending while consumer is "busy"
+    got.append(next(it))
+    assert got == [1, 2]
+    with pytest.raises(RuntimeError, match="late boom"):
+        next(it)
+
+
+def test_prefetch_rejects_bad_depth():
+    from distkeras_tpu.data import prefetch_to_device
+
+    with pytest.raises(ValueError, match="depth"):
+        list(prefetch_to_device(iter([]), lambda x: x, depth=0))
+
+
+def test_streaming_prefetch_is_bit_identical_adag():
+    """The prefetched feed is the same batches in the same order through
+    the same placement — training must be bit-identical to prefetch=0."""
+    import jax
+
+    from distkeras_tpu import ADAG
+    from tests.test_trainers import blobs_dataset, model_spec
+
+    def run(prefetch):
+        t = ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+                 worker_optimizer="sgd", learning_rate=0.1, num_workers=4,
+                 batch_size=16, communication_window=2, num_epoch=2,
+                 device_data=False, prefetch=prefetch, seed=3)
+        return t.train(blobs_dataset(n=1024), shuffle=True)
+
+    a, b = run(0), run(2)
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_streaming_prefetch_is_bit_identical_mesh():
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models import mlp
+    from distkeras_tpu.trainers import MeshTrainer
+    from tests.test_trainers import blobs_dataset
+
+    def run(prefetch):
+        t = MeshTrainer(
+            mlp(input_shape=(16,), hidden=(32,), num_classes=4,
+                dtype=jnp.float32),
+            loss="sparse_softmax_cross_entropy", worker_optimizer="adam",
+            learning_rate=1e-3, mesh_shape={"dp": 8}, batch_size=32,
+            num_epoch=2, seed=5, input_mode="stream", prefetch=prefetch,
+        )
+        return t.train(blobs_dataset(n=512))
+
+    a, b = run(0), run(2)
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
