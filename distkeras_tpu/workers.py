@@ -276,11 +276,10 @@ def run_async_training(trainer, ds, shuffle: bool):
         # External PS (another process/host — the reference's driver-hosted
         # PS serving remote executors): this process contributes W workers;
         # the server owner holds the center and the global worker count.
-        if ckpt_dir:
-            raise NotImplementedError(
-                "checkpoint_dir with an external ps_host is not supported: "
-                "the center lives in the PS owner's process"
-            )
+        # checkpoint_dir here snapshots THIS process's worker states plus a
+        # pulled center copy; on resume the live PS's center is the truth
+        # (workers re-pull it), the saved copy is a disaster-recovery
+        # artifact for the PS owner. num_updates stays server-side.
         ps = None
         if transport == "native":
             from distkeras_tpu.native_ps import FlatSpec, NativePSClient
@@ -346,6 +345,7 @@ def run_async_training(trainer, ds, shuffle: bool):
 
     workers: list[AsyncWorker] = []
     barrier = None
+    snap_client = None
     ckpt_pred = None
     if ckpt_dir:
         from distkeras_tpu import checkpoint as ckpt
@@ -355,20 +355,41 @@ def run_async_training(trainer, ds, shuffle: bool):
         def ckpt_pred(epoch, _every=every, _n=trainer.num_epoch):
             return ckpt.should_checkpoint(epoch, _every, _n)
 
+        if ps is None:
+            # External PS: the center snapshot must NOT ride a training
+            # worker's connection — pull() records that worker's center
+            # version server-side, which would understate its DynSGD
+            # staleness after every checkpoint. A dedicated client with a
+            # sentinel worker id (no commits ever use it) keeps the
+            # snapshot read version-neutral for the real workers.
+            SNAP_WID = 2**32 - 1
+            if transport == "native":
+                from distkeras_tpu.native_ps import NativePSClient
+
+                snap_client = NativePSClient(
+                    external_host, int(getattr(trainer, "ps_port", 0)),
+                    SNAP_WID, clients[0].spec,
+                )
+            else:
+                snap_client = ParameterServerClient(
+                    external_host, int(getattr(trainer, "ps_port", 0)),
+                    SNAP_WID,
+                )
+
         def _checkpoint_action():
             # runs in one worker thread while all others wait at the barrier;
-            # only cadence-selected epochs reach the barrier at all
+            # only cadence-selected epochs reach the barrier at all. The
+            # update count stays with the server when it is external.
             epoch = workers[0]._epoch_done
-            ckpt.save_checkpoint(
-                ckpt_dir,
-                {
-                    "center": ps.get_model(),
-                    "workers": [w.snapshot for w in workers],
-                    "num_updates": ps.num_updates,
-                    "epoch": epoch,
-                },
-                step=epoch,
-            )
+            payload = {
+                "center": (ps.get_model() if ps is not None
+                           else snap_client.pull()),
+                "workers": [w.snapshot for w in workers],
+                "epoch": epoch,
+            }
+            if ps is not None:
+                payload["num_updates"] = ps.num_updates
+            ckpt.save_checkpoint(ckpt_dir, payload, step=epoch)
 
         barrier = threading.Barrier(W, action=_checkpoint_action)
 
@@ -442,6 +463,8 @@ def run_async_training(trainer, ds, shuffle: bool):
     if transport in ("socket", "native"):
         for c in clients:
             c.close()
+    if snap_client is not None:
+        snap_client.close()
     if ps is not None:
         ps.stop()
 

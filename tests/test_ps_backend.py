@@ -259,3 +259,66 @@ def test_ps_backend_validation_scores_after_run():
     assert "epoch" not in recs[0]
     assert np.isfinite(recs[0]["val_loss"])
     assert 0.0 <= recs[0]["val_accuracy"] <= 1.0
+
+
+def test_external_ps_checkpoint_resume(tmp_path):
+    """checkpoint_dir now works against an EXTERNAL PS: the trainer
+    snapshots its own workers (plus a pulled center copy for the PS
+    owner's disaster recovery), and resume restores worker state while the
+    live PS's center carries the training forward — the update count stays
+    server-side."""
+    from distkeras_tpu import DOWNPOUR
+    from distkeras_tpu import checkpoint as ckpt
+    from distkeras_tpu.models import mlp
+    import jax.numpy as jnp
+
+    W, WINDOW, BATCH, ROWS = 2, 2, 16, 512
+    spec = mlp(input_shape=(16,), hidden=(32,), num_classes=4,
+               dtype=jnp.float32)
+    params0, _ = spec.init_np(7)
+    ps = SocketParameterServer(params0, DownpourMerge(), W,
+                               host="127.0.0.1")
+    ps.initialize()
+    ps.start()
+    try:
+        ds = blobs_dataset(n=ROWS)
+
+        def make(num_epoch, resume):
+            return DOWNPOUR(
+                model_spec(), loss="sparse_softmax_cross_entropy",
+                worker_optimizer="sgd", learning_rate=0.02, num_workers=W,
+                batch_size=BATCH, communication_window=WINDOW,
+                num_epoch=num_epoch, backend="ps", ps_transport="socket",
+                ps_host="127.0.0.1", ps_port=ps.port,
+                checkpoint_dir=str(tmp_path), resume=resume,
+            )
+
+        make(2, resume=False).train(ds)          # epochs 0-1, checkpoints
+        wins = (ROWS // W) // (WINDOW * BATCH)   # 8 windows/worker/epoch
+        assert ps.num_updates == W * wins * 2
+
+        t2 = make(4, resume=True)                # resumes at epoch 2
+        t2.train(ds)
+        epochs = {r["epoch"] for r in t2.get_history() if "loss" in r}
+        assert epochs == {2, 3}, epochs          # only the resumed epochs
+        assert ps.num_updates == W * wins * 4    # count lives on the PS
+
+        payload, step = ckpt.restore_checkpoint(str(tmp_path))
+        assert step == 3
+        assert "num_updates" not in payload      # server-side by design
+        assert len(payload["workers"]) == W
+        # the saved center copy equals the live PS center: the final-epoch
+        # barrier happens after every commit, and the snapshot pull rides
+        # a dedicated sentinel-id client (worker staleness untouched)
+        import jax
+
+        live = ps.get_model()
+        for a, b in zip(jax.tree.leaves(payload["center"]),
+                        jax.tree.leaves(live)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # the snapshot client's sentinel id is distinct from every real
+        # worker's, so no training worker's pull version was touched
+        assert set(ps._pull_versions) >= {0, 1}
+        assert 2**32 - 1 in ps._pull_versions
+    finally:
+        ps.stop()
