@@ -42,13 +42,27 @@ class ParameterServer:
     (``ps_transport="inprocess"``).
     """
 
-    def __init__(self, center: Pytree, rule: MergeRule, num_workers: int):
+    def __init__(self, center: Pytree, rule: MergeRule, num_workers: int,
+                 ema_decay: float | None = None):
         self.center = utils.tree_to_numpy(center)
         self.rule = rule
         self.num_workers = int(num_workers)
         self.num_updates = 0
         self._lock = threading.Lock()
         self._pull_versions: dict[int, int] = {}
+        # Polyak/EMA averaging of the center, updated per commit (the
+        # classic async-SGD companion — the EASGD paper evaluates the
+        # averaged center). None = off; read with get_ema().
+        if ema_decay is not None:
+            ema_decay = float(ema_decay)
+            if not 0.0 <= ema_decay < 1.0:
+                raise ValueError(
+                    f"ema_decay must be in [0, 1), got {ema_decay}"
+                )
+        self.ema_decay = ema_decay
+        self._ema = (
+            jax_tree_copy(self.center) if ema_decay is not None else None
+        )
 
     # -- service lifecycle (no-ops for the in-process PS) --------------------
 
@@ -85,10 +99,27 @@ class ParameterServer:
                 )
             )
             self.num_updates += 1
+            if self._ema is not None:
+                # in place: the lock serializes every worker — no fresh
+                # model-sized allocations while holding it
+                d = self.ema_decay
+                import jax
+
+                def fma(e, c):
+                    e *= d
+                    e += (1.0 - d) * np.asarray(c, dtype=e.dtype)
+                    return e
+
+                jax.tree.map(fma, self._ema, self.center)
 
     def get_model(self) -> Pytree:
         with self._lock:
             return jax_tree_copy(self.center)
+
+    def get_ema(self) -> Pytree:
+        """The Polyak-averaged center (None unless ``ema_decay`` was set)."""
+        with self._lock:
+            return None if self._ema is None else jax_tree_copy(self._ema)
 
 
 def jax_tree_copy(tree: Pytree) -> Pytree:
@@ -107,8 +138,9 @@ class SocketParameterServer(ParameterServer):
     """
 
     def __init__(self, center: Pytree, rule: MergeRule, num_workers: int,
-                 host: str = "127.0.0.1", port: int = 0):
-        super().__init__(center, rule, num_workers)
+                 host: str = "127.0.0.1", port: int = 0,
+                 ema_decay: float | None = None):
+        super().__init__(center, rule, num_workers, ema_decay=ema_decay)
         self.host = host
         self.port = int(port)
         self._server_sock: Any = None

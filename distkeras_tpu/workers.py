@@ -272,6 +272,14 @@ def run_async_training(trainer, ds, shuffle: bool):
         # every float leaf must ride the segmented wire: the flat frame has
         # no raw-passthrough representation for tiny leaves
         codec = Int8Codec(min_size=1)
+    if getattr(trainer, "ema_decay", None) is not None and (
+        transport == "native" or external_host is not None
+    ):
+        # mirrors the trainer-constructor validation for direct callers
+        raise ValueError(
+            "ema_decay needs a local Python PS (the C++ fold keeps no "
+            "averaged center; an external PS owner configures EMA there)"
+        )
     if external_host is not None:
         # External PS (another process/host — the reference's driver-hosted
         # PS serving remote executors): this process contributes W workers;
@@ -316,7 +324,8 @@ def run_async_training(trainer, ds, shuffle: bool):
         ]
     elif transport == "socket":
         ps = SocketParameterServer(
-            params, rule, W, port=getattr(trainer, "ps_port", 0)
+            params, rule, W, port=getattr(trainer, "ps_port", 0),
+            ema_decay=getattr(trainer, "ema_decay", None),
         )
         ps.initialize()
         ps.start()
@@ -324,7 +333,9 @@ def run_async_training(trainer, ds, shuffle: bool):
             ParameterServerClient("127.0.0.1", ps.port, i) for i in range(W)
         ]
     elif transport == "inprocess":
-        ps = ParameterServer(params, rule, W)
+        ps = ParameterServer(
+            params, rule, W, ema_decay=getattr(trainer, "ema_decay", None)
+        )
         clients = [_BoundPS(ps, i) for i in range(W)]
     else:
         raise ValueError(f"unknown ps_transport {transport!r}")
@@ -467,6 +478,8 @@ def run_async_training(trainer, ds, shuffle: bool):
         snap_client.close()
     if ps is not None:
         ps.stop()
+        if getattr(trainer, "ema_decay", None) is not None:
+            trainer.ema_params_ = ps.get_ema()
 
     final_nt = next(
         (w.final_nt for w in workers if hasattr(w, "final_nt")), nt

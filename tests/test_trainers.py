@@ -322,3 +322,103 @@ def test_validation_loss_matches_manual_eval():
     manual_acc = float(np.mean(
         np.argmax(np.asarray(out), -1) == np.asarray(val["label"])))
     np.testing.assert_allclose(rec["val_accuracy"], manual_acc, rtol=1e-6)
+
+
+# -- Polyak/EMA averaging ----------------------------------------------------
+
+
+def test_ps_ema_fold_matches_hand_computed():
+    """PS-side EMA is exactly ema = d*ema + (1-d)*center after each fold."""
+    from distkeras_tpu.parallel.merge_rules import DownpourMerge
+    from distkeras_tpu.parameter_servers import ParameterServer
+
+    d = 0.5
+    ps = ParameterServer({"w": np.zeros(3, np.float32)}, DownpourMerge(),
+                         num_workers=1, ema_decay=d)
+    ema = np.zeros(3, np.float32)
+    center = np.zeros(3, np.float32)
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        delta = rng.normal(size=3).astype(np.float32)
+        ps.commit(0, {"w": delta})
+        center = center + delta            # DOWNPOUR fold
+        ema = d * ema + (1 - d) * center
+        np.testing.assert_allclose(ps.get_ema()["w"], ema, rtol=1e-6)
+    np.testing.assert_allclose(ps.get_model()["w"], center, rtol=1e-6)
+
+
+def test_collective_ema_decay_zero_equals_center():
+    """decay=0 makes the EMA a copy of the latest center — pins the update
+    order (EMA folds in the post-merge center each window)."""
+    import jax
+
+    from distkeras_tpu import ADAG
+
+    ds = blobs_dataset(n=512)
+    t = ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+             worker_optimizer="sgd", learning_rate=0.1, num_workers=4,
+             batch_size=16, communication_window=2, num_epoch=2,
+             device_data=False, ema_decay=0.0)
+    params = t.train(ds, shuffle=True)
+    assert t.ema_params_ is not None
+    for la, lb in zip(jax.tree.leaves(t.ema_params_),
+                      jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_collective_ema_tracks_behind_the_center():
+    from distkeras_tpu import ADAG
+
+    ds = blobs_dataset(n=512)
+    t = ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+             worker_optimizer="sgd", learning_rate=0.1, num_workers=4,
+             batch_size=16, communication_window=2, num_epoch=2,
+             device_data=False, ema_decay=0.9)
+    params = t.train(ds, shuffle=True)
+    import jax
+
+    diffs = [float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+             for a, b in zip(jax.tree.leaves(t.ema_params_),
+                             jax.tree.leaves(params))]
+    assert max(diffs) > 0                       # it lags the raw center
+    assert all(np.isfinite(d) for d in diffs)
+
+
+def test_ema_forces_streaming_with_warning():
+    from distkeras_tpu import ADAG
+
+    ds = blobs_dataset(n=512)
+    t = ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+             worker_optimizer="sgd", learning_rate=0.1, num_workers=4,
+             batch_size=16, communication_window=2, num_epoch=1,
+             device_data=True, ema_decay=0.5)
+    with pytest.warns(UserWarning, match="streaming"):
+        t.train(ds)
+    assert t.ema_params_ is not None
+
+
+def test_ps_backend_ema_end_to_end():
+    from distkeras_tpu import DOWNPOUR
+
+    ds = blobs_dataset(n=1024)
+    t = DOWNPOUR(model_spec(), loss="sparse_softmax_cross_entropy",
+                 worker_optimizer="sgd", learning_rate=0.02, num_workers=2,
+                 batch_size=32, communication_window=2, num_epoch=2,
+                 backend="ps", ema_decay=0.9)
+    t.train(ds, shuffle=True)
+    assert t.ema_params_ is not None
+    leaves = [np.asarray(l) for l in __import__("jax").tree.leaves(t.ema_params_)]
+    assert all(np.isfinite(l).all() for l in leaves)
+
+
+def test_ema_validation_errors():
+    from distkeras_tpu import ADAG, DOWNPOUR
+
+    with pytest.raises(ValueError, match="ema_decay must be"):
+        ADAG(model_spec(), num_workers=2, ema_decay=1.0)
+    with pytest.raises(ValueError, match="native"):
+        DOWNPOUR(model_spec(), num_workers=2, backend="ps",
+                 ps_transport="native", ema_decay=0.9)
+    with pytest.raises(ValueError, match="external|PS owner"):
+        DOWNPOUR(model_spec(), num_workers=2, backend="ps",
+                 ps_transport="socket", ps_host="127.0.0.1", ema_decay=0.9)
