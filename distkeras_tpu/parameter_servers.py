@@ -63,6 +63,12 @@ class ParameterServer:
         self._ema = (
             jax_tree_copy(self.center) if ema_decay is not None else None
         )
+        # per-leaf scratch reused across commits: the fold runs under the
+        # serializing lock, so it must not allocate model-sized temporaries
+        self._ema_scratch = (
+            None if self._ema is None
+            else _tree_map(np.empty_like, self._ema)
+        )
 
     # -- service lifecycle (no-ops for the in-process PS) --------------------
 
@@ -100,17 +106,17 @@ class ParameterServer:
             )
             self.num_updates += 1
             if self._ema is not None:
-                # in place: the lock serializes every worker — no fresh
-                # model-sized allocations while holding it
+                # in place via the preallocated scratch: the lock
+                # serializes every worker, so the fold allocates nothing
                 d = self.ema_decay
-                import jax
 
-                def fma(e, c):
+                def fma(e, c, s):
+                    np.multiply(np.asarray(c, dtype=e.dtype), 1.0 - d,
+                                out=s)
                     e *= d
-                    e += (1.0 - d) * np.asarray(c, dtype=e.dtype)
-                    return e
+                    e += s
 
-                jax.tree.map(fma, self._ema, self.center)
+                _tree_map(fma, self._ema, self.center, self._ema_scratch)
 
     def get_model(self) -> Pytree:
         with self._lock:
@@ -122,10 +128,14 @@ class ParameterServer:
             return None if self._ema is None else jax_tree_copy(self._ema)
 
 
-def jax_tree_copy(tree: Pytree) -> Pytree:
+def _tree_map(fn, *trees):
     import jax
 
-    return jax.tree.map(np.copy, tree)
+    return jax.tree.map(fn, *trees)
+
+
+def jax_tree_copy(tree: Pytree) -> Pytree:
+    return _tree_map(np.copy, tree)
 
 
 class SocketParameterServer(ParameterServer):

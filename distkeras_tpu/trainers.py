@@ -148,6 +148,44 @@ def _fits_device_budget(ds: Dataset, cols, budget_bytes: int) -> bool:
     return len(ds) * row_bytes <= budget_bytes
 
 
+def _validate_ema_decay(ema_decay):
+    """Shared range check for the trainers' ``ema_decay`` kwarg."""
+    if ema_decay is None:
+        return None
+    ema_decay = float(ema_decay)
+    if not 0.0 <= ema_decay < 1.0:
+        raise ValueError(f"ema_decay must be in [0, 1), got {ema_decay}")
+    return ema_decay
+
+
+def _ema_tracking(center_like, decay, use_resident):
+    """Build the per-step EMA carry for a streaming training loop.
+
+    Returns ``(use_resident, ema, ema_step)``: the resident input mode is
+    overridden (with a warning) because EMA folds in every intermediate
+    center, which a whole-epoch-in-one-dispatch path never materializes.
+    ``ema`` is a jitted COPY of ``center_like`` (the engines donate their
+    state buffers, so the EMA needs its own), in the same layout/sharding.
+    """
+    if use_resident:
+        import warnings
+
+        warnings.warn(
+            "ema_decay tracks the center per step/window, which needs the "
+            "streaming input path; overriding the resident input mode for "
+            "this run",
+            stacklevel=3,
+        )
+        use_resident = False
+    d = decay
+    ema_step = jax.jit(
+        lambda e, c: jax.tree.map(lambda a, b: d * a + (1.0 - d) * b, e, c),
+        donate_argnums=(0,),
+    )
+    ema = jax.jit(lambda c: jax.tree.map(jnp.copy, c))(center_like)
+    return use_resident, ema, ema_step
+
+
 def _profile_trace_ctx(profile_dir):
     """``jax.profiler.trace`` context for a training run (or a no-op).
 
@@ -542,12 +580,8 @@ class DistributedTrainer(Trainer):
         # The averaged model lands in `ema_params_` next to the returned
         # (raw) center; EMA state is not checkpointed (resume restarts it
         # from the restored center).
+        ema_decay = _validate_ema_decay(ema_decay)
         if ema_decay is not None:
-            ema_decay = float(ema_decay)
-            if not 0.0 <= ema_decay < 1.0:
-                raise ValueError(
-                    f"ema_decay must be in [0, 1), got {ema_decay}"
-                )
             if backend == "ps" and ps_transport == "native":
                 raise ValueError(
                     "ema_decay is not supported on ps_transport='native' "
@@ -680,26 +714,9 @@ class DistributedTrainer(Trainer):
 
         ema, ema_step = None, None
         if self.ema_decay is not None:
-            if use_resident:
-                import warnings
-
-                warnings.warn(
-                    "ema_decay tracks the center per communication window, "
-                    "which needs the streaming input path; overriding the "
-                    "resident input mode for this run",
-                    stacklevel=2,
-                )
-                use_resident = False
-            d = self.ema_decay
-            ema_step = jax.jit(
-                lambda e, c: jax.tree.map(
-                    lambda a, b: d * a + (1.0 - d) * b, e, c
-                ),
-                donate_argnums=(0,),
+            use_resident, ema, ema_step = _ema_tracking(
+                state.center, self.ema_decay, use_resident
             )
-            # a COPY of the (possibly restored) center: run_window donates
-            # state buffers, so holding the center itself would dangle
-            ema = jax.jit(lambda c: jax.tree.map(jnp.copy, c))(state.center)
 
         self.record_training_start()
         if use_resident:
@@ -978,6 +995,7 @@ class MeshTrainer(Trainer):
                  resume: bool = False, checkpoint_async: bool = False,
                  profile_dir=None,
                  input_mode: str = "auto", prefetch: int = 1,
+                 ema_decay: float | None = None,
                  clipnorm=None, clipvalue=None, validation_data=None):
         from distkeras_tpu.parallel.strategies import STRATEGIES
         from distkeras_tpu.parallel.tensor import get_mesh_nd
@@ -1032,6 +1050,10 @@ class MeshTrainer(Trainer):
         self.input_mode = input_mode
         # streaming prefetch depth (see DistributedTrainer.prefetch)
         self.prefetch = int(prefetch)
+        # Polyak/EMA of the global params per step (see
+        # DistributedTrainer.ema_decay); needs the streaming input path
+        self.ema_decay = _validate_ema_decay(ema_decay)
+        self.ema_params_ = None
 
     def _build_engine(self):
         """Construct the strategy's engine + params re-layout callables."""
@@ -1161,6 +1183,13 @@ class MeshTrainer(Trainer):
             ),
         }[self.input_mode]
 
+        ema, ema_step = None, None
+        if self.ema_decay is not None:
+            # EMA carries live in the ENGINE layout (sharded stays sharded)
+            use_resident, ema, ema_step = _ema_tracking(
+                params, self.ema_decay, use_resident
+            )
+
         ctx = _profile_trace_ctx(self.profile_dir)
         self.record_training_start()
         with ctx:
@@ -1200,6 +1229,8 @@ class MeshTrainer(Trainer):
                         params, nt, opt, loss = engine.run_step(
                             params, nt, opt, b
                         )
+                        if ema_step is not None:
+                            ema = ema_step(ema, params)
                         self.history.append(loss=loss, epoch=epoch)
                         n_steps += 1
                     if self.log_metrics and n_steps:
@@ -1220,6 +1251,12 @@ class MeshTrainer(Trainer):
             from jax.experimental import multihost_utils
 
             params = multihost_utils.process_allgather(params, tiled=True)
+            if ema is not None:
+                ema = multihost_utils.process_allgather(ema, tiled=True)
+        if ema is not None:
+            self.ema_params_ = from_engine(
+                jax.tree.map(np.asarray, jax.device_get(ema))
+            )
         return self._finalize(
             from_engine(jax.tree.map(np.asarray, jax.device_get(params))),
             jax.tree.map(np.asarray, jax.device_get(nt)),

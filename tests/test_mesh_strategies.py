@@ -340,3 +340,29 @@ def test_mesh_trainer_validation_data_pipeline(rng):
     assert np.isfinite(vls).all()
     assert vls[-1] < vls[0]
     assert 0.0 <= recs[-1]["val_accuracy"] <= 1.0
+
+
+def test_mesh_trainer_ema_decay_zero_equals_params():
+    """MeshTrainer ema parity with DistributedTrainer: decay=0 pins the
+    EMA to the latest global params, through the engine re-layout."""
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models import mlp
+    from distkeras_tpu.trainers import MeshTrainer
+    from tests.test_trainers import blobs_dataset
+
+    t = MeshTrainer(
+        mlp(input_shape=(16,), hidden=(32,), num_classes=4,
+            dtype=jnp.float32),
+        loss="sparse_softmax_cross_entropy", worker_optimizer="adam",
+        learning_rate=1e-3, mesh_shape={"dp": 8},
+        parameter_sharding="fsdp", batch_size=32, num_epoch=2, seed=5,
+        input_mode="stream", ema_decay=0.0,
+    )
+    params = t.train(blobs_dataset(n=512))
+    assert t.ema_params_ is not None
+    for la, lb in zip(jax.tree.leaves(t.ema_params_),
+                      jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-6, atol=1e-7)
