@@ -55,7 +55,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dkps_server_create.restype = ctypes.c_void_p
     lib.dkps_server_create.argtypes = [
         f32p, ctypes.c_uint64, ctypes.c_int, ctypes.c_double,
-        ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_double,
     ]
     lib.dkps_server_port.restype = ctypes.c_int
     lib.dkps_server_port.argtypes = [ctypes.c_void_p]
@@ -73,6 +73,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dkps_server_get_center.argtypes = [ctypes.c_void_p, f32p]
     lib.dkps_server_set_center.restype = None
     lib.dkps_server_set_center.argtypes = [ctypes.c_void_p, f32p]
+    lib.dkps_server_get_ema.restype = ctypes.c_int
+    lib.dkps_server_get_ema.argtypes = [ctypes.c_void_p, f32p]
     lib.dkps_server_record_pull.restype = None
     lib.dkps_server_record_pull.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     lib.dkps_client_connect.restype = ctypes.c_void_p

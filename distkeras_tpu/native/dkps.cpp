@@ -98,6 +98,10 @@ void set_nodelay(int fd) {
 
 struct Server {
   std::vector<float> center;
+  // Polyak/EMA of the center, updated per commit when ema_decay >= 0
+  // (negative = off) — same semantics as the Python PS's get_ema()
+  std::vector<float> ema;
+  double ema_decay = -1.0;
   uint64_t n = 0;
   int mode = MODE_FIXED;
   double fixed_scale = 1.0;
@@ -112,6 +116,16 @@ struct Server {
   std::mutex conn_mu;
   std::vector<int> conn_fds;
   std::vector<std::thread> handlers;
+
+  // EMA fold after a commit landed in the center — call under mu
+  void ema_fold_locked() {
+    if (ema_decay < 0) return;
+    const float d = static_cast<float>(ema_decay);
+    const float od = 1.0f - d;
+    float* e = ema.data();
+    const float* c = center.data();
+    for (uint64_t i = 0; i < n; ++i) e[i] = d * e[i] + od * c[i];
+  }
 
   // fold scale for one commit from conn_wid_'s staleness — call under mu
   float fold_scale_locked() {
@@ -159,6 +173,7 @@ struct Server {
           float* c = center.data();
           const float* d = buf.data();
           for (uint64_t i = 0; i < n; ++i) c[i] += d[i] * s;
+          ema_fold_locked();
           num_updates += 1;
         }
         if (!send_all(fd, &ack, 1)) break;
@@ -200,6 +215,7 @@ struct Server {
               c[off + i] += ss * static_cast<float>(d[i]);
             off += lens[seg];
           }
+          ema_fold_locked();
           num_updates += 1;
         }
         if (!send_all(fd, &ack, 1)) break;
@@ -264,12 +280,15 @@ extern "C" {
 // ---------------------------------------------------------------- server --
 
 void* dkps_server_create(const float* init, uint64_t n, int mode,
-                         double fixed_scale, const char* host, int port) {
+                         double fixed_scale, const char* host, int port,
+                         double ema_decay) {
   auto* s = new Server();
   s->center.assign(init, init + n);
   s->n = n;
   s->mode = mode;
   s->fixed_scale = fixed_scale;
+  s->ema_decay = ema_decay;
+  if (ema_decay >= 0) s->ema = s->center;
 
   s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) {
@@ -377,6 +396,18 @@ void dkps_server_set_center(void* h, const float* in) {
   auto* s = static_cast<Server*>(h);
   std::lock_guard<std::mutex> g(s->mu);
   std::memcpy(s->center.data(), in, s->n * sizeof(float));
+  // a restored center restarts the average from itself (EMA state is not
+  // checkpointed — same policy as the Python trainers)
+  if (s->ema_decay >= 0) s->ema = s->center;
+}
+
+// EMA read: 0 on success, -1 when the server was created without EMA
+int dkps_server_get_ema(void* h, float* out) {
+  auto* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (s->ema_decay < 0) return -1;
+  std::memcpy(out, s->ema.data(), s->n * sizeof(float));
+  return 0;
 }
 
 // record a pull version server-side (used by the in-process owner when it

@@ -122,7 +122,8 @@ class NativeSocketParameterServer:
     """
 
     def __init__(self, center: Pytree, rule: MergeRule, num_workers: int,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 ema_decay: float | None = None):
         self._lib = load_dkps(required=True)
         self.spec = FlatSpec(center)
         self.rule = rule
@@ -132,12 +133,22 @@ class NativeSocketParameterServer:
         self._requested_port = int(port)
         self._handle = None
         self._init_vec = self.spec.flatten(center)
+        # Polyak/EMA of the center, folded per commit in C++ (parity with
+        # ParameterServer.get_ema); negative sentinel = off on the C ABI
+        if ema_decay is not None:
+            ema_decay = float(ema_decay)
+            if not 0.0 <= ema_decay < 1.0:
+                raise ValueError(
+                    f"ema_decay must be in [0, 1), got {ema_decay}"
+                )
+        self.ema_decay = ema_decay
 
     def initialize(self) -> None:
         mode, scale = fold_mode(self.rule, self.num_workers)
         h = self._lib.dkps_server_create(
             _f32p(self._init_vec), self.spec.n, mode, scale,
             self.host.encode(), self._requested_port,
+            -1.0 if self.ema_decay is None else self.ema_decay,
         )
         if not h:
             raise OSError(
@@ -181,6 +192,15 @@ class NativeSocketParameterServer:
     def set_model(self, tree: Pytree) -> None:
         vec = np.ascontiguousarray(self.spec.flatten(tree))
         self._lib.dkps_server_set_center(self._handle, _f32p(vec))
+
+    def get_ema(self) -> Pytree | None:
+        """The Polyak-averaged center (None unless ``ema_decay`` was set)."""
+        if self.ema_decay is None:
+            return None
+        out = np.empty(self.spec.n, dtype=np.float32)
+        if self._lib.dkps_server_get_ema(self._handle, _f32p(out)) != 0:
+            return None
+        return self.spec.unflatten(out)
 
 
 class NativePSClient:

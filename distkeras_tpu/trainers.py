@@ -582,12 +582,6 @@ class DistributedTrainer(Trainer):
         # from the restored center).
         ema_decay = _validate_ema_decay(ema_decay)
         if ema_decay is not None:
-            if backend == "ps" and ps_transport == "native":
-                raise ValueError(
-                    "ema_decay is not supported on ps_transport='native' "
-                    "(the C++ fold keeps no averaged center); use "
-                    "'socket' or 'inprocess'"
-                )
             if backend == "ps" and ps_host is not None:
                 raise ValueError(
                     "ema_decay with an external ps_host must be configured "

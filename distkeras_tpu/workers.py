@@ -272,13 +272,12 @@ def run_async_training(trainer, ds, shuffle: bool):
         # every float leaf must ride the segmented wire: the flat frame has
         # no raw-passthrough representation for tiny leaves
         codec = Int8Codec(min_size=1)
-    if getattr(trainer, "ema_decay", None) is not None and (
-        transport == "native" or external_host is not None
-    ):
+    if getattr(trainer, "ema_decay", None) is not None \
+            and external_host is not None:
         # mirrors the trainer-constructor validation for direct callers
         raise ValueError(
-            "ema_decay needs a local Python PS (the C++ fold keeps no "
-            "averaged center; an external PS owner configures EMA there)"
+            "ema_decay with an external ps_host must be configured on the "
+            "PS owner's server (the center lives there)"
         )
     if external_host is not None:
         # External PS (another process/host — the reference's driver-hosted
@@ -315,7 +314,8 @@ def run_async_training(trainer, ds, shuffle: bool):
         )
 
         ps = NativeSocketParameterServer(
-            params, rule, W, port=getattr(trainer, "ps_port", 0)
+            params, rule, W, port=getattr(trainer, "ps_port", 0),
+            ema_decay=getattr(trainer, "ema_decay", None),
         )
         ps.initialize()
         ps.start()

@@ -30,10 +30,11 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def make_server(center, rule, num_workers):
+def make_server(center, rule, num_workers, ema_decay=None):
     from distkeras_tpu.native_ps import NativeSocketParameterServer
 
-    ps = NativeSocketParameterServer(center, rule, num_workers)
+    ps = NativeSocketParameterServer(center, rule, num_workers,
+                                     ema_decay=ema_decay)
     ps.initialize()
     ps.start()
     return ps
@@ -344,3 +345,44 @@ def test_native_transport_trains_with_int8_compression():
                  backend="ps", ps_transport="native", compression="int8")
     t.train(ds, shuffle=True)
     assert final_loss(t) < 0.6, final_loss(t)
+
+
+def test_native_ema_matches_python_ps(rng):
+    """The C++ per-commit EMA fold equals the Python PS's, commit for
+    commit (same decay, same fold sequence)."""
+    center = {"w": np.zeros(48, np.float32), "b": np.zeros(5, np.float32)}
+    d = 0.7
+    py = ParameterServer(center, DownpourMerge(), 1, ema_decay=d)
+    ps = make_server(center, DownpourMerge(), 1, ema_decay=d)
+    try:
+        c = make_client(ps, 0)
+        for i in range(4):
+            delta = {"w": rng.normal(size=48).astype(np.float32),
+                     "b": rng.normal(size=5).astype(np.float32)}
+            py.pull(0); py.commit(0, delta)
+            c.pull(); c.commit(0, delta)
+        import jax
+
+        for a, b in zip(jax.tree.leaves(ps.get_ema()),
+                        jax.tree.leaves(py.get_ema())):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+        c.close()
+    finally:
+        ps.stop()
+
+
+def test_native_transport_trainer_ema_end_to_end():
+    from distkeras_tpu import DOWNPOUR
+
+    ds = blobs_dataset(n=1024)
+    t = DOWNPOUR(model_spec(), loss="sparse_softmax_cross_entropy",
+                 worker_optimizer="sgd", learning_rate=0.02, num_workers=2,
+                 batch_size=32, communication_window=2, num_epoch=2,
+                 backend="ps", ps_transport="native", ema_decay=0.9)
+    t.train(ds, shuffle=True)
+    assert t.ema_params_ is not None
+    import jax
+
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree.leaves(t.ema_params_))
