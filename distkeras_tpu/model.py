@@ -43,6 +43,10 @@ class ModelSpec:
     #: strategy engines (pipeline/sequence/expert) rebuild mesh-specialized
     #: forwards; ``None`` for Keras or hand-written specs
     module: Any = None
+    #: the example input tuple the spec was built with (shape/dtype only —
+    #: lets serving transforms like ``ops.quant.quantize_serving`` trace
+    #: the module once without user-supplied inputs); ``None`` when unknown
+    example: Any = None
 
     def init_np(self, seed: int = 0) -> tuple[Pytree, Pytree]:
         """Host-side init convenience returning NumPy pytrees."""
@@ -84,7 +88,7 @@ def from_flax(module, example_input, *, name: str | None = None,
         return out, state
 
     return ModelSpec(init=init, apply=apply, name=name or type(module).__name__,
-                     module=module)
+                     module=module, example=example)
 
 
 def from_keras(model, *, name: str | None = None) -> ModelSpec:

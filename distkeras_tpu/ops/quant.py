@@ -168,25 +168,136 @@ def q_matmul(x, qt: QTensor, *, impl: str = "auto", out_dtype=None,
     return out.reshape(*lead, n)
 
 
-def quantize_dense_tree(params):
-    """Walk a flax param tree and quantize every Dense-shaped leaf pair.
+def _q_interceptor(next_fun, args, kwargs, context):
+    """flax method interceptor: any ``nn.Dense`` whose params arrived
+    quantized (``kernel_q``/``scale``[/``bias``] — what
+    :func:`quantize_dense_tree` produces) is served by :func:`q_matmul`
+    instead of its own kernel read; everything else runs unchanged."""
+    import flax.linen as nn
+
+    m = context.module
+    if (type(m) is nn.Dense and context.method_name == "__call__"
+            and m.has_variable("params", "kernel_q")):
+        q = m.get_variable("params", "kernel_q")
+        s = m.get_variable("params", "scale")
+        x = args[0]
+        # mirror nn.Dense's promote-to-module-dtype semantics so the
+        # quantized forward keeps the fp model's compute dtypes
+        cdt = m.dtype if m.dtype is not None else x.dtype
+        x = x.astype(cdt)
+        out = q_matmul(x, QTensor(q, s), out_dtype=cdt)
+        if m.use_bias:
+            out = out + jnp.asarray(
+                m.get_variable("params", "bias")
+            ).astype(cdt)
+        return out
+    return next_fun(*args, **kwargs)
+
+
+def quantize_serving(spec, params, state=None):
+    """Generic int8 weight-only serving for a flax-backed ``ModelSpec``.
+
+    ``(spec, trained params) → (int8 spec, int8 params)``: the model is
+    traced once (``jax.eval_shape`` on the spec's recorded example input)
+    to find exactly the ``nn.Dense`` modules in the forward; their kernels
+    become int8 matrices + per-output-channel scales
+    (:func:`quantize_dense_tree`), and the returned spec's ``apply``
+    serves them through a flax method interceptor — no model-code
+    changes, so the whole zoo (MLP, the transformer classifiers, custom
+    modules BUILT FROM ``nn.Dense``) quantizes the same way. Kernel/bias
+    pairs owned by anything other than ``nn.Dense`` (e.g.
+    ``nn.DenseGeneral``, convolutions) stay in float — the trace is what
+    guarantees nothing is converted that the interceptor cannot serve.
+    Inference-only: the returned apply rejects ``training=True``.
+    ``models.quantize_lm`` remains the LM-family door (its ``QDense``
+    modules also cover the cached-decode entry points, which never pass
+    through ``nn.Dense.__call__``).
+    """
+    import dataclasses
+
+    import flax.linen as nn
+
+    if getattr(spec, "module", None) is None:
+        raise ValueError(
+            "quantize_serving needs a flax-backed ModelSpec (built by "
+            "from_flax, e.g. the models/ zoo); Keras and hand-written "
+            "specs have no flax module to intercept"
+        )
+    if getattr(spec, "example", None) is None:
+        raise ValueError(
+            "quantize_serving needs the spec's example input to trace the "
+            "module (ModelSpec.example — from_flax records it)"
+        )
+    base_apply = spec.apply
+    state = {} if state is None else state
+
+    # trace once to record which param paths belong to real nn.Dense
+    # modules reached by the serving forward
+    dense_paths: set[tuple] = set()
+
+    def record(next_fun, args, kwargs, context):
+        m = context.module
+        if type(m) is nn.Dense and context.method_name == "__call__":
+            dense_paths.add(tuple(m.path))
+        return next_fun(*args, **kwargs)
+
+    x0 = spec.example
+    x0 = x0[0] if isinstance(x0, tuple) and len(x0) == 1 else x0
+    with nn.intercept_methods(record):
+        jax.eval_shape(
+            lambda p, s, x: base_apply(p, s, x, False), params, state, x0
+        )
+
+    def apply(params, state, x, training):
+        if training:
+            raise ValueError(
+                "int8 weight-only quantization is a serving path; train "
+                "the float model and re-quantize"
+            )
+        with nn.intercept_methods(_q_interceptor):
+            return base_apply(params, state, x, training)
+
+    qspec = dataclasses.replace(spec, apply=apply, name=spec.name + "_int8")
+    return qspec, quantize_dense_tree(params, paths=dense_paths)
+
+
+def quantize_dense_tree(params, paths: set | None = None):
+    """Walk a flax param tree and quantize Dense-shaped leaf groups.
 
     A subtree ``{"kernel": [K, N] float, "bias": ...}`` (exactly the param
     set ``nn.Dense`` creates) becomes ``{"kernel_q": int8, "scale": f32,
-    "bias": ...}`` — the param set ``models.lm.QDense`` reads. Everything
-    else (embeddings, LayerNorm scales/biases, conv kernels) passes through
-    unchanged.
+    "bias": ...}`` — the param set ``models.lm.QDense`` and the serving
+    interceptor read. Everything else (embeddings, LayerNorm
+    scales/biases, conv kernels) passes through unchanged.
+
+    ``paths`` (from :func:`quantize_serving`'s recording trace) restricts
+    conversion to subtrees KNOWN to belong to ``nn.Dense`` modules — and
+    within it, bias-less Dense params (``{"kernel"}`` alone,
+    ``use_bias=False``) convert too. Without ``paths`` (the
+    ``quantize_lm`` door) only exact ``{kernel, bias}`` pairs convert,
+    since a bare 2-D ``kernel`` could belong to anything.
     """
     from collections.abc import Mapping
 
-    def rec(node):
+    def convert(node):
+        qt = quantize(node["kernel"], axis=0)
+        out = {"kernel_q": qt.q, "scale": qt.scale}
+        if "bias" in node:
+            out["bias"] = node["bias"]
+        return out
+
+    def rec(node, path):
         if isinstance(node, Mapping):
-            if (set(node) == {"kernel", "bias"}
-                    and getattr(node["kernel"], "ndim", 0) == 2):
-                qt = quantize(node["kernel"], axis=0)
-                return {"kernel_q": qt.q, "scale": qt.scale,
-                        "bias": node["bias"]}
-            return {k: rec(v) for k, v in node.items()}
+            is_dense_shape = (
+                set(node) in ({"kernel", "bias"}, {"kernel"})
+                and getattr(node.get("kernel"), "ndim", 0) == 2
+            )
+            if paths is not None:
+                if path in paths and is_dense_shape:
+                    return convert(node)
+            elif set(node) == {"kernel", "bias"} and is_dense_shape:
+                return convert(node)
+            return {k: rec(v, path + (k,)) for k, v in node.items()}
         return node
 
-    return rec(params)
+    return rec(params, ())

@@ -28,7 +28,8 @@ class ModelPredictor:
 
     def __init__(self, model, params=None, state=None,
                  features_col="features", output_col: str = "prediction",
-                 batch_size: int = 512, mesh=None, dp_axis: str = "dp"):
+                 batch_size: int = 512, mesh=None, dp_axis: str = "dp",
+                 quantize: bool = False):
         if isinstance(model, ModelSpec):
             if params is None:
                 raise ValueError("ModelSpec predictor needs explicit params")
@@ -38,6 +39,14 @@ class ModelPredictor:
         else:
             self.spec = from_keras(model)
             self.params, self.state = self.spec.init_np()
+        if quantize:
+            # int8 weight-only serving (ops/quant.py): every Dense kernel
+            # streams int8 from HBM; flax-backed specs only
+            from distkeras_tpu.ops.quant import quantize_serving
+
+            self.spec, self.params = quantize_serving(
+                self.spec, self.params, state=self.state
+            )
         self.features_col = (
             [features_col] if isinstance(features_col, str) else list(features_col)
         )
