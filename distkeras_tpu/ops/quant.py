@@ -180,7 +180,7 @@ def _q_interceptor(next_fun, args, kwargs, context):
             and m.has_variable("params", "kernel_q")):
         q = m.get_variable("params", "kernel_q")
         s = m.get_variable("params", "scale")
-        x = args[0]
+        x = args[0] if args else kwargs["inputs"]  # Dense(…)(inputs=x)
         # mirror nn.Dense's promote-to-module-dtype semantics so the
         # quantized forward keeps the fp model's compute dtypes
         cdt = m.dtype if m.dtype is not None else x.dtype

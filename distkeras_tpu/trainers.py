@@ -843,6 +843,7 @@ class SingleTrainer(DistributedTrainer):
                  learning_rate: float = 0.01, batch_size: int = 32,
                  features_col="features", label_col: str = "label",
                  num_epoch: int = 1, seed: int = 0, mesh=None,
+                 prefetch: int = 1, ema_decay: float | None = None,
                  clipnorm=None, clipvalue=None, validation_data=None):
         super().__init__(
             keras_model, loss, worker_optimizer, learning_rate=learning_rate,
@@ -850,6 +851,7 @@ class SingleTrainer(DistributedTrainer):
             label_col=label_col, num_epoch=num_epoch, communication_window=1,
             backend="collective",
             mesh=mesh if mesh is not None else get_mesh(1), seed=seed,
+            prefetch=prefetch, ema_decay=ema_decay,
             clipnorm=clipnorm, clipvalue=clipvalue,
             validation_data=validation_data,
         )

@@ -81,7 +81,22 @@ def main():
     ap.add_argument("--window", type=int, default=None)
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--rows", type=int, default=16384)
+    ap.add_argument("--backend", choices=["collective", "ps"],
+                    default="collective")
+    ap.add_argument("--compression", choices=["int8", "topk"], default=None,
+                    help="lossy commit compression for the PS wire "
+                         "(backend=ps; error feedback keeps convergence)")
+    ap.add_argument("--ema", type=float, default=None, metavar="DECAY",
+                    help="Polyak/EMA averaging of the center; the averaged "
+                         "model is also scored at the end")
+    ap.add_argument("--int8-predict", action="store_true",
+                    help="serve the trained model with int8 weights "
+                         "(ModelPredictor(quantize=True))")
     args = ap.parse_args()
+
+    if args.int8_predict and args.frontend == "keras":
+        ap.error("--int8-predict needs the native flax zoo "
+                 "(--frontend native); Keras specs have no flax module")
 
     print(f"devices: {jax.devices()}")
     print(f"mnist: {'synthetic stand-in' if is_synthetic('mnist') else 'real'}")
@@ -109,6 +124,11 @@ def main():
         kw["num_workers"] = args.workers
         if args.window:
             kw["communication_window"] = args.window
+        kw["backend"] = args.backend
+        if args.compression:
+            kw["compression"] = args.compression
+    if args.ema is not None:
+        kw["ema_decay"] = args.ema
     trainer = cls(model, **kw)
 
     trainer.train(train, shuffle=True)
@@ -119,11 +139,19 @@ def main():
     )
 
     predictor = ModelPredictor(
-        trainer.spec, trainer.trained_params_, trainer.trained_nt_
+        trainer.spec, trainer.trained_params_, trainer.trained_nt_,
+        quantize=args.int8_predict,
     )
     test_pred = predictor.predict(test)
     acc = AccuracyEvaluator().evaluate(test_pred)
-    print(f"test accuracy: {acc:.4f}")
+    tag = " (int8 serving)" if args.int8_predict else ""
+    print(f"test accuracy{tag}: {acc:.4f}")
+    if args.ema is not None and trainer.ema_params_ is not None:
+        ema_pred = ModelPredictor(
+            trainer.spec, trainer.ema_params_, trainer.trained_nt_
+        ).predict(test)
+        print(f"EMA(decay={args.ema}) test accuracy: "
+              f"{AccuracyEvaluator().evaluate(ema_pred):.4f}")
     return acc
 
 

@@ -290,3 +290,40 @@ def test_quantize_serving_rejects_specless_models():
                      apply=lambda p, s, x, t: (x, s))
     with pytest.raises(ValueError, match="flax-backed"):
         quantize_serving(spec, {})
+
+
+def test_quantize_serving_handles_keyword_invocation(rng):
+    """Dense called as Dense(...)(inputs=x) quantizes AND serves."""
+    import flax.linen as nn
+
+    from distkeras_tpu.model import from_flax
+    from distkeras_tpu.ops.quant import quantize_serving
+
+    class KW(nn.Module):
+        @nn.compact
+        def __call__(self, x, training: bool = False):
+            return nn.Dense(4, name="d")(inputs=x)
+
+    spec = from_flax(KW(), jnp.zeros((1, 8), jnp.float32))
+    params, state = spec.init_np(0)
+    qspec, qparams = quantize_serving(spec, params)
+    assert set(qparams["d"]) == {"kernel_q", "scale", "bias"}
+    x = rng.normal(size=(3, 8)).astype(np.float32)
+    base, _ = spec.apply(params, state, x, False)
+    qout, _ = qspec.apply(qparams, state, x, False)
+    np.testing.assert_allclose(np.asarray(qout), np.asarray(base),
+                               rtol=0.05, atol=0.05)
+
+
+def test_single_trainer_accepts_ema_and_prefetch():
+    from distkeras_tpu import SingleTrainer
+    from tests.test_trainers import blobs_dataset, model_spec
+
+    t = SingleTrainer(model_spec(), loss="sparse_softmax_cross_entropy",
+                      worker_optimizer="sgd", learning_rate=0.1,
+                      batch_size=32, num_epoch=1, ema_decay=0.0, prefetch=2)
+    params = t.train(blobs_dataset(n=256))
+    assert t.ema_params_ is not None
+    for la, lb in zip(jax.tree.leaves(t.ema_params_),
+                      jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
