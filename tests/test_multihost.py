@@ -413,16 +413,30 @@ def _model():
                dtype=jnp.float32)
 
 def run_mesh(profile_dir):
+    import jax as _jax
+    import numpy as _np
+
     train, test = higgs(n_train=512, n_test=90)
     t = MeshTrainer(_model(), loss="sparse_softmax_cross_entropy",
                     worker_optimizer="adam", learning_rate=1e-3,
                     mesh_shape={"dp": 8}, parameter_sharding="fsdp",
                     batch_size=32, num_epoch=2, seed=11,
                     input_mode="stream", validation_data=test,
-                    profile_dir=profile_dir)
+                    profile_dir=profile_dir, ema_decay=0.5)
     t.train(train)
-    return [[r["epoch"], r["val_loss"], r.get("val_accuracy")]
+    recs = [[r["epoch"], r["val_loss"], r.get("val_accuracy")]
             for r in t.metrics_ if "val_loss" in r]
+    # per-leaf position-weighted EMA checksums: pins the cross-process EMA
+    # gather (ZeRO-sharded carries process_allgather'd + re-laid-out)
+    # against the oracle — position weights catch shard-order scrambles a
+    # plain sum would miss
+    assert t.ema_params_ is not None
+    recs.append([
+        float(_np.dot(_np.asarray(l, _np.float64).ravel(),
+                      _np.arange(1, l.size + 1, dtype=_np.float64)))
+        for l in _jax.tree.leaves(t.ema_params_)
+    ])
+    return recs
 
 def run_adag():
     train, test = higgs(n_train=1024, n_test=90)
@@ -483,15 +497,22 @@ def test_two_process_validation_and_profile(tmp_path):
     oracle = {"mesh": ns["run_mesh"](None), "adag": ns["run_adag"]()}
 
     got = json.loads((tmp_path / "val.json").read_text())
+    # mesh yields 2 val records + a trailing per-leaf EMA-sum row; adag
+    # yields the 2 val records only
+    assert len(got["mesh"]) == 3 and len(got["adag"]) == 2, got
     for key in ("mesh", "adag"):
-        assert len(got[key]) == 2, (key, got[key])  # one record per epoch
-        for (ep_c, vl_c, va_c), (ep_o, vl_o, va_o) in zip(got[key],
-                                                          oracle[key]):
+        for (ep_c, vl_c, va_c), (ep_o, vl_o, va_o) in zip(got[key][:2],
+                                                          oracle[key][:2]):
             assert ep_c == ep_o
             np.testing.assert_allclose(vl_c, vl_o, rtol=1e-4, atol=1e-5,
                                        err_msg=f"{key} val_loss diverged")
             np.testing.assert_allclose(va_c, va_o, rtol=1e-4, atol=1e-5,
                                        err_msg=f"{key} val_accuracy diverged")
+    assert len(got["mesh"][2]) == 6  # the mlp's 3 Dense layers x (W, b)
+    np.testing.assert_allclose(
+        got["mesh"][2], oracle["mesh"][2], rtol=1e-4, atol=1e-5,
+        err_msg="cross-process EMA diverged from the single-process oracle",
+    )
 
     # per-process profiler traces: one subdirectory per controller, each
     # with a non-empty trace session inside
