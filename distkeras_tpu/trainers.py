@@ -148,6 +148,23 @@ def _fits_device_budget(ds: Dataset, cols, budget_bytes: int) -> bool:
     return len(ds) * row_bytes <= budget_bytes
 
 
+def _bcast_host_port(host: str, port: int) -> tuple[str, int]:
+    """Broadcast process 0's PS address to every controller (fixed-size
+    uint8 buffer over the jax.distributed collective fabric)."""
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(256, np.uint8)
+    b = (host or "").encode()
+    if len(b) > buf.size:
+        raise ValueError(f"host address too long to broadcast: {host!r}")
+    buf[:len(b)] = np.frombuffer(b, np.uint8)
+    buf = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    port = int(np.asarray(
+        multihost_utils.broadcast_one_to_all(np.asarray([port], np.int32))
+    )[0])
+    return bytes(buf).rstrip(b"\x00").decode(), port
+
+
 def _validate_ema_decay(ema_decay):
     """Shared range check for the trainers' ``ema_decay`` kwarg."""
     if ema_decay is None:
@@ -638,16 +655,6 @@ class DistributedTrainer(Trainer):
                     "hogwild workers checkpoint at a cross-thread barrier); "
                     "use the collective backend or synchronous checkpoints"
                 )
-            if jax.process_count() > 1:
-                # fail fast — hogwild threads are placed over jax.devices(),
-                # which under jax.distributed includes devices this process
-                # cannot address (and every controller would run its own
-                # full hogwild loop)
-                raise NotImplementedError(
-                    "backend='ps' under multi-process jax.distributed is "
-                    "not supported; run one trainer per host against a "
-                    "shared ps_transport='socket' server instead"
-                )
             _reject_worker_axis_model(
                 self.spec, "backend='ps' (independent hogwild host threads)"
             )
@@ -655,6 +662,11 @@ class DistributedTrainer(Trainer):
         try:
             with ctx:
                 if self.backend == "ps":
+                    if jax.process_count() > 1:
+                        # the multi-slice story, automated: process 0 hosts
+                        # the PS, every controller runs its local hogwild
+                        # workers against it over TCP/DCN
+                        return self._train_ps_multiprocess(ds, shuffle)
                     return self._train_ps(ds, shuffle)
                 return self._train_collective(ds, shuffle)
         finally:
@@ -791,14 +803,14 @@ class DistributedTrainer(Trainer):
             engine.center_params(state), engine.worker_nt(state, 0)
         )
 
-    def _train_ps(self, ds: Dataset, shuffle: bool):
+    def _train_ps(self, ds: Dataset, shuffle: bool, runner=None):
         from distkeras_tpu.workers import run_async_training
 
         # fail-fast: a malformed validation_data must not cost a full run
         validator = self._make_validator()
         self.record_training_start()
         t0 = time.perf_counter()
-        params, nt, history = run_async_training(self, ds, shuffle)
+        params, nt, history = run_async_training(runner or self, ds, shuffle)
         elapsed = time.perf_counter() - t0
         self.record_training_end()
         for rec in history:
@@ -812,6 +824,136 @@ class DistributedTrainer(Trainer):
             # hogwild epochs overlap freely — score once, after the run
             self._validate_epoch(validator, params, nt, None)
         return self._finalize(params, nt)
+
+    def _train_ps_multiprocess(self, ds: Dataset, shuffle: bool):
+        """``backend='ps'`` across ``jax.distributed`` controllers — the
+        multi-slice/DCN story with zero user plumbing: process 0 hosts the
+        PS (socket, or the native C++ server), every controller runs
+        ``num_workers / process_count`` local hogwild workers against it
+        with offset worker ids over TCP, and a post-barrier pull hands
+        every controller the SAME trained center. Rows are partitioned
+        contiguously per process (the rebuilt Spark executor shard).
+
+        Rows split STRIDED (process ``i`` takes rows ``i::process_count``)
+        so label-sorted datasets never hand a controller a single-class
+        shard and no tail row is dropped — the same guarantees
+        ``worker_shards`` makes within a process. History/metrics stay
+        per-controller views of the free-running async run; when
+        ``validation_data`` is set, the LAST validation record scores the
+        returned post-barrier center, which is identical everywhere.
+
+        Not supported on this path: ``checkpoint_dir`` (every controller
+        would write one directory — checkpoint the PS owner's center
+        instead) and ``ema_decay`` (the averaged center would live only
+        with process 0's server).
+        """
+        import copy
+
+        from jax.experimental import multihost_utils
+
+        from distkeras_tpu import networking
+
+        pc, pi = jax.process_count(), jax.process_index()
+        if self.num_workers % pc:
+            raise ValueError(
+                f"num_workers {self.num_workers} must be divisible by "
+                f"process_count {pc} (each controller runs an equal share "
+                f"of hogwild workers)"
+            )
+        if self.checkpoint_dir:
+            raise NotImplementedError(
+                "checkpoint_dir under multi-process backend='ps' is not "
+                "supported (controllers would collide in one directory); "
+                "checkpoint the PS owner's center instead"
+            )
+        if self.ema_decay is not None:
+            raise NotImplementedError(
+                "ema_decay under multi-process backend='ps' is not "
+                "supported (the averaged center would live only with "
+                "process 0's server)"
+            )
+        if self.ps_host is not None:
+            raise ValueError(
+                "ps_host is incompatible with multi-process backend='ps' "
+                "(process 0 hosts the server automatically)"
+            )
+        W_local = self.num_workers // pc
+        transport = "native" if self.ps_transport == "native" else "socket"
+        # one init serves the server template AND the final pull's
+        # FlatSpec (shapes only) — no per-stage re-inits of a big model
+        params0, _ = self.spec.init_np(self.seed)
+        ps = None
+        host, port = "", 0
+        if pi == 0:
+            rule = self.allocate_merge_rule()
+            if transport == "native":
+                from distkeras_tpu.native_ps import NativeSocketParameterServer
+
+                ps = NativeSocketParameterServer(
+                    params0, rule, self.num_workers, host="0.0.0.0",
+                    port=self.ps_port,
+                )
+            else:
+                from distkeras_tpu.parameter_servers import (
+                    SocketParameterServer,
+                )
+
+                ps = SocketParameterServer(
+                    params0, rule, self.num_workers, host="0.0.0.0",
+                    port=self.ps_port,
+                )
+            ps.initialize()
+            ps.start()
+            host = networking.determine_host_address()
+            port = ps.port
+        host, port = _bcast_host_port(host, port)
+
+        # strided per-process row partition: disjoint, covers every row,
+        # and a label-sorted dataset still gives each controller all
+        # classes; worker_shards inside the runner raises its own sizing
+        # error if a share is too small
+        shard = Dataset({c: ds[c][pi::pc] for c in ds.columns})
+
+        shim = copy.copy(self)  # shares spec/history; overrides the wiring
+        shim.num_workers = W_local
+        shim.ps_transport = transport
+        shim.ps_host = host
+        shim.ps_port = port
+        shim.worker_id_offset = pi * W_local
+        try:
+            self._train_ps(shard, shuffle, runner=shim)
+            # all controllers' commits must land before anyone reads the
+            # final center, and the server must outlive every reader
+            multihost_utils.sync_global_devices("distkeras_ps_drain")
+            if transport == "native":
+                from distkeras_tpu.native_ps import FlatSpec, NativePSClient
+
+                client = NativePSClient(
+                    host, port, 2**32 - 2, FlatSpec(params0)
+                )
+            else:
+                from distkeras_tpu.parameter_servers import (
+                    ParameterServerClient,
+                )
+
+                client = ParameterServerClient(host, port, 2**32 - 2)
+            final = client.pull()
+            client.close()
+            multihost_utils.sync_global_devices("distkeras_ps_final")
+        finally:
+            if ps is not None:
+                ps.stop()
+        # non-trainables trained per-controller on different shards —
+        # broadcast process 0's so every controller returns the identical
+        # (center, nt) model
+        nt = multihost_utils.broadcast_one_to_all(self.trained_nt_)
+        nt = jax.tree.map(np.asarray, nt)
+        validator = self._make_validator()
+        if validator is not None:
+            # the LAST validation record scores the returned global center
+            # (the earlier one was this controller's pre-drain snapshot)
+            self._validate_epoch(validator, final, nt, None)
+        return self._finalize(final, nt)
 
     def _maybe_checkpoint(self, state, epoch: int):
         if not self.checkpoint_dir:

@@ -350,7 +350,9 @@ def run_async_training(trainer, ds, shuffle: bool):
         ps.num_updates = restored_updates
 
     window_fn = _build_local_window(trainer._loss_step(), optimizer)
-    devices = jax.devices()
+    # hogwild threads drive this PROCESS's chips; under jax.distributed the
+    # global device list includes devices other controllers own
+    devices = jax.local_devices()
     history: list[dict] = []
     hlock = threading.Lock()
 

@@ -520,3 +520,80 @@ def test_two_process_validation_and_profile(tmp_path):
         sub = trace_dir / f"process{pid}"
         assert sub.is_dir(), f"missing trace dir for process {pid}"
         assert any(sub.rglob("*")), f"empty trace dir for process {pid}"
+
+
+@pytest.mark.slow
+def test_two_process_ps_backend_through_trainer_api(tmp_path):
+    """backend='ps' under a REAL 2-process jax.distributed cluster, through
+    plain trainer.train(ds): process 0 hosts the PS automatically, each
+    controller runs its 2 local hogwild workers against it over TCP with
+    offset ids, and the post-barrier pull hands BOTH controllers the same
+    trained center (checksums allgathered and compared in-cluster)."""
+    from distkeras_tpu.job_deployment import Job, LocalRunner, Punchcard
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(f"""
+        import json, os, sys
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        sys.path.insert(0, {str(REPO)!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from distkeras_tpu.job_deployment import (
+            cluster_args_from_env, initialize_cluster)
+        initialize_cluster(**cluster_args_from_env())
+        import numpy as np
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        from distkeras_tpu import DOWNPOUR
+        from distkeras_tpu.datasets import higgs
+        from distkeras_tpu.models import mlp
+
+        from distkeras_tpu.data import Dataset
+
+        train, _ = higgs(n_train=2048, n_test=64)
+        # LABEL-SORTED rows: the strided per-process split must still hand
+        # every controller all classes (a contiguous cut would give each
+        # controller one class and wreck the center)
+        order = np.argsort(train["label"], kind="stable")
+        train = Dataset({{c: train[c][order] for c in train.columns}})
+        t = DOWNPOUR(
+            mlp(input_shape=(28,), hidden=(32, 16), num_classes=2,
+                dtype=jnp.float32),
+            loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+            learning_rate=0.02, num_workers=4, batch_size=16,
+            communication_window=2, num_epoch=2, seed=3, backend="ps",
+        )
+        params = t.train(train, shuffle=True)
+        losses = [float(l) for l in t.get_history().losses()]
+        assert np.isfinite(losses).all(), losses
+        # 2 local workers x 16 windows x 2 epochs of per-window records
+        assert len(losses) == 64, len(losses)
+        # every controller ends with the identical center
+        ck = np.asarray([
+            float(np.dot(np.asarray(l, np.float64).ravel(),
+                         np.arange(1, np.asarray(l).size + 1,
+                                   dtype=np.float64)))
+            for l in jax.tree.leaves(params)
+        ])
+        everyone = np.asarray(multihost_utils.process_allgather(ck))
+        np.testing.assert_allclose(everyone[0], everyone[1], rtol=1e-9,
+                                   err_msg="controllers returned "
+                                           "different centers")
+        if jax.process_index() == 0:
+            with open({str(tmp_path)!r} + "/losses.json", "w") as f:
+                json.dump(losses, f)
+    """))
+
+    pc = Punchcard(script=str(worker), hosts=["localhost", "localhost"],
+                   coordinator_port=port)
+    runner = LocalRunner()
+    Job(pc, runner=runner).run()
+    codes = runner.wait(timeout=420)
+    assert codes == [0, 0], [p.captured_stderr[-2000:] for p in runner.procs]
+
+    losses = json.loads((tmp_path / "losses.json").read_text())
+    assert np.mean(losses[-8:]) < losses[0]  # it actually learned
