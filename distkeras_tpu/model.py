@@ -47,6 +47,16 @@ class ModelSpec:
     #: lets serving transforms like ``ops.quant.quantize_serving`` trace
     #: the module once without user-supplied inputs); ``None`` when unknown
     example: Any = None
+    #: optional fused loss implementations, keyed by the trainer-facing loss
+    #: name: ``{name: fn(params, state, x, y, training, mask=None) ->
+    #: (loss, new_state)}`` (``mask``: per-row validity weights, used by the
+    #: ``validation_data`` evaluator's padded chunks). When a trainer is
+    #: constructed with ``loss=<name>``, its loss step calls the fused fn
+    #: instead of ``loss(y, apply(x))`` — the seam that lets a model compute
+    #: its own loss without materializing the full output
+    #: (e.g. ``transformer_lm(fused_ce=True)``'s chunked cross-entropy,
+    #: which never builds the ``[B, L, V]`` logits tensor).
+    fused_losses: Any = None
 
     def init_np(self, seed: int = 0) -> tuple[Pytree, Pytree]:
         """Host-side init convenience returning NumPy pytrees."""

@@ -33,6 +33,7 @@ from distkeras_tpu.models.moe import (
 )
 from distkeras_tpu.models.lm import (
     TransformerLM,
+    beam_search,
     generate,
     next_token_dataset,
     quantize_lm,
@@ -56,6 +57,6 @@ __all__ = [
     "pipelined_transformer_forward",
     "sequence_parallel_transformer_forward",
     "MoETransformerClassifier", "moe_transformer_classifier",
-    "TransformerLM", "transformer_lm", "generate", "next_token_dataset",
-    "quantize_lm",
+    "TransformerLM", "transformer_lm", "generate", "beam_search",
+    "next_token_dataset", "quantize_lm",
 ]

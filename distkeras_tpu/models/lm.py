@@ -23,6 +23,7 @@ next-token objective is plain ``sparse_softmax_cross_entropy`` on the
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import flax.linen as nn
@@ -316,15 +317,29 @@ class TransformerLM(nn.Module):
         )
         return x + pos[None]
 
-    def _logits(self, x):
-        h = self.ln_head(x)
+    def _head(self, h):
+        """``lm_head`` over post-``ln_head`` hiddens — the ONE place the
+        head cast discipline lives (bf16 matmul, f32 logits); shared by
+        training, prefill, and decode so the paths cannot drift."""
         return self.lm_head(h.astype(self.dtype)).astype(jnp.float32)
 
+    def _logits(self, x):
+        return self._head(self.ln_head(x))
+
     def __call__(self, tokens, mask=None, training: bool = False):
+        # one forward definition: the unfused path is exactly hidden() + the
+        # head matmul, so the fused_ce loss can never drift from training's
+        return self._head(self.hidden(tokens, mask, training))
+
+    def hidden(self, tokens, mask=None, training: bool = False):
+        """Final pre-head hidden states ``[B, L, dim]`` (after the head
+        LayerNorm, f32) — the ``fused_ce`` loss path consumes these and
+        applies ``lm_head`` chunk-by-chunk, so the ``[B, L, vocab]`` logits
+        tensor never materializes (``ops/fused_ce.py``)."""
         x = self._embed_at(tokens)
         for blk in self.blocks:
             x = blk(x, mask, training)
-        return self._logits(x)
+        return self.ln_head(x)
 
     def prefill(self, tokens):
         """Full forward over the prompt; returns ``(logits, caches)`` with
@@ -468,10 +483,147 @@ def generate(model, params, prompt, max_new_tokens: int, *,
     return np.asarray(run(params, prompt, jax.random.PRNGKey(seed)))
 
 
+@functools.lru_cache(maxsize=64)
+def _beam_program(module: TransformerLM, max_new_tokens: int, beams: int,
+                  length_penalty: float, eos_id: int | None):
+    """One jitted prefill+scan beam-search program per (module, config)."""
+
+    def run(params, prompt):
+        B, lp = prompt.shape
+        K, V = beams, module.vocab
+        NEG = jnp.float32(-1e30)
+
+        logits, caches = module.apply(
+            {"params": params}, prompt, method=TransformerLM.prefill
+        )
+        logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), -1)
+        # eos stays a legal FIRST pick — it just finishes that beam
+        # immediately (a prompt is never "already finished")
+        scores, tok0 = jax.lax.top_k(logp0, K)          # [B, K]
+        # every beam shares the prompt's cache: tile rows to [B*K, …]
+        caches = jax.tree.map(
+            lambda c: jnp.repeat(c, K, axis=0), caches
+        )
+        toks = jnp.zeros((B, K, max_new_tokens), jnp.int32)
+        toks = toks.at[:, :, 0].set(tok0)
+        finished = (
+            tok0 == eos_id if eos_id is not None
+            else jnp.zeros((B, K), bool)
+        )
+
+        def body(carry, i):
+            scores, toks, caches, finished = carry
+            tok = jax.lax.dynamic_index_in_dim(
+                toks, i - 1, axis=2, keepdims=False
+            )                                            # [B, K]
+            logits, caches = module.apply(
+                {"params": params}, tok.reshape(B * K), caches,
+                lp + i - 1, method=TransformerLM.decode_step,
+            )
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32), -1
+            ).reshape(B, K, V)
+            if eos_id is not None:
+                # finished beams emit only eos at zero cost — their score
+                # is frozen and they stay comparable with live beams
+                only_eos = jnp.full((V,), NEG).at[eos_id].set(0.0)
+                logp = jnp.where(finished[:, :, None], only_eos, logp)
+            cand = scores[:, :, None] + logp             # [B, K, V]
+            scores, flat = jax.lax.top_k(cand.reshape(B, K * V), K)
+            parent, tok_new = flat // V, flat % V        # [B, K]
+            # reorder beam-major state to follow the surviving parents
+            gather = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+            caches = jax.tree.map(
+                lambda c: jnp.take(c, gather, axis=0), caches
+            )
+            toks = jnp.take_along_axis(toks, parent[:, :, None], axis=1)
+            toks = toks.at[:, :, i].set(tok_new)
+            finished = jnp.take_along_axis(finished, parent, axis=1)
+            if eos_id is not None:
+                finished = finished | (tok_new == eos_id)
+            return (scores, toks, caches, finished), None
+
+        if max_new_tokens > 1:
+            (scores, toks, caches, finished), _ = jax.lax.scan(
+                body, (scores, toks, caches, finished),
+                jnp.arange(1, max_new_tokens),
+            )
+        if length_penalty:
+            # GNMT length normalization: rank by score / ((5+len)/6)^alpha,
+            # len = tokens up to and including eos (or all, if none)
+            if eos_id is not None:
+                hit = toks == eos_id
+                first = jnp.argmax(hit, axis=2)
+                any_hit = jnp.any(hit, axis=2)
+                length = jnp.where(any_hit, first + 1, max_new_tokens)
+            else:
+                length = jnp.full((B, K), max_new_tokens)
+            norm = ((5.0 + length.astype(jnp.float32)) / 6.0) \
+                ** jnp.float32(length_penalty)
+            ranked = scores / norm
+        else:
+            ranked = scores
+        order = jnp.argsort(-ranked, axis=1)
+        ranked = jnp.take_along_axis(ranked, order, axis=1)
+        toks = jnp.take_along_axis(toks, order[:, :, None], axis=1)
+        full = jnp.concatenate(
+            [jnp.broadcast_to(prompt[:, None], (B, K, lp)), toks], axis=2
+        )
+        return full.astype(jnp.int32), ranked
+
+    return jax.jit(run)
+
+
+def beam_search(model, params, prompt, max_new_tokens: int, *,
+                beams: int = 4, length_penalty: float = 0.0,
+                eos_id: int | None = None):
+    """KV-cached beam-search decoding: ``prompt`` [B, Lp] int32 →
+    ``(tokens [B, beams, Lp+new], scores [B, beams])``, best beam first.
+
+    Same TPU shape discipline as :func:`generate` — one jitted program
+    (prefill + ``lax.scan``), static shapes throughout, the per-block KV
+    caches tiled to ``B·beams`` rows and re-gathered each step to follow
+    surviving parents. ``scores`` are accumulated token log-probabilities;
+    with ``length_penalty`` α > 0 they are GNMT-normalized
+    (``score / ((5+len)/6)^α``). ``eos_id`` finishes a beam: its score
+    freezes and it pads with ``eos_id`` while staying in the candidate set.
+    ``beams=1`` reduces exactly to greedy :func:`generate`.
+    """
+    module = model.module if isinstance(model, ModelSpec) else model
+    if not isinstance(module, TransformerLM):
+        raise TypeError(
+            f"beam_search() needs a TransformerLM (or its ModelSpec from "
+            f"transformer_lm()), got {type(module)}"
+        )
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim != 2:
+        raise ValueError(f"prompt must be [batch, length], got {prompt.shape}")
+    if prompt.shape[1] + max_new_tokens > module.maxlen:
+        raise ValueError(
+            f"prompt length {prompt.shape[1]} + max_new_tokens "
+            f"{max_new_tokens} exceeds the model's maxlen {module.maxlen}"
+        )
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if not 1 <= int(beams) <= module.vocab:
+        raise ValueError(
+            f"beams must be in [1, vocab={module.vocab}], got {beams}"
+        )
+    if eos_id is not None and not 0 <= int(eos_id) < module.vocab:
+        raise ValueError(f"eos_id {eos_id} outside vocab {module.vocab}")
+    run = _beam_program(
+        module, int(max_new_tokens), int(beams), float(length_penalty),
+        None if eos_id is None else int(eos_id),
+    )
+    toks, scores = run(params, prompt)
+    return np.asarray(toks), np.asarray(scores)
+
+
 def transformer_lm(vocab=1024, maxlen=256, dim=128, heads=4, depth=2,
                    dtype=jnp.bfloat16, attn_impl="reference",
                    attn_window=None, kv_heads=None,
-                   pos_embedding="sincos") -> ModelSpec:
+                   pos_embedding="sincos", fused_ce=False,
+                   ce_chunk=256) -> ModelSpec:
     """Causal-LM ModelSpec. Train with ``loss="sparse_softmax_cross_entropy"``
     on ``features=tokens [B, L]`` / ``label=tokens shifted left [B, L]``
     (see :func:`next_token_dataset`); decode with :func:`generate`.
@@ -481,14 +633,53 @@ def transformer_lm(vocab=1024, maxlen=256, dim=128, heads=4, depth=2,
     multi-query): query head ``h`` reads shared K/V head ``h // group``, and
     the decode KV cache shrinks ``heads / kv_heads`` ×. ``pos_embedding``:
     "sincos" (additive, the default) or "rope" (rotary q/k rotations —
-    relative positions; composes with GQA and sliding windows)."""
+    relative positions; composes with GQA and sliding windows).
+    ``fused_ce=True`` computes the training loss as a chunked fused
+    linear+cross-entropy (``ce_chunk`` rows of logits at a time,
+    ``ops/fused_ce.py``) so the ``[B, L, vocab]`` logits tensor never
+    materializes — the large-vocab memory lever; inference/`generate` are
+    unchanged."""
     module = TransformerLM(
         vocab=vocab, maxlen=maxlen, dim=dim, heads=heads, depth=depth,
         dtype=dtype, attn_impl=attn_impl, attn_window=attn_window,
         kv_heads=kv_heads, pos_embedding=pos_embedding,
     )
     example = jnp.zeros((1, maxlen), jnp.int32)
-    return from_flax(module, example, name="transformer_lm")
+    spec = from_flax(module, example, name="transformer_lm")
+    if fused_ce:
+        from distkeras_tpu.ops.fused_ce import chunked_softmax_cross_entropy
+
+        chunk = int(ce_chunk)
+
+        def fused(params, state, x, y, training, mask=None):
+            h = module.apply(
+                {"params": params, **state}, x, training=training,
+                method=TransformerLM.hidden,
+            )
+            b_, l_, d_ = h.shape
+            token_mask = None
+            if mask is not None:
+                # per-row validity [B] broadcasts to every token of the row
+                # (the validator's padded-chunk mask); [B, L] passes through
+                mask = jnp.asarray(mask, jnp.float32)
+                token_mask = (
+                    jnp.repeat(mask, l_) if mask.ndim == 1
+                    else mask.reshape(b_ * l_)
+                )
+            loss = chunked_softmax_cross_entropy(
+                h.astype(module.dtype).reshape(b_ * l_, d_),
+                jnp.reshape(y, (b_ * l_,)),
+                params["lm_head"]["kernel"].astype(module.dtype),
+                params["lm_head"]["bias"],
+                mask=token_mask,
+                chunk=chunk,
+            )
+            return loss, state
+
+        spec = dataclasses.replace(
+            spec, fused_losses={"sparse_softmax_cross_entropy": fused}
+        )
+    return spec
 
 
 def quantize_lm(model, params) -> tuple[ModelSpec, dict]:

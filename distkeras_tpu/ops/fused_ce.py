@@ -1,0 +1,182 @@
+"""Chunked fused linear + softmax cross-entropy — large-vocab LM training
+without the ``[B, L, V]`` logits tensor.
+
+The plain causal-LM loss path materializes the full logits
+(``hidden @ lm_head`` → ``[B, L, V]``) and then reduces them to one scalar;
+at serious vocab sizes that buffer dominates training memory (B=8, L=2048,
+V=64k in f32 is ~4.3 GB — before the backward doubles it with dlogits).
+Only three reductions of the logits are ever needed: the per-row
+log-sum-exp, the picked label logit, and (in the backward) the softmax
+row. So this op computes the loss **in row chunks** inside a ``lax.scan``:
+each chunk's ``[chunk, V]`` logits live only for one scan step, XLA fuses
+the matmul with the log-sum-exp that consumes it, and the full logits
+tensor never exists in HBM — forward *or* backward.
+
+The backward is a :func:`jax.custom_vjp` that recomputes each chunk's
+logits from the saved ``hidden`` (the flash-attention trade: FLOPs for
+HBM), forms ``dlogits = softmax − onehot`` chunk-locally, and accumulates
+``d_kernel`` in an f32 carry. Peak extra memory is
+``O(chunk · V)`` activations + one f32 kernel-shaped accumulator, instead
+of ``O(N · V)``.
+
+This is a compiler-level fusion, not a Pallas kernel, on purpose: the
+chunk matmul ``[chunk, D] · [D, V]`` is exactly MXU-shaped, and XLA already
+fuses the elementwise softmax/log-sum-exp chain into its epilogue — a
+hand-written kernel would re-derive what the scan structure already
+guarantees (the O(chunk·V) ceiling).
+
+Surfaced on the LM family as ``transformer_lm(fused_ce=True)`` (see
+``models/lm.py``) via the ``ModelSpec.fused_losses`` seam — consumed by
+the six collective/PS trainers, ``MeshTrainer(strategy="spmd")`` (any
+``parameter_sharding``), and the ``validation_data`` evaluator. The
+pipeline/sequence/expert strategy engines rebuild their forwards
+mesh-specialized and train unfused (``MeshTrainer`` warns). The reference has no analogue (its largest head was an IMDB LSTM
+classifier, SURVEY.md §5.7); this exists so the rebuild's beyond-parity LM
+family trains at real vocab sizes on one chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _chunk_rows(n: int, chunk: int) -> tuple[int, int]:
+    """Number of scan steps and padded row count."""
+    steps = max(1, -(-n // chunk))
+    return steps, steps * chunk
+
+
+def _pad_to(x, rows):
+    n = x.shape[0]
+    if n == rows:
+        return x
+    pad = [(0, rows - n)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad)
+
+
+def _chunk_logits(h_c, kernel, bias):
+    """One chunk's logits in f32: ``[chunk, D] @ [D, V] (+ bias)``.
+
+    The matmul runs in the params' dtype (bf16 on TPU → MXU) with f32
+    accumulation; the softmax math downstream is all f32.
+    """
+    logits = jnp.dot(h_c, kernel, preferred_element_type=jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    return logits
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _fused_ce(hidden, kernel, bias, labels, mask, chunk):
+    loss, _ = _fused_ce_fwd(hidden, kernel, bias, labels, mask, chunk)
+    return loss
+
+
+def _fused_ce_fwd(hidden, kernel, bias, labels, mask, chunk):
+    n = hidden.shape[0]
+    steps, rows = _chunk_rows(n, chunk)
+    h = _pad_to(hidden, rows).reshape(steps, chunk, hidden.shape[1])
+    lab = _pad_to(labels, rows).reshape(steps, chunk)
+    m = _pad_to(mask, rows).reshape(steps, chunk)
+
+    def body(total, args):
+        h_c, lab_c, m_c = args
+        logits = _chunk_logits(h_c, kernel, bias)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, lab_c[:, None], axis=-1)[:, 0]
+        return total + jnp.sum((lse - picked) * m_c), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (h, lab, m))
+    msum = jnp.sum(mask)
+    denom = jnp.maximum(msum, 1.0)
+    return total / denom, (hidden, kernel, bias, labels, mask, total, msum)
+
+
+def _fused_ce_bwd(chunk, res, g):
+    hidden, kernel, bias, labels, mask, total, msum = res
+    n, d = hidden.shape
+    steps, rows = _chunk_rows(n, chunk)
+    h = _pad_to(hidden, rows).reshape(steps, chunk, d)
+    lab = _pad_to(labels, rows).reshape(steps, chunk)
+    m = _pad_to(mask, rows).reshape(steps, chunk)
+    v = kernel.shape[1]
+    denom = jnp.maximum(msum, 1.0)
+    scale = g / denom
+
+    def body(carry, args):
+        dk, db = carry
+        h_c, lab_c, m_c = args
+        logits = _chunk_logits(h_c, kernel, bias)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, lab_c[:, None], axis=-1)[:, 0]
+        p = jax.nn.softmax(logits, axis=-1)
+        dlogits = p - jax.nn.one_hot(lab_c, v, dtype=p.dtype)
+        dlogits = dlogits * (m_c * scale)[:, None]
+        # dh in the hidden dtype (bf16 matmul on the MXU), dk accumulated f32
+        dh_c = jnp.dot(
+            dlogits.astype(hidden.dtype), kernel.T,
+            preferred_element_type=jnp.float32,
+        ).astype(hidden.dtype)
+        dk = dk + jnp.dot(
+            h_c.T, dlogits.astype(hidden.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.float32)
+        db = db + jnp.sum(dlogits, axis=0)
+        return (dk, db), (dh_c, lse - picked)
+
+    zero = (jnp.zeros((d, v), jnp.float32), jnp.zeros((v,), jnp.float32))
+    (dk, db), (dh, nll) = jax.lax.scan(body, zero, (h, lab, m))
+    dh = dh.reshape(rows, d)[:n]
+    # loss = T/D with T = Σ nll_i·m_i, D = max(Σm, 1):
+    # ∂loss/∂m_i = nll_i/D − T·[Σm > 1]/D² — the same weights a caller
+    # differentiating the unfused masked mean would get
+    ddenom = jnp.where(msum > 1.0, 1.0, 0.0)
+    dmask = g * (nll.reshape(rows)[:n] / denom - total * ddenom / denom**2)
+    dbias = None if bias is None else db.astype(bias.dtype)
+    return (
+        dh,
+        dk.astype(kernel.dtype),
+        dbias,
+        np.zeros(labels.shape, dtype=jax.dtypes.float0),
+        dmask.astype(mask.dtype),
+    )
+
+
+_fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
+def chunked_softmax_cross_entropy(hidden, labels, kernel, bias=None, *,
+                                  mask=None, chunk: int = 256):
+    """Mean sparse softmax cross-entropy of ``hidden @ kernel (+ bias)``
+    against integer ``labels``, computed ``chunk`` rows at a time.
+
+    Equivalent to ``sparse_softmax_cross_entropy(labels, logits)`` (or its
+    masked form when ``mask`` is given) with the logits accumulated in f32 —
+    but the full ``[N, V]`` logits tensor is never materialized in either
+    the forward or the backward pass (see module docstring).
+
+    Args:
+      hidden: ``[N, D]`` final hidden states (callers flatten ``[B, L, D]``).
+      labels: ``[N]`` integer class ids.
+      kernel: ``[D, V]`` head weight (any float dtype; bf16 hits the MXU).
+      bias: optional ``[V]`` head bias.
+      mask: optional ``[N]`` validity weights; loss is
+        ``sum(nll · mask) / max(sum(mask), 1)``. Default: all rows valid.
+      chunk: rows per scan step — peak logits memory is ``chunk × V`` f32.
+    """
+    hidden = jnp.asarray(hidden)
+    if hidden.ndim != 2:
+        raise ValueError(f"hidden must be [rows, dim], got {hidden.shape}")
+    labels = jnp.asarray(labels, jnp.int32).reshape(hidden.shape[0])
+    if mask is None:
+        mask = jnp.ones((hidden.shape[0],), jnp.float32)
+    else:
+        mask = jnp.asarray(mask, jnp.float32).reshape(hidden.shape[0])
+    if int(chunk) < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    return _fused_ce(hidden, kernel, bias, labels, mask, int(chunk))

@@ -111,13 +111,17 @@ class GeneratorPredictor:
     (``models.transformer_lm``): appends a column of newly generated tokens
     ``[N, max_new_tokens]``. Prompts are processed in fixed-size chunks
     (static shapes — XLA compiles the prefill+scan program once); pad rows
-    are generated and discarded.
+    are generated and discarded. ``beams > 1`` decodes with
+    :func:`models.beam_search` instead of sampling and keeps each row's
+    best beam (``temperature``/``top_k`` must stay at their greedy
+    defaults — beam search is deterministic).
     """
 
     def __init__(self, model, params, *, features_col: str = "features",
                  output_col: str = "generated", max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int | None = None,
-                 seed: int = 0, batch_size: int = 64):
+                 seed: int = 0, batch_size: int = 64, beams: int = 1,
+                 length_penalty: float = 0.0, eos_id: int | None = None):
         from distkeras_tpu.models.lm import TransformerLM
 
         module = model.module if isinstance(model, ModelSpec) else model
@@ -135,20 +139,43 @@ class GeneratorPredictor:
         self.top_k = top_k
         self.seed = int(seed)
         self.batch_size = int(batch_size)
+        self.beams = int(beams)
+        self.length_penalty = float(length_penalty)
+        self.eos_id = eos_id
+        if self.beams < 1:
+            raise ValueError(f"beams must be >= 1, got {beams}")
+        if self.beams > 1 and (self.temperature != 0.0 or top_k is not None):
+            raise ValueError(
+                "beam search is deterministic: temperature/top_k cannot be "
+                "combined with beams > 1"
+            )
+        if self.beams == 1 and (eos_id is not None or self.length_penalty):
+            raise ValueError(
+                "eos_id/length_penalty are beam-search options: sampling "
+                "decode (beams=1) would silently ignore them — set beams > 1"
+            )
 
     def predict(self, ds: Dataset) -> Dataset:
-        from distkeras_tpu.models.lm import generate
+        from distkeras_tpu.models.lm import beam_search, generate
 
         outs = []
         for i, ((chunk,), real) in enumerate(padded_chunks(
             [np.asarray(ds[self.features_col])], self.batch_size
         )):
-            full = generate(
-                self.model, self.params, chunk, self.max_new_tokens,
-                temperature=self.temperature, top_k=self.top_k,
-                # distinct stream per chunk — identical prompts in different
-                # chunks must not draw identical samples
-                seed=self.seed + i,
-            )
+            if self.beams > 1:
+                toks, _ = beam_search(
+                    self.model, self.params, chunk, self.max_new_tokens,
+                    beams=self.beams, length_penalty=self.length_penalty,
+                    eos_id=self.eos_id,
+                )
+                full = toks[:, 0]  # best beam per row
+            else:
+                full = generate(
+                    self.model, self.params, chunk, self.max_new_tokens,
+                    temperature=self.temperature, top_k=self.top_k,
+                    # distinct stream per chunk — identical prompts in
+                    # different chunks must not draw identical samples
+                    seed=self.seed + i,
+                )
             outs.append(full[:real, chunk.shape[1]:])
         return ds.with_column(self.output_col, np.concatenate(outs))

@@ -126,9 +126,24 @@ def _as_cols(features_col) -> list[str]:
     )
 
 
-def _make_loss_step(spec: ModelSpec, loss_fn: Callable, n_feat: int):
+def _make_loss_step(spec: ModelSpec, loss_fn: Callable, n_feat: int,
+                    loss_name=None):
     """Build ``loss_step(params, nt, batch)`` for a batch laid out as
-    ``(*features, label)`` — shared by all training engines."""
+    ``(*features, label)`` — shared by all training engines.
+
+    When the spec carries a fused implementation for this loss name
+    (``ModelSpec.fused_losses``), the step routes through it instead of
+    ``loss(y, apply(x))`` — the model computes its own loss without
+    materializing the full output (e.g. the chunked large-vocab
+    cross-entropy of ``transformer_lm(fused_ce=True)``)."""
+    fused = (spec.fused_losses or {}).get(loss_name)
+    if fused is not None:
+        def fused_step(params, nt, batch):
+            feats, y = batch[:n_feat], batch[n_feat]
+            x = feats[0] if n_feat == 1 else tuple(feats)
+            return fused(params, nt, x, y, training=True)
+
+        return fused_step
 
     def loss_step(params, nt, batch):
         feats, y = batch[:n_feat], batch[n_feat]
@@ -239,9 +254,13 @@ class _Validator:
 
     def __init__(self, spec: ModelSpec, loss_fn: Callable, ds: Dataset,
                  features_col: list[str], label_col: str, batch_size: int,
-                 mesh=None):
+                 mesh=None, fused_loss=None):
         if len(ds) == 0:
             raise ValueError("validation_data has 0 rows")
+        if fused_loss is not None and len(features_col) != 1:
+            raise ValueError(
+                "fused-loss validation supports a single features column"
+            )
         self.ds = ds
         self.mesh = mesh
         self.cols = list(features_col) + [label_col]
@@ -254,6 +273,18 @@ class _Validator:
         def eval_batch(params, nt, arrs, mask):
             feats, y = arrs[:n_feat], arrs[n_feat]
             x = feats[0] if n_feat == 1 else tuple(feats)
+            if fused_loss is not None:
+                # a model with a fused loss (transformer_lm(fused_ce=True))
+                # must not materialize its full output at eval either: one
+                # fused call over the whole chunk with the row mask (pad
+                # rows excluded inside the op, so peak memory stays at the
+                # op's own chunk·V ceiling). Rows share the static L, so
+                # the masked token mean × real-row count equals the sum of
+                # per-row means the plain path accumulates. Accuracy stays
+                # undefined exactly as for per-token labels below.
+                loss = fused_loss(params, nt, x, y, training=False,
+                                  mask=mask)[0]
+                return loss * jnp.sum(mask), jnp.full((), -1.0)
             out, _ = spec.apply(params, nt, x, training=False)
             # loss_fn is mean-reduced; vmap over single-row slices recovers
             # per-row losses for any named loss, so pad rows mask out exactly
@@ -420,6 +451,7 @@ class Trainer:
             self._coerce_dataset(self.validation_data),
             self.features_col, self.label_col, self.batch_size,
             mesh=getattr(self, "mesh", None),
+            fused_loss=(self.spec.fused_losses or {}).get(self.loss),
         )
 
     def _validate_epoch(self, validator, params, nt, epoch):
@@ -642,7 +674,8 @@ class DistributedTrainer(Trainer):
         )
 
     def _loss_step(self) -> Callable:
-        return _make_loss_step(self.spec, self.loss_fn, len(self.features_col))
+        return _make_loss_step(self.spec, self.loss_fn, len(self.features_col),
+                               loss_name=self.loss)
 
     # -- training ----------------------------------------------------------
 
@@ -1206,7 +1239,8 @@ class MeshTrainer(Trainer):
         ident = lambda p: p
         if self.strategy == "spmd":
             loss_step = _make_loss_step(
-                self.spec, self.loss_fn, len(self.features_col)
+                self.spec, self.loss_fn, len(self.features_col),
+                loss_name=self.loss,
             )
             if self.parameter_sharding == "megatron":
                 engine = SPMDEngine(
@@ -1233,6 +1267,19 @@ class MeshTrainer(Trainer):
             kwargs = dict(dp_axis=dp_axis)
         elif self.strategy == "expert":
             kwargs = dict(aux_weight=self.aux_weight)
+        if (self.spec.fused_losses or {}).get(self.loss) is not None:
+            import warnings
+
+            # strategy engines rebuild the forward mesh-specialized from the
+            # flax module, so they cannot consume the spec's fused loss —
+            # the full-output loss runs instead, at full-output memory
+            warnings.warn(
+                f"strategy={self.strategy!r} trains with the unfused "
+                f"{self.loss!r} loss (the model's fused implementation — "
+                f"e.g. transformer_lm(fused_ce=True) — only applies under "
+                f"strategy='spmd' and the collective/ps trainers); expect "
+                f"full-logits memory"
+            )
         loss_step, specs_for, to_engine, from_engine = STRATEGIES[
             self.strategy
         ](self.spec, self.loss_fn, self.mesh, **kwargs)

@@ -13,6 +13,11 @@ one kwarg each —
                       a ring cache of `window` slots
   --attn flash        the Pallas flash-attention kernel (auto-falls back to
                       the XLA path off-TPU / on ragged prompt lengths)
+  --fused-ce          chunked fused linear+cross-entropy training loss —
+                      the [B, L, vocab] logits tensor never materializes
+
+After training, the script decodes greedily AND with beam search
+(models.beam_search), then re-serves the model in int8.
 
 The task is a deterministic cyclic language (next token = (token+1) mod V),
 so the script can check its own generations exactly.
@@ -48,6 +53,7 @@ def main():
     ap.add_argument("--pos", default="sincos", choices=["sincos", "rope"])
     ap.add_argument("--kv-heads", type=int, default=None)
     ap.add_argument("--window", type=int, default=None)
+    ap.add_argument("--fused-ce", action="store_true")
     ap.add_argument("--quick", action="store_true",
                     help="CI-sized run (fewer rows, shorter sequences)")
     args = ap.parse_args()
@@ -79,6 +85,7 @@ def main():
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
         attn_impl=args.attn, pos_embedding=args.pos,
         kv_heads=args.kv_heads, attn_window=args.window,
+        fused_ce=args.fused_ce,
     )
     cls = getattr(trainers, args.trainer)
     kwargs = dict(
@@ -108,6 +115,19 @@ def main():
               f"{list(out[r, n_prompt:n_prompt + 12])} ...")
     if acc < 0.9:
         print("FAILED: generations diverge from the cyclic language")
+        return 1
+
+    # beam search over the same caches: best beam of a trained model must
+    # recover the greedy continuation on a deterministic language
+    from distkeras_tpu.models import beam_search
+
+    btoks, bscores = beam_search(spec, params, prompts, max_new_tokens=n_new,
+                                 beams=4)
+    bacc = float((btoks[:, 0, n_prompt:] == expect[:, n_prompt:]).mean())
+    print(f"[beam-4] best-beam accuracy: {bacc:.3f}  "
+          f"(score {float(bscores[0, 0]):.2f})")
+    if bacc < 0.9:
+        print("FAILED: beam search diverges from the cyclic language")
         return 1
 
     # int8 weight-only serving: quantize the trained model and decode again

@@ -1,0 +1,212 @@
+"""Chunked fused linear+cross-entropy (``ops/fused_ce.py``).
+
+Oracle: the unfused path — materialize ``hidden @ kernel + bias`` and take
+``sparse_softmax_cross_entropy`` (masked form when a mask is given). The
+fused op must match it in value AND in the gradients w.r.t. hidden, kernel,
+and bias, across chunk sizes that do and don't divide the row count.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.ops import losses
+from distkeras_tpu.ops.fused_ce import chunked_softmax_cross_entropy
+
+
+def _oracle(hidden, labels, kernel, bias, mask=None):
+    logits = (
+        jnp.dot(hidden, kernel, preferred_element_type=jnp.float32)
+        .astype(jnp.float32)
+    )
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if mask is None:
+        return losses.sparse_softmax_cross_entropy(labels, logits)
+    return losses.masked_sparse_softmax_cross_entropy(labels, logits, mask)
+
+
+def _problem(rng, n=37, d=16, v=101, dtype=np.float32):
+    h = rng.normal(size=(n, d)).astype(dtype)
+    w = (rng.normal(size=(d, v)) * 0.3).astype(dtype)
+    b = (rng.normal(size=(v,)) * 0.1).astype(np.float32)
+    y = rng.integers(0, v, n).astype(np.int32)
+    return h, y, w, b
+
+
+@pytest.mark.parametrize("chunk", [8, 16, 37, 64])
+def test_matches_unfused_f32(rng, chunk):
+    h, y, w, b = _problem(rng)
+    fused = chunked_softmax_cross_entropy(h, y, w, b, chunk=chunk)
+    ref = _oracle(h, y, w, b)
+    np.testing.assert_allclose(float(fused), float(ref), rtol=1e-6)
+
+
+def test_gradients_match_unfused_f32(rng):
+    h, y, w, b = _problem(rng)
+
+    gf = jax.grad(
+        lambda h, w, b: chunked_softmax_cross_entropy(h, y, w, b, chunk=16),
+        argnums=(0, 1, 2),
+    )(h, w, b)
+    gr = jax.grad(
+        lambda h, w, b: _oracle(h, y, w, b), argnums=(0, 1, 2)
+    )(h, w, b)
+    for a, e in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=2e-5, atol=1e-7)
+
+
+def test_masked_rows_are_excluded(rng):
+    h, y, w, b = _problem(rng, n=24)
+    mask = (rng.uniform(size=24) > 0.3).astype(np.float32)
+    fused = chunked_softmax_cross_entropy(h, y, w, b, mask=mask, chunk=7)
+    ref = _oracle(h, y, w, b, mask=mask)
+    np.testing.assert_allclose(float(fused), float(ref), rtol=1e-6)
+    # a masked row's hidden state must get zero gradient
+    gh = jax.grad(
+        lambda h: chunked_softmax_cross_entropy(h, y, w, b, mask=mask,
+                                                chunk=7)
+    )(jnp.asarray(h))
+    dead = np.asarray(gh)[mask == 0.0]
+    assert np.all(dead == 0.0)
+
+
+def test_mask_gradient_matches_unfused(rng):
+    """mask is a differentiable loss weight: d(loss)/d(mask) must equal the
+    autodiff of the unfused masked mean (nll_i/D − T·[Σm>1]/D²)."""
+    h, y, w, b = _problem(rng, n=19)
+    mask = rng.uniform(0.2, 1.0, size=19).astype(np.float32)
+    gm_f = jax.grad(
+        lambda m: chunked_softmax_cross_entropy(h, y, w, b, mask=m, chunk=5)
+    )(jnp.asarray(mask))
+    gm_r = jax.grad(lambda m: _oracle(h, y, w, b, mask=m))(jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(gm_f), np.asarray(gm_r),
+                               rtol=2e-5, atol=1e-7)
+
+
+def test_bf16_params_close_to_f32_oracle(rng):
+    h, y, w, b = _problem(rng, n=32, d=32, v=64)
+    h16, w16 = h.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
+    fused = chunked_softmax_cross_entropy(h16, y, w16, b, chunk=16)
+    ref = _oracle(jnp.asarray(h), y, jnp.asarray(w), b)
+    np.testing.assert_allclose(float(fused), float(ref), rtol=3e-2)
+    gh = jax.grad(
+        lambda x: chunked_softmax_cross_entropy(x, y, w16, b, chunk=16)
+    )(h16)
+    assert gh.dtype == jnp.bfloat16
+    gr = jax.grad(lambda x: _oracle(x, y, jnp.asarray(w), b))(jnp.asarray(h))
+    rel = np.abs(np.asarray(gh, np.float32) - np.asarray(gr))
+    assert float(rel.max()) <= 5e-2 * float(np.abs(np.asarray(gr)).max()) + 1e-4
+
+
+def test_shape_validation(rng):
+    h, y, w, b = _problem(rng, n=8, d=4, v=11)
+    with pytest.raises(ValueError, match="rows, dim"):
+        chunked_softmax_cross_entropy(h[None], y, w, b)
+    with pytest.raises(ValueError, match="chunk"):
+        chunked_softmax_cross_entropy(h, y, w, b, chunk=0)
+
+
+# -- model/trainer integration ------------------------------------------------
+
+
+def _lm_pair(**kw):
+    from distkeras_tpu.models.lm import transformer_lm
+
+    cfg = dict(vocab=97, maxlen=16, dim=32, heads=4, depth=1,
+               dtype=jnp.float32)
+    cfg.update(kw)
+    plain = transformer_lm(**cfg)
+    fused = transformer_lm(fused_ce=True, ce_chunk=8, **cfg)
+    return plain, fused
+
+
+def test_lm_fused_loss_step_matches_plain(rng):
+    from distkeras_tpu.trainers import _make_loss_step
+    from distkeras_tpu.ops.losses import get_loss
+
+    plain, fused = _lm_pair()
+    assert fused.fused_losses and "sparse_softmax_cross_entropy" in \
+        fused.fused_losses
+    params, nt = plain.init_np(0)
+    toks = rng.integers(0, 97, size=(4, 17)).astype(np.int32)
+    batch = (toks[:, :-1], toks[:, 1:])
+    loss_name = "sparse_softmax_cross_entropy"
+    step_p = _make_loss_step(plain, get_loss(loss_name), 1,
+                             loss_name=loss_name)
+    step_f = _make_loss_step(fused, get_loss(loss_name), 1,
+                             loss_name=loss_name)
+    (lp, _), gp = jax.value_and_grad(step_p, has_aux=True)(params, nt, batch)
+    (lf, _), gf = jax.value_and_grad(step_f, has_aux=True)(params, nt, batch)
+    np.testing.assert_allclose(float(lf), float(lp), rtol=1e-5)
+    flat_p = jax.tree.leaves(gp)
+    flat_f = jax.tree.leaves(gf)
+    for a, e in zip(flat_f, flat_p):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=5e-4, atol=1e-6)
+
+
+def test_lm_trains_with_fused_ce(rng):
+    from distkeras_tpu.models.lm import next_token_dataset, transformer_lm
+    from distkeras_tpu.trainers import ADAG
+
+    period = 8
+    spec = transformer_lm(vocab=period, maxlen=16, dim=32, heads=4, depth=1,
+                          dtype=jnp.float32, fused_ce=True, ce_chunk=64)
+    # the deterministic "count up mod period" language is quickly learnable
+    rows = np.stack([
+        (np.arange(13) + s) % period for s in rng.integers(0, period, 256)
+    ]).astype(np.int32)
+    ds = next_token_dataset(rows)
+    tr = ADAG(spec, loss="sparse_softmax_cross_entropy",
+              worker_optimizer="adam", learning_rate=5e-3, batch_size=32,
+              communication_window=2, num_epoch=6, num_workers=2, seed=0)
+    tr.train(ds, shuffle=True)
+    hist = [float(l) for l in tr.get_history().losses()]
+    assert np.isfinite(hist).all()
+    assert np.mean(hist[-2:]) < 0.5 * np.mean(hist[:2])
+
+
+def test_validator_scores_through_fused_loss(rng):
+    """validation_data on a fused_ce model must not materialize full logits:
+    the _Validator routes through the fused fn and reports the same val_loss
+    as the unfused path (accuracy is undefined for per-token labels on both
+    paths)."""
+    from distkeras_tpu.models.lm import next_token_dataset
+    from distkeras_tpu.ops.losses import get_loss
+    from distkeras_tpu.trainers import _Validator
+
+    plain, fused = _lm_pair()
+    name = "sparse_softmax_cross_entropy"
+    params, nt = plain.init_np(0)
+    rows = rng.integers(0, 97, size=(11, 17)).astype(np.int32)
+    ds = next_token_dataset(rows)
+    v_plain = _Validator(plain, get_loss(name), ds, ["features"], "label", 4)
+    v_fused = _Validator(fused, get_loss(name), ds, ["features"], "label", 4,
+                         fused_loss=fused.fused_losses[name])
+    r_plain = v_plain(params, nt)
+    r_fused = v_fused(params, nt)
+    np.testing.assert_allclose(r_fused["val_loss"], r_plain["val_loss"],
+                               rtol=1e-5)
+    assert "val_accuracy" not in r_fused and "val_accuracy" not in r_plain
+
+
+def test_mesh_trainer_strategy_warns_fused_loss_unused():
+    """Strategy engines rebuild the forward and cannot consume the fused
+    loss; MeshTrainer must say so instead of silently training unfused."""
+    import pytest as _pytest
+
+    from distkeras_tpu.trainers import MeshTrainer
+
+    _, fused = _lm_pair()
+    t = MeshTrainer(fused, loss="sparse_softmax_cross_entropy",
+                    mesh_shape={"pp": 8}, strategy="pipeline", batch_size=8)
+    with _pytest.warns(UserWarning, match="unfused"):
+        try:
+            t._build_engine()
+        except Exception:
+            pass  # the LM isn't pipeline-compatible; the warning is the test
