@@ -40,6 +40,7 @@ from distkeras_tpu.models.lm import (
     transformer_lm,
 )
 from distkeras_tpu.models.resnet import ResNetSmall, resnet_small
+from distkeras_tpu.models.sru import SRUClassifier, sru_classifier
 from distkeras_tpu.models.transformer import (
     TransformerClassifier,
     pipelined_transformer_forward,
@@ -52,6 +53,7 @@ __all__ = [
     "LeNet", "lenet",
     "VGGSmall", "vgg_small",
     "LSTMClassifier", "lstm_classifier",
+    "SRUClassifier", "sru_classifier",
     "ResNetSmall", "resnet_small",
     "TransformerClassifier", "transformer_classifier",
     "pipelined_transformer_forward",

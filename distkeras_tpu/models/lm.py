@@ -392,6 +392,28 @@ class TransformerLM(nn.Module):
         return self._logits(x)[:, 0], tuple(new_caches)
 
 
+def _check_decode_args(fn_name: str, model, prompt, max_new_tokens: int):
+    """Shared validation for generate()/beam_search(): returns
+    ``(module, prompt int32 [B, Lp])`` or raises."""
+    module = model.module if isinstance(model, ModelSpec) else model
+    if not isinstance(module, TransformerLM):
+        raise TypeError(
+            f"{fn_name}() needs a TransformerLM (or its ModelSpec from "
+            f"transformer_lm()), got {type(module)}"
+        )
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim != 2:
+        raise ValueError(f"prompt must be [batch, length], got {prompt.shape}")
+    if prompt.shape[1] + max_new_tokens > module.maxlen:
+        raise ValueError(
+            f"prompt length {prompt.shape[1]} + max_new_tokens "
+            f"{max_new_tokens} exceeds the model's maxlen {module.maxlen}"
+        )
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    return module, prompt
+
+
 def _sample_fn(temperature: float, top_k: int | None):
     """Greedy for temperature==0, else temperature/top-k categorical."""
 
@@ -456,23 +478,9 @@ def generate(model, params, prompt, max_new_tokens: int, *,
     sampling at the given temperature, optionally truncated to the ``top_k``
     highest-probability tokens. Deterministic for a fixed ``seed``.
     """
-    module = model.module if isinstance(model, ModelSpec) else model
-    if not isinstance(module, TransformerLM):
-        raise TypeError(
-            f"generate() needs a TransformerLM (or its ModelSpec from "
-            f"transformer_lm()), got {type(module)}"
-        )
-    prompt = jnp.asarray(prompt, jnp.int32)
-    if prompt.ndim != 2:
-        raise ValueError(f"prompt must be [batch, length], got {prompt.shape}")
-    lp = prompt.shape[1]
-    if lp + max_new_tokens > module.maxlen:
-        raise ValueError(
-            f"prompt length {lp} + max_new_tokens {max_new_tokens} exceeds "
-            f"the model's maxlen {module.maxlen}"
-        )
-    if max_new_tokens < 1:
-        raise ValueError("max_new_tokens must be >= 1")
+    module, prompt = _check_decode_args(
+        "generate", model, prompt, max_new_tokens
+    )
     if top_k is not None and not 1 <= int(top_k) <= module.vocab:
         raise ValueError(
             f"top_k must be in [1, vocab={module.vocab}], got {top_k}"
@@ -589,22 +597,9 @@ def beam_search(model, params, prompt, max_new_tokens: int, *,
     freezes and it pads with ``eos_id`` while staying in the candidate set.
     ``beams=1`` reduces exactly to greedy :func:`generate`.
     """
-    module = model.module if isinstance(model, ModelSpec) else model
-    if not isinstance(module, TransformerLM):
-        raise TypeError(
-            f"beam_search() needs a TransformerLM (or its ModelSpec from "
-            f"transformer_lm()), got {type(module)}"
-        )
-    prompt = jnp.asarray(prompt, jnp.int32)
-    if prompt.ndim != 2:
-        raise ValueError(f"prompt must be [batch, length], got {prompt.shape}")
-    if prompt.shape[1] + max_new_tokens > module.maxlen:
-        raise ValueError(
-            f"prompt length {prompt.shape[1]} + max_new_tokens "
-            f"{max_new_tokens} exceeds the model's maxlen {module.maxlen}"
-        )
-    if max_new_tokens < 1:
-        raise ValueError("max_new_tokens must be >= 1")
+    module, prompt = _check_decode_args(
+        "beam_search", model, prompt, max_new_tokens
+    )
     if not 1 <= int(beams) <= module.vocab:
         raise ValueError(
             f"beams must be in [1, vocab={module.vocab}], got {beams}"

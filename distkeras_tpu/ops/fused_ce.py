@@ -126,10 +126,13 @@ def _fused_ce_bwd(chunk, res, g):
             h_c.T, dlogits.astype(hidden.dtype),
             preferred_element_type=jnp.float32,
         ).astype(jnp.float32)
-        db = db + jnp.sum(dlogits, axis=0)
+        if bias is not None:  # no [V] carry/reduction for bias-free heads
+            db = db + jnp.sum(dlogits, axis=0)
         return (dk, db), (dh_c, lse - picked)
 
-    zero = (jnp.zeros((d, v), jnp.float32), jnp.zeros((v,), jnp.float32))
+    zero_db = (jnp.zeros((), jnp.float32) if bias is None
+               else jnp.zeros((v,), jnp.float32))
+    zero = (jnp.zeros((d, v), jnp.float32), zero_db)
     (dk, db), (dh, nll) = jax.lax.scan(body, zero, (h, lab, m))
     dh = dh.reshape(rows, d)[:n]
     # loss = T/D with T = Σ nll_i·m_i, D = max(Σm, 1):

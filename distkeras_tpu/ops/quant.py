@@ -257,7 +257,11 @@ def quantize_serving(spec, params, state=None):
         with nn.intercept_methods(_q_interceptor):
             return base_apply(params, state, x, training)
 
-    qspec = dataclasses.replace(spec, apply=apply, name=spec.name + "_int8")
+    # fused_losses closures capture the FLOAT module and param layout —
+    # they must not ride into the int8 serving spec (training it is an
+    # error the quantized apply raises; a stale fused fn would bypass it)
+    qspec = dataclasses.replace(spec, apply=apply, name=spec.name + "_int8",
+                                fused_losses=None)
     return qspec, quantize_dense_tree(params, paths=dense_paths)
 
 

@@ -75,6 +75,21 @@ def test_masked_rows_are_excluded(rng):
     assert np.all(dead == 0.0)
 
 
+def test_bias_free_head_matches_and_differentiates(rng):
+    h, y, w, _ = _problem(rng, n=21)
+    fused = chunked_softmax_cross_entropy(h, y, w, None, chunk=8)
+    ref = _oracle(h, y, w, None)
+    np.testing.assert_allclose(float(fused), float(ref), rtol=1e-6)
+    gf = jax.grad(
+        lambda h, w: chunked_softmax_cross_entropy(h, y, w, None, chunk=8),
+        argnums=(0, 1),
+    )(h, w)
+    gr = jax.grad(lambda h, w: _oracle(h, y, w, None), argnums=(0, 1))(h, w)
+    for a, e in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=2e-5, atol=1e-7)
+
+
 def test_mask_gradient_matches_unfused(rng):
     """mask is a differentiable loss weight: d(loss)/d(mask) must equal the
     autodiff of the unfused masked mean (nll_i/D − T·[Σm>1]/D²)."""
