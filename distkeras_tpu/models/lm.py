@@ -274,6 +274,11 @@ class TransformerLM(nn.Module):
     pos_embedding: str = "sincos"
     #: int8 weight-only serving mode — see :func:`quantize_lm`
     quant: bool = False
+    #: rematerialize each block in the backward pass (jax.checkpoint) —
+    #: the long-context training memory lever, same as the encoder family;
+    #: decode entry points (prefill/step) are never differentiated and
+    #: stay unwrapped
+    remat: bool = False
 
     def setup(self):
         if self.kv_heads is not None and self.heads % self.kv_heads:
@@ -292,14 +297,19 @@ class TransformerLM(nn.Module):
                 f"{self.dim // self.heads}"
             )
         self.embed = nn.Embed(self.vocab, self.dim, dtype=self.dtype)
+        # nn.remat preserves the params tree (blocks_i names unchanged) and
+        # transforms __call__ only — prefill/step run through the same
+        # parameters un-rematted, which is exactly right for decode
+        block_cls = (nn.remat(DecoderBlock, static_argnums=(3,))
+                     if self.remat else DecoderBlock)
         self.blocks = [
-            DecoderBlock(dim=self.dim, heads=self.heads, dtype=self.dtype,
-                         attn_impl=self.attn_impl,
-                         attn_window=self.attn_window,
-                         kv_heads=self.kv_heads,
-                         rope=self.pos_embedding == "rope",
-                         maxlen=self.maxlen,
-                         quant=self.quant)
+            block_cls(dim=self.dim, heads=self.heads, dtype=self.dtype,
+                      attn_impl=self.attn_impl,
+                      attn_window=self.attn_window,
+                      kv_heads=self.kv_heads,
+                      rope=self.pos_embedding == "rope",
+                      maxlen=self.maxlen,
+                      quant=self.quant)
             for _ in range(self.depth)
         ]
         self.ln_head = nn.LayerNorm(dtype=jnp.float32)
@@ -618,7 +628,7 @@ def transformer_lm(vocab=1024, maxlen=256, dim=128, heads=4, depth=2,
                    dtype=jnp.bfloat16, attn_impl="reference",
                    attn_window=None, kv_heads=None,
                    pos_embedding="sincos", fused_ce=False,
-                   ce_chunk=256) -> ModelSpec:
+                   ce_chunk=256, remat=False) -> ModelSpec:
     """Causal-LM ModelSpec. Train with ``loss="sparse_softmax_cross_entropy"``
     on ``features=tokens [B, L]`` / ``label=tokens shifted left [B, L]``
     (see :func:`next_token_dataset`); decode with :func:`generate`.
@@ -633,11 +643,12 @@ def transformer_lm(vocab=1024, maxlen=256, dim=128, heads=4, depth=2,
     linear+cross-entropy (``ce_chunk`` rows of logits at a time,
     ``ops/fused_ce.py``) so the ``[B, L, vocab]`` logits tensor never
     materializes — the large-vocab memory lever; inference/`generate` are
-    unchanged."""
+    unchanged. ``remat=True`` checkpoints each decoder block (the
+    long-context activation-memory lever; composes with ``fused_ce``)."""
     module = TransformerLM(
         vocab=vocab, maxlen=maxlen, dim=dim, heads=heads, depth=depth,
         dtype=dtype, attn_impl=attn_impl, attn_window=attn_window,
-        kv_heads=kv_heads, pos_embedding=pos_embedding,
+        kv_heads=kv_heads, pos_embedding=pos_embedding, remat=remat,
     )
     example = jnp.zeros((1, maxlen), jnp.int32)
     spec = from_flax(module, example, name="transformer_lm")

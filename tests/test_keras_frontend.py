@@ -125,3 +125,44 @@ def test_keras_batchnorm_model_trains_and_stats_move():
     assert np.any(np.abs(np.asarray(bn.moving_variance) - 1.0) > 1e-3)
     preds = np.argmax(model.predict(x, verbose=0), axis=-1)
     assert np.mean(preds == y) > 0.7
+
+
+def test_keras_dropout_model_trains_and_infers_deterministically():
+    """Reference-era Keras models carry Dropout layers (the upstream MNIST
+    examples did); they must train through the trainers — the Keras seed-
+    generator state rides the non-trainable path — with dropout ACTIVE in
+    training mode and OFF at inference."""
+    import keras
+
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.model import from_keras
+
+    model = keras.Sequential([
+        keras.layers.Input((16,)),
+        keras.layers.Dense(32, activation="relu"),
+        keras.layers.Dropout(0.5),
+        keras.layers.Dense(4),
+    ])
+    spec = from_keras(model)
+    params, state = spec.init(None)
+    x = np.random.default_rng(1).normal(size=(8, 16)).astype(np.float32)
+    # training mode is stochastic (different masks as the seed state
+    # advances), inference is deterministic
+    o1, s1 = spec.apply(params, state, x, training=True)
+    o2, _ = spec.apply(params, s1, x, training=True)
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+    e1, _ = spec.apply(params, state, x, training=False)
+    e2, _ = spec.apply(params, state, x, training=False)
+    np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(256, 16)).astype(np.float32)
+    ys = (xs[:, 0] > 0).astype(np.int32)
+    ds = Dataset({"features": xs, "label": ys})
+    t = ADAG(model, loss="sparse_softmax_cross_entropy",
+             worker_optimizer="adam", learning_rate=5e-3, num_workers=4,
+             batch_size=16, communication_window=2, num_epoch=8)
+    out = t.train(ds, shuffle=True)
+    assert out is model
+    preds = np.argmax(model.predict(xs, verbose=0), axis=-1)
+    assert np.mean(preds == ys) > 0.7
