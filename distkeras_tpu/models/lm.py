@@ -279,6 +279,10 @@ class TransformerLM(nn.Module):
     #: decode entry points (prefill/step) are never differentiated and
     #: stay unwrapped
     remat: bool = False
+    #: share the token embedding with the output head (Press & Wolf 2017):
+    #: logits = hidden @ embedding.T — V·dim fewer parameters, and the
+    #: embedding receives both input- and output-side gradients
+    tie_embeddings: bool = False
 
     def setup(self):
         if self.kv_heads is not None and self.heads % self.kv_heads:
@@ -313,8 +317,9 @@ class TransformerLM(nn.Module):
             for _ in range(self.depth)
         ]
         self.ln_head = nn.LayerNorm(dtype=jnp.float32)
-        head = QDense if self.quant else nn.Dense
-        self.lm_head = head(self.vocab, dtype=self.dtype)
+        if not self.tie_embeddings:
+            head = QDense if self.quant else nn.Dense
+            self.lm_head = head(self.vocab, dtype=self.dtype)
 
     def _embed_at(self, tokens, pos0: int | jax.Array = 0):
         """Embed ``tokens`` occupying positions ``pos0 .. pos0+L``."""
@@ -328,10 +333,14 @@ class TransformerLM(nn.Module):
         return x + pos[None]
 
     def _head(self, h):
-        """``lm_head`` over post-``ln_head`` hiddens — the ONE place the
-        head cast discipline lives (bf16 matmul, f32 logits); shared by
-        training, prefill, and decode so the paths cannot drift."""
-        return self.lm_head(h.astype(self.dtype)).astype(jnp.float32)
+        """Output projection over post-``ln_head`` hiddens — the ONE place
+        the head cast discipline lives (bf16 matmul, f32 logits); shared by
+        training, prefill, and decode so the paths cannot drift. Tied mode
+        contracts against the embedding table (``nn.Embed.attend``)."""
+        h16 = h.astype(self.dtype)
+        if self.tie_embeddings:
+            return self.embed.attend(h16).astype(jnp.float32)
+        return self.lm_head(h16).astype(jnp.float32)
 
     def _logits(self, x):
         return self._head(self.ln_head(x))
@@ -628,7 +637,8 @@ def transformer_lm(vocab=1024, maxlen=256, dim=128, heads=4, depth=2,
                    dtype=jnp.bfloat16, attn_impl="reference",
                    attn_window=None, kv_heads=None,
                    pos_embedding="sincos", fused_ce=False,
-                   ce_chunk=256, remat=False) -> ModelSpec:
+                   ce_chunk=256, remat=False,
+                   tie_embeddings=False) -> ModelSpec:
     """Causal-LM ModelSpec. Train with ``loss="sparse_softmax_cross_entropy"``
     on ``features=tokens [B, L]`` / ``label=tokens shifted left [B, L]``
     (see :func:`next_token_dataset`); decode with :func:`generate`.
@@ -644,11 +654,16 @@ def transformer_lm(vocab=1024, maxlen=256, dim=128, heads=4, depth=2,
     ``ops/fused_ce.py``) so the ``[B, L, vocab]`` logits tensor never
     materializes — the large-vocab memory lever; inference/`generate` are
     unchanged. ``remat=True`` checkpoints each decoder block (the
-    long-context activation-memory lever; composes with ``fused_ce``)."""
+    long-context activation-memory lever; composes with ``fused_ce``).
+    ``tie_embeddings=True`` shares the token embedding with the output
+    head (V·dim fewer parameters; the head matmul contracts against the
+    embedding table, so int8 ``quantize_lm`` leaves the head in the
+    trained dtype)."""
     module = TransformerLM(
         vocab=vocab, maxlen=maxlen, dim=dim, heads=heads, depth=depth,
         dtype=dtype, attn_impl=attn_impl, attn_window=attn_window,
         kv_heads=kv_heads, pos_embedding=pos_embedding, remat=remat,
+        tie_embeddings=tie_embeddings,
     )
     example = jnp.zeros((1, maxlen), jnp.int32)
     spec = from_flax(module, example, name="transformer_lm")
@@ -672,11 +687,19 @@ def transformer_lm(vocab=1024, maxlen=256, dim=128, heads=4, depth=2,
                     jnp.repeat(mask, l_) if mask.ndim == 1
                     else mask.reshape(b_ * l_)
                 )
+            if module.tie_embeddings:
+                # the head IS the embedding: contract against its transpose
+                # (same math as nn.Embed.attend in _head), no bias
+                kernel = params["embed"]["embedding"].T.astype(module.dtype)
+                bias = None
+            else:
+                kernel = params["lm_head"]["kernel"].astype(module.dtype)
+                bias = params["lm_head"]["bias"]
             loss = chunked_softmax_cross_entropy(
                 h.astype(module.dtype).reshape(b_ * l_, d_),
                 jnp.reshape(y, (b_ * l_,)),
-                params["lm_head"]["kernel"].astype(module.dtype),
-                params["lm_head"]["bias"],
+                kernel,
+                bias,
                 mask=token_mask,
                 chunk=chunk,
             )

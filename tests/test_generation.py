@@ -636,3 +636,80 @@ def test_generator_predictor_beam_mode(lm):
         GeneratorPredictor(spec, params, beams=2, temperature=0.5)
     with pytest.raises(ValueError, match="beams"):
         GeneratorPredictor(spec, params, beams=0)
+
+
+# -- weight tying -------------------------------------------------------------
+
+
+def test_tied_embeddings_structure_and_logits():
+    """tie_embeddings drops lm_head from the params tree and computes
+    logits as hidden @ embedding.T (nn.Embed.attend)."""
+    spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS,
+                          depth=1, dtype=jnp.float32, tie_embeddings=True)
+    params, _ = spec.init_np(0)
+    assert "lm_head" not in params
+    assert params["embed"]["embedding"].shape == (VOCAB, DIM)
+    toks = np.arange(8, dtype=np.int32).reshape(1, 8)
+    logits = spec.apply(params, {}, jnp.asarray(toks), False)[0]
+    h = spec.module.apply({"params": params}, jnp.asarray(toks),
+                          method=TransformerLM.hidden)
+    manual = np.asarray(h) @ np.asarray(params["embed"]["embedding"]).T
+    np.testing.assert_allclose(np.asarray(logits), manual, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_tied_fused_ce_matches_unfused():
+    """fused_ce on a tied model contracts against the embedding transpose —
+    loss and gradients equal the unfused tied path."""
+    from distkeras_tpu.ops.losses import get_loss
+    from distkeras_tpu.trainers import _make_loss_step
+
+    cfg = dict(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS, depth=1,
+               dtype=jnp.float32, tie_embeddings=True)
+    plain = transformer_lm(**cfg)
+    fused = transformer_lm(**cfg, fused_ce=True, ce_chunk=8)
+    params, _ = plain.init_np(0)
+    rng = np.random.default_rng(4)
+    toks = rng.integers(0, VOCAB, size=(3, 17)).astype(np.int32)
+    batch = (toks[:, :-1], toks[:, 1:])
+    name = "sparse_softmax_cross_entropy"
+    sp = _make_loss_step(plain, get_loss(name), 1, loss_name=name)
+    sf = _make_loss_step(fused, get_loss(name), 1, loss_name=name)
+    (lp, _), gp = jax.value_and_grad(sp, has_aux=True)(params, {}, batch)
+    (lf, _), gf = jax.value_and_grad(sf, has_aux=True)(params, {}, batch)
+    np.testing.assert_allclose(float(lf), float(lp), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=1e-6)
+
+
+def test_tied_lm_trains_generates_and_quantizes():
+    """End to end on the cycle language: the tied model (V·dim fewer
+    params) learns, decodes the cycle, beam-decodes it, and survives int8
+    quantization (blocks quantized; the tied head stays in the trained
+    dtype)."""
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.models import beam_search, quantize_lm
+
+    period = 8
+    rng = np.random.default_rng(0)
+    rows = np.stack([
+        (np.arange(17) + s) % period for s in rng.integers(0, period, 512)
+    ]).astype(np.int32)
+    spec = transformer_lm(vocab=period, maxlen=32, dim=32, heads=4, depth=2,
+                          dtype=jnp.float32, tie_embeddings=True)
+    t = ADAG(spec, loss="sparse_softmax_cross_entropy",
+             worker_optimizer="adam", learning_rate=5e-3, num_workers=4,
+             batch_size=32, communication_window=2, num_epoch=6)
+    t.train(next_token_dataset(rows), shuffle=True)
+    params = t.trained_params_
+    prompt = np.tile(np.arange(6) % period, (2, 1)).astype(np.int32)
+    out = generate(spec, params, prompt, max_new_tokens=8)
+    expect = (np.arange(6, 14) % period)[None].repeat(2, axis=0)
+    assert np.array_equal(out[:, 6:], expect)
+    btoks, _ = beam_search(spec, params, prompt, max_new_tokens=8, beams=3)
+    assert np.array_equal(btoks[:, 0, 6:], expect)
+    qspec, qparams = quantize_lm(spec, params)
+    assert "lm_head" not in qparams
+    qout = generate(qspec, qparams, prompt, max_new_tokens=8)
+    assert np.array_equal(qout[:, 6:], expect)
