@@ -433,8 +433,14 @@ def _check_decode_args(fn_name: str, model, prompt, max_new_tokens: int):
     return module, prompt
 
 
-def _sample_fn(temperature: float, top_k: int | None):
-    """Greedy for temperature==0, else temperature/top-k categorical."""
+def _sample_fn(temperature: float, top_k: int | None,
+               top_p: float | None = None):
+    """Greedy for temperature==0, else temperature/top-k/top-p categorical.
+
+    Filters compose in the conventional order: top-k first, then nucleus
+    (top-p) over the surviving distribution — smallest prefix of
+    descending-probability tokens whose mass reaches ``top_p`` (the top-1
+    token always survives)."""
 
     def sample(logits, key):
         if temperature == 0.0:
@@ -443,6 +449,16 @@ def _sample_fn(temperature: float, top_k: int | None):
         if top_k is not None:
             kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
             scaled = jnp.where(scaled < kth, -1e30, scaled)
+        if top_p is not None and top_p < 1.0:
+            desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(desc, axis=-1)
+            # keep a token iff the mass strictly BEFORE it is < top_p: the
+            # minimal nucleus covering top_p, never empty
+            keep = jnp.cumsum(probs, axis=-1) - probs < top_p
+            cutoff = jnp.min(
+                jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True
+            )
+            scaled = jnp.where(scaled < cutoff, -1e30, scaled)
         return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
     return sample
@@ -450,12 +466,13 @@ def _sample_fn(temperature: float, top_k: int | None):
 
 @functools.lru_cache(maxsize=64)
 def _generate_program(module: TransformerLM, max_new_tokens: int,
-                      temperature: float, top_k: int | None):
+                      temperature: float, top_k: int | None,
+                      top_p: float | None = None):
     """One jitted prefill+scan program per (module, decode config) — flax
     modules are frozen dataclasses, so the lru_cache key is by value and
     repeated generate()/GeneratorPredictor chunks reuse the compilation
     (jit itself still specializes per prompt shape)."""
-    sample = _sample_fn(temperature, top_k)
+    sample = _sample_fn(temperature, top_k, top_p)
 
     def run(params, prompt, key):
         lp = prompt.shape[1]
@@ -487,7 +504,7 @@ def _generate_program(module: TransformerLM, max_new_tokens: int,
 
 def generate(model, params, prompt, max_new_tokens: int, *,
              temperature: float = 0.0, top_k: int | None = None,
-             seed: int = 0):
+             top_p: float | None = None, seed: int = 0):
     """Autoregressive decoding: ``prompt`` [B, Lp] int32 → [B, Lp+new] int32.
 
     One jitted program: prefill writes the KV caches for the whole prompt in
@@ -495,7 +512,9 @@ def generate(model, params, prompt, max_new_tokens: int, *,
     against the cache (O(L) per token instead of the O(L²) of re-running the
     full forward). ``temperature=0`` is greedy; otherwise categorical
     sampling at the given temperature, optionally truncated to the ``top_k``
-    highest-probability tokens. Deterministic for a fixed ``seed``.
+    highest-probability tokens and/or the smallest nucleus of tokens whose
+    probability mass reaches ``top_p`` (applied after ``top_k``).
+    Deterministic for a fixed ``seed``.
     """
     module, prompt = _check_decode_args(
         "generate", model, prompt, max_new_tokens
@@ -504,8 +523,11 @@ def generate(model, params, prompt, max_new_tokens: int, *,
         raise ValueError(
             f"top_k must be in [1, vocab={module.vocab}], got {top_k}"
         )
+    if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     run = _generate_program(
-        module, int(max_new_tokens), float(temperature), top_k
+        module, int(max_new_tokens), float(temperature), top_k,
+        None if top_p is None else float(top_p),
     )
     return np.asarray(run(params, prompt, jax.random.PRNGKey(seed)))
 

@@ -113,13 +113,14 @@ class GeneratorPredictor:
     (static shapes — XLA compiles the prefill+scan program once); pad rows
     are generated and discarded. ``beams > 1`` decodes with
     :func:`models.beam_search` instead of sampling and keeps each row's
-    best beam (``temperature``/``top_k`` must stay at their greedy
-    defaults — beam search is deterministic).
+    best beam (``temperature``/``top_k``/``top_p`` must stay at their
+    greedy defaults — beam search is deterministic).
     """
 
     def __init__(self, model, params, *, features_col: str = "features",
                  output_col: str = "generated", max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int | None = None,
+                 top_p: float | None = None,
                  seed: int = 0, batch_size: int = 64, beams: int = 1,
                  length_penalty: float = 0.0, eos_id: int | None = None):
         from distkeras_tpu.models.lm import TransformerLM
@@ -137,6 +138,7 @@ class GeneratorPredictor:
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = top_k
+        self.top_p = top_p
         self.seed = int(seed)
         self.batch_size = int(batch_size)
         self.beams = int(beams)
@@ -144,10 +146,12 @@ class GeneratorPredictor:
         self.eos_id = eos_id
         if self.beams < 1:
             raise ValueError(f"beams must be >= 1, got {beams}")
-        if self.beams > 1 and (self.temperature != 0.0 or top_k is not None):
+        if self.beams > 1 and (
+            self.temperature != 0.0 or top_k is not None or top_p is not None
+        ):
             raise ValueError(
-                "beam search is deterministic: temperature/top_k cannot be "
-                "combined with beams > 1"
+                "beam search is deterministic: temperature/top_k/top_p "
+                "cannot be combined with beams > 1"
             )
         if self.beams == 1 and (eos_id is not None or self.length_penalty):
             raise ValueError(
@@ -173,6 +177,7 @@ class GeneratorPredictor:
                 full = generate(
                     self.model, self.params, chunk, self.max_new_tokens,
                     temperature=self.temperature, top_k=self.top_k,
+                    top_p=self.top_p,
                     # distinct stream per chunk — identical prompts in
                     # different chunks must not draw identical samples
                     seed=self.seed + i,

@@ -98,6 +98,48 @@ def test_top_k_restricts_support(lm):
     np.testing.assert_array_equal(greedy, k1)
 
 
+def test_top_p_restricts_support(lm):
+    """Sampled tokens stay inside the numpy-computed nucleus; a tiny top_p
+    degenerates to greedy; top_p=1.0 is a no-op filter."""
+    spec, params = lm
+    module = spec.module
+    prompt = np.ones((2, 5), np.int32)
+
+    greedy = generate(spec, params, prompt, max_new_tokens=5)
+    p_tiny = generate(spec, params, prompt, max_new_tokens=5,
+                      temperature=2.0, top_p=1e-6, seed=3)
+    np.testing.assert_array_equal(greedy, p_tiny)
+
+    plain = generate(spec, params, prompt, max_new_tokens=6,
+                     temperature=1.0, seed=11)
+    p_one = generate(spec, params, prompt, max_new_tokens=6,
+                     temperature=1.0, top_p=1.0, seed=11)
+    np.testing.assert_array_equal(plain, p_one)
+
+    # every sampled first token lies in the nucleus of its own distribution
+    top_p = 0.6
+    logits = np.asarray(
+        module.apply({"params": params}, jnp.asarray(prompt))
+    )[:, -1]
+    out = generate(spec, params, prompt, max_new_tokens=1, temperature=1.0,
+                   top_p=top_p, seed=5)
+    for row, tok in enumerate(out[:, -1]):
+        order = np.argsort(-logits[row])
+        probs = np.exp(logits[row][order] - logits[row][order].max())
+        probs /= probs.sum()
+        before = np.cumsum(probs) - probs
+        nucleus = set(order[before < top_p])
+        assert int(tok) in nucleus
+
+
+def test_generate_rejects_bad_top_p(lm):
+    spec, params = lm
+    for bad in (0.0, -0.5, 1.5):
+        with pytest.raises(ValueError, match="top_p"):
+            generate(spec, params, np.zeros((1, 4), np.int32),
+                     max_new_tokens=2, temperature=1.0, top_p=bad)
+
+
 def test_generate_validates_inputs(lm):
     spec, params = lm
     with pytest.raises(ValueError, match="maxlen"):
