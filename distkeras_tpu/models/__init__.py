@@ -35,6 +35,7 @@ from distkeras_tpu.models.lm import (
     TransformerLM,
     beam_search,
     generate,
+    speculative_generate,
     next_token_dataset,
     quantize_lm,
     transformer_lm,
@@ -60,5 +61,6 @@ __all__ = [
     "sequence_parallel_transformer_forward",
     "MoETransformerClassifier", "moe_transformer_classifier",
     "TransformerLM", "transformer_lm", "generate", "beam_search",
+    "speculative_generate",
     "next_token_dataset", "quantize_lm",
 ]

@@ -212,11 +212,15 @@ class DecoderBlock(nn.Module):
         for sliding-window models — then the cache is a RING: position
         ``p`` lives in slot ``p % window`` (decode reads ``window``, not
         ``maxlen``, keys per step — the bandwidth the window promises)."""
+        cache_len = k_cache.shape[1]
+        if cache_len >= self.maxlen:
+            # the non-ring step IS the T=1 multi-token pass; one shared
+            # body keeps cached decode and the speculative verify forward
+            # (extend) from ever drifting apart
+            return self.extend(x_t, k_cache, v_cache, pos)
         q, k, v = self._project_qkv(x_t)  # q [B,1,H,Dh]; k/v [B,1,Hkv,Dh]
         q, k = self._rope_qk(q, k, pos)   # cache holds pre-rotated keys
-        cache_len = k_cache.shape[1]
-        ring = cache_len < self.maxlen
-        slot = pos % cache_len if ring else pos
+        slot = pos % cache_len
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k.astype(k_cache.dtype), (0, slot, 0, 0)
         )
@@ -236,15 +240,10 @@ class DecoderBlock(nn.Module):
         s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_cache) \
             .astype(jnp.float32) * (dh ** -0.5)
         kp = jnp.arange(cache_len)
-        if ring:
-            # slot s holds absolute position pos - ((pos - s) % window),
-            # automatically causal and in-band; only never-written slots
-            # (absolute < 0, early decode) need masking
-            valid = pos - ((pos - kp) % cache_len) >= 0
-        else:
-            valid = kp <= pos                        # causal: cache ≤ pos
-            if self.attn_window is not None:
-                valid &= pos - kp < self.attn_window  # sliding-window band
+        # slot s holds absolute position pos - ((pos - s) % window),
+        # automatically causal and in-band; only never-written slots
+        # (absolute < 0, early decode) need masking
+        valid = pos - ((pos - kp) % cache_len) >= 0
         s = jnp.where(valid[None, None, None, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         att = jnp.einsum(
@@ -253,6 +252,53 @@ class DecoderBlock(nn.Module):
         att = att.reshape(B, 1, self.dim)
         x_t = x_t + self.attn_out(att.astype(self.dtype)).astype(jnp.float32)
         return self._mlp(x_t), k_cache, v_cache
+
+    def extend(self, x, k_cache, v_cache, pos0):
+        """``T`` consecutive decode positions in one pass: ``x`` [B, T, dim]
+        residual stream occupying absolute positions ``pos0 .. pos0+T-1``
+        (``pos0`` may be a traced scalar). Cache entries for those positions
+        are written and each query attends causally to every cached position
+        ≤ its own — the multi-token sibling of :meth:`step`, and speculative
+        decoding's verify forward (T candidate tokens scored against the
+        cache in one batched matmul instead of T sequential steps). Ring
+        (sliding-window) caches are not supported — a wrapped
+        ``dynamic_update_slice`` cannot write a contiguous span."""
+        B, T, _ = x.shape
+        cache_len = k_cache.shape[1]
+        if cache_len < self.maxlen:
+            raise ValueError(
+                "extend() needs a full-length cache; sliding-window models "
+                "use a ring cache that cannot take a contiguous span write"
+            )
+        q, k, v = self._project_qkv(x)
+        q, k = self._rope_qk(q, k, pos0)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, pos0, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, pos0, 0, 0)
+        )
+        dh = self.dim // self.heads
+        hkv = self._hkv
+        group = self.heads // hkv
+        # same dtype/GQA discipline as step(): q·k in model dtype, softmax
+        # f32, p·v in model dtype; the [H] axis factors as [Hkv, group]
+        qg = q.reshape(B, T, hkv, group, dh)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_cache) \
+            .astype(jnp.float32) * (dh ** -0.5)
+        kp = jnp.arange(cache_len)[None, :]
+        qp = pos0 + jnp.arange(T)[:, None]
+        valid = kp <= qp                          # causal: cache ≤ own pos
+        if self.attn_window is not None:
+            valid &= qp - kp < self.attn_window
+        s = jnp.where(valid[None, None, None, :, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        att = jnp.einsum(
+            "bhgqk,bkhd->bqhgd", p.astype(v_cache.dtype), v_cache
+        )
+        att = att.reshape(B, T, self.dim)
+        x = x + self.attn_out(att.astype(self.dtype)).astype(jnp.float32)
+        return self._mlp(x), k_cache, v_cache
 
 
 class TransformerLM(nn.Module):
@@ -410,6 +456,19 @@ class TransformerLM(nn.Module):
             new_caches.append((kc, vc))
         return self._logits(x)[:, 0], tuple(new_caches)
 
+    def extend(self, tokens, caches, pos0):
+        """Multi-token cached decode: ``tokens`` [B, T] occupying absolute
+        positions ``pos0 .. pos0+T-1`` → ``(logits [B, T, vocab], updated
+        caches)``; ``logits[:, t]`` predicts position ``pos0+t+1``.
+        Speculative decoding's verify forward — T candidate tokens scored
+        against the cache at one batched pass's cost."""
+        x = self._embed_at(tokens, pos0)
+        new_caches = []
+        for blk, (kc, vc) in zip(self.blocks, caches):
+            x, kc, vc = blk.extend(x, kc, vc, pos0)
+            new_caches.append((kc, vc))
+        return self._logits(x), tuple(new_caches)
+
 
 def _check_decode_args(fn_name: str, model, prompt, max_new_tokens: int):
     """Shared validation for generate()/beam_search(): returns
@@ -530,6 +589,155 @@ def generate(model, params, prompt, max_new_tokens: int, *,
         None if top_p is None else float(top_p),
     )
     return np.asarray(run(params, prompt, jax.random.PRNGKey(seed)))
+
+
+@functools.lru_cache(maxsize=32)
+def _speculative_program(target: TransformerLM, draft: TransformerLM,
+                         max_new_tokens: int, spec_tokens: int):
+    """One jitted speculative-decode program per (target, draft, config)."""
+    K = spec_tokens
+
+    def run(t_params, d_params, prompt):
+        B, lp = prompt.shape
+        cap = max_new_tokens + K + 1  # emission block may overhang the tail
+
+        t_logits, t_caches = target.apply(
+            {"params": t_params}, prompt, method=TransformerLM.prefill
+        )
+        _, d_caches = draft.apply(
+            {"params": d_params}, prompt, method=TransformerLM.prefill
+        )
+        tok0 = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+        out = jnp.zeros((B, cap), jnp.int32)
+        out = jax.lax.dynamic_update_slice(out, tok0[:, None], (0, 0))
+
+        def cond(carry):
+            return carry[1] < max_new_tokens
+
+        def body(carry):
+            out, n, last, t_caches, d_caches, rounds, accepted = carry
+            cur = lp + n - 1  # absolute position of `last`; not yet cached
+
+            def draft_step(c, i):
+                tok, caches = c
+                logits, caches = draft.apply(
+                    {"params": d_params}, tok, caches, cur + i,
+                    method=TransformerLM.decode_step,
+                )
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (nxt, caches), nxt
+
+            (_, d_caches), props = jax.lax.scan(
+                draft_step, (last, d_caches), jnp.arange(K)
+            )
+            props = props.T  # [B, K]: proposals for positions cur+1..cur+K
+
+            # verify: one cached forward over [last, props…]; logits[:, t]
+            # is the target's prediction for position cur+t+1
+            block = jnp.concatenate([last[:, None], props], axis=1)
+            t_logits, t_caches = target.apply(
+                {"params": t_params}, block, t_caches, cur,
+                method=TransformerLM.extend,
+            )
+            g = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+
+            # accepted prefix per row, then lockstep on the batch minimum:
+            # every row's first `a` proposals equal its own greedy tokens,
+            # so emitting props[:, :a] + g[:, a] is exact for every row —
+            # uniform positions keep the cache writes dynamic_update_slice
+            match = (props == g[:, :K]).astype(jnp.int32)
+            a = jnp.min(jnp.sum(jnp.cumprod(match, axis=1), axis=1))
+
+            cols = jnp.arange(K + 1)[None, :]
+            emit = jnp.where(
+                cols == a, g,
+                jnp.concatenate(
+                    [props, jnp.zeros((B, 1), jnp.int32)], axis=1
+                ),
+            )  # [B, K+1]: props below a, the correction g[:, a] at a,
+            #    garbage above (overwritten by the next round or trimmed)
+            out = jax.lax.dynamic_update_slice(out, emit, (0, n))
+            last = jnp.take_along_axis(
+                g, jnp.full((B, 1), a, jnp.int32), axis=1
+            )[:, 0]
+            return (out, n + a + 1, last, t_caches, d_caches,
+                    rounds + 1, accepted + a)
+
+        out, _, _, _, _, rounds, accepted = jax.lax.while_loop(
+            cond,
+            body,
+            (out, jnp.asarray(1, jnp.int32), tok0, t_caches, d_caches,
+             jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32)),
+        )
+        full = jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
+        return full, rounds, accepted
+
+    return jax.jit(run)
+
+
+def speculative_generate(target, target_params, draft, draft_params, prompt,
+                         max_new_tokens: int, *, spec_tokens: int = 4):
+    """Greedy speculative decoding (Leviathan et al. 2023): a cheap
+    ``draft`` model proposes ``spec_tokens`` tokens autoregressively; the
+    ``target`` model scores all of them in ONE cached forward
+    (:meth:`TransformerLM.extend`) and keeps the longest matching prefix
+    plus its own correction token. Output is **exactly** the target's
+    greedy :func:`generate` stream — the draft changes the number of
+    target passes (latency), never the tokens.
+
+    Returns ``(tokens [B, Lp+new] int32, stats)`` where ``stats`` reports
+    ``rounds`` (target verify passes), ``proposed``/``accepted`` draft
+    tokens and the ``acceptance`` rate. With a well-matched draft the
+    target runs ~``(accepted/rounds + 1)`` positions per pass instead
+    of 1 — the decode-latency lever when the target is bandwidth-bound.
+
+    Batched prompts are supported lockstep: each round advances every row
+    by the batch-minimum accepted length (still exact for every row).
+    TPU shape discipline throughout: one jitted program, a
+    ``lax.while_loop`` over rounds, static ``[B, K+1]`` verify blocks.
+    Sliding-window (``attn_window``) models are not supported — their
+    ring caches cannot take the verify block's contiguous span write.
+    """
+    tm, prompt = _check_decode_args(
+        "speculative_generate", target, prompt, max_new_tokens
+    )
+    dm = draft.module if isinstance(draft, ModelSpec) else draft
+    if not isinstance(dm, TransformerLM):
+        raise TypeError(
+            f"speculative_generate() needs a TransformerLM draft (or its "
+            f"ModelSpec), got {type(dm)}"
+        )
+    if dm.vocab != tm.vocab:
+        raise ValueError(
+            f"draft vocab {dm.vocab} != target vocab {tm.vocab}"
+        )
+    if tm.attn_window is not None or dm.attn_window is not None:
+        raise ValueError(
+            "speculative_generate does not support sliding-window models "
+            "(ring caches cannot take the verify block's span write)"
+        )
+    K = int(spec_tokens)
+    if K < 1:
+        raise ValueError(f"spec_tokens must be >= 1, got {spec_tokens}")
+    need = prompt.shape[1] + int(max_new_tokens) + K - 1
+    for name, m in (("target", tm), ("draft", dm)):
+        if need > m.maxlen:
+            raise ValueError(
+                f"prompt {prompt.shape[1]} + max_new_tokens "
+                f"{max_new_tokens} + spec_tokens {K} - 1 = {need} exceeds "
+                f"the {name}'s maxlen {m.maxlen} (the verify block probes "
+                f"spec_tokens positions past the emitted stream)"
+            )
+    run = _speculative_program(tm, dm, int(max_new_tokens), K)
+    toks, rounds, accepted = run(target_params, draft_params, prompt)
+    rounds, accepted = int(rounds), int(accepted)
+    stats = {
+        "rounds": rounds,
+        "proposed": rounds * K,
+        "accepted": accepted,
+        "acceptance": accepted / (rounds * K) if rounds else 0.0,
+    }
+    return np.asarray(toks), stats
 
 
 @functools.lru_cache(maxsize=64)

@@ -484,6 +484,137 @@ def test_rope_requires_even_head_dim():
         spec.init_np(0)
 
 
+def test_extend_matches_sequential_decode_steps(lm):
+    """The multi-token cached forward (speculative decoding's verify pass)
+    equals the same positions decoded one step at a time — logits and the
+    caches it leaves behind."""
+    spec, params = lm
+    module = spec.module
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, VOCAB, size=(3, 11)).astype(np.int32)
+    lp, T = 4, 5
+
+    _, caches = module.apply(
+        {"params": params}, toks[:, :lp], method=TransformerLM.prefill
+    )
+    ext_logits, ext_caches = module.apply(
+        {"params": params}, toks[:, lp : lp + T], caches, lp,
+        method=TransformerLM.extend,
+    )
+    step_caches = caches
+    step_logits = []
+    for pos in range(lp, lp + T):
+        lg, step_caches = module.apply(
+            {"params": params}, toks[:, pos], step_caches, pos,
+            method=TransformerLM.decode_step,
+        )
+        step_logits.append(np.asarray(lg))
+    np.testing.assert_allclose(
+        np.asarray(ext_logits), np.stack(step_logits, axis=1),
+        rtol=2e-4, atol=2e-4,
+    )
+    for (ka, va), (kb, vb) in zip(ext_caches, step_caches):
+        np.testing.assert_allclose(np.asarray(ka), np.asarray(kb),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(va), np.asarray(vb),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_speculative_matches_greedy_any_draft(lm):
+    """Speculative output is EXACTLY the target's greedy stream no matter
+    how bad the draft is — an unrelated random draft only costs rounds."""
+    from distkeras_tpu.models import speculative_generate
+
+    spec, params = lm
+    draft = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=16, heads=2,
+                           depth=1, dtype=jnp.float32)
+    dparams, _ = draft.init_np(99)
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, VOCAB, size=(3, 6)).astype(np.int32)
+
+    greedy = generate(spec, params, prompt, max_new_tokens=9)
+    out, stats = speculative_generate(
+        spec, params, draft, dparams, prompt, 9, spec_tokens=3
+    )
+    np.testing.assert_array_equal(out, greedy)
+    assert stats["rounds"] >= 1
+    assert stats["proposed"] == 3 * stats["rounds"]
+    assert 0 <= stats["accepted"] <= stats["proposed"]
+    assert 0.0 <= stats["acceptance"] <= 1.0
+
+
+def test_speculative_self_draft_accepts_everything(lm):
+    """With draft == target every proposal is accepted: K+1 tokens per
+    verify pass, so rounds collapse ~(K+1)x vs one-at-a-time decode."""
+    from distkeras_tpu.models import speculative_generate
+
+    spec, params = lm
+    prompt = np.ones((2, 5), np.int32)
+    new, K = 12, 3
+    greedy = generate(spec, params, prompt, max_new_tokens=new)
+    out, stats = speculative_generate(
+        spec, params, spec, params, prompt, new, spec_tokens=K
+    )
+    np.testing.assert_array_equal(out, greedy)
+    assert stats["accepted"] == stats["proposed"]
+    assert stats["acceptance"] == 1.0
+    # 1 prefill token + rounds * (K+1) emissions must cover `new`
+    assert stats["rounds"] == -(-(new - 1) // (K + 1))
+
+
+def test_speculative_composes_with_gqa_and_rope():
+    """The verify forward rides the same block machinery as decode — GQA
+    cache layouts and RoPE offsets included."""
+    from distkeras_tpu.models import speculative_generate
+
+    spec = transformer_lm(vocab=32, maxlen=48, dim=32, heads=4, depth=2,
+                          kv_heads=2, pos_embedding="rope",
+                          dtype=jnp.float32)
+    params, _ = spec.init_np(3)
+    draft = transformer_lm(vocab=32, maxlen=48, dim=16, heads=2, depth=1,
+                           kv_heads=1, pos_embedding="rope",
+                           dtype=jnp.float32)
+    dparams, _ = draft.init_np(4)
+    prompt = np.arange(10, dtype=np.int32).reshape(2, 5) % 32
+
+    greedy = generate(spec, params, prompt, max_new_tokens=8)
+    out, _ = speculative_generate(
+        spec, params, draft, dparams, prompt, 8, spec_tokens=4
+    )
+    np.testing.assert_array_equal(out, greedy)
+
+
+def test_speculative_validates_inputs(lm):
+    from distkeras_tpu.models import speculative_generate
+
+    spec, params = lm
+    prompt = np.zeros((1, 4), np.int32)
+    other_vocab = transformer_lm(vocab=VOCAB * 2, maxlen=MAXLEN, dim=16,
+                                 heads=2, depth=1, dtype=jnp.float32)
+    ov_params, _ = other_vocab.init_np(0)
+    with pytest.raises(ValueError, match="vocab"):
+        speculative_generate(spec, params, other_vocab, ov_params,
+                             prompt, 4)
+    windowed = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=16, heads=2,
+                              depth=1, attn_window=8, dtype=jnp.float32)
+    w_params, _ = windowed.init_np(0)
+    with pytest.raises(ValueError, match="sliding-window"):
+        speculative_generate(windowed, w_params, windowed, w_params,
+                             prompt, 4)
+    with pytest.raises(ValueError, match="spec_tokens"):
+        speculative_generate(spec, params, spec, params, prompt, 4,
+                             spec_tokens=0)
+    with pytest.raises(ValueError, match="maxlen"):
+        # fits generate()'s bound but not the verify probe's headroom
+        speculative_generate(spec, params, spec, params,
+                             np.zeros((1, MAXLEN - 6), np.int32), 6,
+                             spec_tokens=4)
+    with pytest.raises(TypeError, match="draft"):
+        from distkeras_tpu.models import mlp
+
+        speculative_generate(spec, params, mlp(), params, prompt, 4)
+
+
 def test_ring_cache_shape_and_long_wraparound():
     """Sliding-window LM decode uses a RING cache of length window (not
     maxlen), and stays equal to the full windowed forward far past the
