@@ -492,19 +492,22 @@ def _check_decode_args(fn_name: str, model, prompt, max_new_tokens: int):
     return module, prompt
 
 
-def _sample_fn(temperature: float, top_k: int | None,
-               top_p: float | None = None):
-    """Greedy for temperature==0, else temperature/top-k/top-p categorical.
+def _warp_fn(temperature: float, top_k: int | None,
+             top_p: float | None = None):
+    """Logit-warping for sampling: temperature scale, then top-k, then
+    nucleus (top-p) truncation. Returns warped logits (filtered tokens at
+    -1e30); ``softmax(warped)`` is the distribution every sampling path —
+    plain :func:`generate` and speculative verify alike — draws from.
 
-    Filters compose in the conventional order: top-k first, then nucleus
-    (top-p) over the surviving distribution — smallest prefix of
-    descending-probability tokens whose mass reaches ``top_p`` (the top-1
-    token always survives)."""
+    Tie behavior at the nucleus boundary: every token whose warped logit
+    EQUALS the cutoff survives (strict ``scaled < cutoff`` filter), so with
+    exactly-tied logits the kept support can exceed the minimal nucleus by
+    the tied tokens — the conventional choice (matches the common HF
+    implementation), and the one that keeps the filter permutation-
+    invariant. Requires temperature > 0."""
 
-    def sample(logits, key):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        scaled = logits / temperature
+    def warp(logits):
+        scaled = logits.astype(jnp.float32) / temperature
         if top_k is not None:
             kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
             scaled = jnp.where(scaled < kth, -1e30, scaled)
@@ -518,7 +521,30 @@ def _sample_fn(temperature: float, top_k: int | None,
                 jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True
             )
             scaled = jnp.where(scaled < cutoff, -1e30, scaled)
-        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+        return scaled
+
+    return warp
+
+
+def _sample_fn(temperature: float, top_k: int | None,
+               top_p: float | None = None):
+    """Greedy for temperature==0, else temperature/top-k/top-p categorical.
+
+    Filters compose in the conventional order: top-k first, then nucleus
+    (top-p) over the surviving distribution — smallest prefix of
+    descending-probability tokens whose mass reaches ``top_p`` (the top-1
+    token always survives; see :func:`_warp_fn` for tie behavior)."""
+    if temperature == 0.0:
+        def sample(logits, key):
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        return sample
+    warp = _warp_fn(temperature, top_k, top_p)
+
+    def sample(logits, key):
+        return jax.random.categorical(
+            key, warp(logits), axis=-1
+        ).astype(jnp.int32)
 
     return sample
 
@@ -615,7 +641,8 @@ def _speculative_program(target: TransformerLM, draft: TransformerLM,
             return carry[1] < max_new_tokens
 
         def body(carry):
-            out, n, last, t_caches, d_caches, rounds, accepted = carry
+            (out, n, last, t_caches, d_caches, rounds, accepted,
+             proposed) = carry
             cur = lp + n - 1  # absolute position of `last`; not yet cached
 
             def draft_step(c, i):
@@ -660,39 +687,225 @@ def _speculative_program(target: TransformerLM, draft: TransformerLM,
             last = jnp.take_along_axis(
                 g, jnp.full((B, 1), a, jnp.int32), axis=1
             )[:, 0]
-            return (out, n + a + 1, last, t_caches, d_caches,
-                    rounds + 1, accepted + a)
+            # stats clamp to the emission budget: the final round's block
+            # may overhang max_new_tokens; proposals (and accepts) beyond
+            # the budget never land in `out`, so they don't count
+            room = max_new_tokens - n
+            return (out, n + a + 1, last, t_caches, d_caches, rounds + 1,
+                    accepted + jnp.minimum(a, room),
+                    proposed + jnp.minimum(K, room))
 
-        out, _, _, _, _, rounds, accepted = jax.lax.while_loop(
+        out, _, _, _, _, rounds, accepted, proposed = jax.lax.while_loop(
             cond,
             body,
             (out, jnp.asarray(1, jnp.int32), tok0, t_caches, d_caches,
-             jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32)),
+             jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+             jnp.asarray(0, jnp.int32)),
         )
         full = jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
-        return full, rounds, accepted
+        return full, rounds, accepted, proposed
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=32)
+def _speculative_sampled_program(target: TransformerLM,
+                                 draft: TransformerLM,
+                                 max_new_tokens: int, spec_tokens: int,
+                                 temperature: float, top_k: int | None,
+                                 top_p: float | None):
+    """Sampled speculative decoding (Leviathan et al. 2023, §3): the draft
+    SAMPLES K proposals from its warped distribution q; each proposal x_i is
+    accepted with probability min(1, p(x_i)/q(x_i)) against the target's
+    warped distribution p, and the first rejection is replaced by a sample
+    from the residual norm(max(p − q, 0)). Per position the emitted token is
+    then distributed EXACTLY as p — acceptance only moves latency, never the
+    distribution. Both p and q are warped identically (temperature/top-k/
+    top-p), so the preserved distribution is the one plain
+    :func:`generate` samples from.
+
+    Lockstep batching: each round every row advances by the batch-minimum
+    accepted length ``a``. All rows accepted their first ``a`` proposals, so
+    positions 0..a-1 emit proposals; at the cut position each row emits its
+    own scheme token — its accepted proposal if it accepted position ``a``,
+    else its residual resample (and a fresh p-sample at a == K, where no
+    proposal exists). Dropped later proposals were never emitted, so the
+    per-row output stream stays exactly p-distributed."""
+    K = spec_tokens
+    warp = _warp_fn(temperature, top_k, top_p)
+
+    def run(t_params, d_params, prompt, key):
+        B, lp = prompt.shape
+        cap = max_new_tokens + K + 1
+
+        t_logits, t_caches = target.apply(
+            {"params": t_params}, prompt, method=TransformerLM.prefill
+        )
+        _, d_caches = draft.apply(
+            {"params": d_params}, prompt, method=TransformerLM.prefill
+        )
+        key, k0 = jax.random.split(key)
+        tok0 = jax.random.categorical(
+            k0, warp(t_logits[:, -1]), axis=-1
+        ).astype(jnp.int32)
+        out = jnp.zeros((B, cap), jnp.int32)
+        out = jax.lax.dynamic_update_slice(out, tok0[:, None], (0, 0))
+
+        def cond(carry):
+            return carry[1] < max_new_tokens
+
+        def body(carry):
+            (out, n, last, t_caches, d_caches, rounds, accepted,
+             proposed) = carry
+            cur = lp + n - 1
+            kd, ka, kc = jax.random.split(
+                jax.random.fold_in(key, rounds), 3
+            )
+
+            def draft_step(c, i):
+                tok, caches = c
+                logits, caches = draft.apply(
+                    {"params": d_params}, tok, caches, cur + i,
+                    method=TransformerLM.decode_step,
+                )
+                wl = warp(logits)                          # [B, V] f32
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(kd, i), wl, axis=-1
+                ).astype(jnp.int32)
+                return (nxt, caches), (nxt, jax.nn.log_softmax(wl, -1))
+
+            (_, d_caches), (props, q_lp) = jax.lax.scan(
+                draft_step, (last, d_caches), jnp.arange(K)
+            )
+            props = props.T                    # [B, K]
+            q_lp = jnp.swapaxes(q_lp, 0, 1)    # [B, K, V]
+
+            block = jnp.concatenate([last[:, None], props], axis=1)
+            t_logits, t_caches = target.apply(
+                {"params": t_params}, block, t_caches, cur,
+                method=TransformerLM.extend,
+            )
+            p_lp = jax.nn.log_softmax(warp(t_logits), -1)  # [B, K+1, V]
+
+            # accept x_i iff log u < log p(x_i) − log q(x_i)
+            idx = props[..., None]
+            p_at = jnp.take_along_axis(p_lp[:, :K], idx, axis=-1)[..., 0]
+            q_at = jnp.take_along_axis(q_lp, idx, axis=-1)[..., 0]
+            log_u = jnp.log(jax.random.uniform(
+                ka, (B, K), jnp.float32, minval=1e-37
+            ))
+            accept = (log_u < p_at - q_at).astype(jnp.int32)   # [B, K]
+            a_row = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)
+            a = jnp.min(a_row)
+
+            # cut-position token per row (position cur+a+1):
+            #  • a == K: no proposal exists — fresh sample from p_K
+            #  • row accepted position a: its proposed token stands
+            #  • row rejected position a: residual resample from
+            #    norm(max(p − q, 0)) (zero-mass guard: if p ≤ q everywhere
+            #    the rejection had probability 0; fall back to p)
+            a_k = jnp.minimum(a, K - 1)
+            ga = jnp.full((B, 1, 1), a_k, jnp.int32)
+            p_cut = jnp.take_along_axis(
+                p_lp, jnp.broadcast_to(ga, (B, 1, p_lp.shape[-1])), axis=1
+            )[:, 0]                                             # [B, V]
+            q_cut = jnp.take_along_axis(
+                q_lp, jnp.broadcast_to(ga, (B, 1, q_lp.shape[-1])), axis=1
+            )[:, 0]
+            residual = jnp.maximum(jnp.exp(p_cut) - jnp.exp(q_cut), 0.0)
+            has_mass = jnp.sum(residual, -1, keepdims=True) > 0
+            res_logits = jnp.where(
+                has_mass,
+                jnp.where(residual > 0, jnp.log(residual), -jnp.inf),
+                p_cut,
+            )
+            kc1, kc2 = jax.random.split(kc)
+            res_tok = jax.random.categorical(
+                kc1, res_logits, axis=-1
+            ).astype(jnp.int32)
+            p_k_tok = jax.random.categorical(
+                kc2, p_lp[:, K], axis=-1
+            ).astype(jnp.int32)
+            accept_at_a = jnp.take_along_axis(
+                accept, jnp.full((B, 1), a_k, jnp.int32), axis=1
+            )[:, 0].astype(bool)
+            prop_at_a = jnp.take_along_axis(
+                props, jnp.full((B, 1), a_k, jnp.int32), axis=1
+            )[:, 0]
+            cut_tok = jnp.where(
+                a == K, p_k_tok,
+                jnp.where(accept_at_a, prop_at_a, res_tok),
+            )
+
+            cols = jnp.arange(K + 1)[None, :]
+            emit = jnp.where(
+                cols == a, cut_tok[:, None],
+                jnp.concatenate(
+                    [props, jnp.zeros((B, 1), jnp.int32)], axis=1
+                ),
+            )
+            out = jax.lax.dynamic_update_slice(out, emit, (0, n))
+            room = max_new_tokens - n
+            return (out, n + a + 1, cut_tok, t_caches, d_caches,
+                    rounds + 1,
+                    accepted + jnp.minimum(a, room),
+                    proposed + jnp.minimum(K, room))
+
+        out, _, _, _, _, rounds, accepted, proposed = jax.lax.while_loop(
+            cond,
+            body,
+            (out, jnp.asarray(1, jnp.int32), tok0, t_caches, d_caches,
+             jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+             jnp.asarray(0, jnp.int32)),
+        )
+        full = jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
+        return full, rounds, accepted, proposed
 
     return jax.jit(run)
 
 
 def speculative_generate(target, target_params, draft, draft_params, prompt,
-                         max_new_tokens: int, *, spec_tokens: int = 4):
-    """Greedy speculative decoding (Leviathan et al. 2023): a cheap
-    ``draft`` model proposes ``spec_tokens`` tokens autoregressively; the
-    ``target`` model scores all of them in ONE cached forward
-    (:meth:`TransformerLM.extend`) and keeps the longest matching prefix
-    plus its own correction token. Output is **exactly** the target's
-    greedy :func:`generate` stream — the draft changes the number of
-    target passes (latency), never the tokens.
+                         max_new_tokens: int, *, spec_tokens: int = 4,
+                         temperature: float = 0.0, top_k: int | None = None,
+                         top_p: float | None = None, seed: int = 0):
+    """Speculative decoding (Leviathan et al. 2023): a cheap ``draft``
+    model proposes ``spec_tokens`` tokens autoregressively; the ``target``
+    model scores all of them in ONE cached forward
+    (:meth:`TransformerLM.extend`) and keeps an accepted prefix plus a
+    correction token.
+
+    ``temperature=0`` (default) is the greedy scheme: proposals are kept
+    while they match the target's own argmax, and the output is **exactly**
+    the target's greedy :func:`generate` stream — the draft changes the
+    number of target passes (latency), never the tokens. (Exactness rides
+    on both paths sharing ONE attention/cache body — ``decode_step`` and
+    ``extend`` route through the same block code — so the verify block's
+    logits are the same program XLA compiles for plain decode; bf16
+    near-ties under a different reduction schedule would otherwise be a
+    hazard. The test suite and the bench assert stream equality in-run.)
+
+    ``temperature>0`` is the paper's rejection-sampling scheme: the draft
+    SAMPLES each proposal from its warped distribution ``q``; proposal
+    ``x`` is accepted with probability ``min(1, p(x)/q(x))`` against the
+    target's warped distribution ``p``, and the first rejection is
+    replaced by a sample from ``norm(max(p − q, 0))``. Each emitted token
+    is then distributed EXACTLY as ``p`` — the same distribution plain
+    ``generate(..., temperature, top_k, top_p)`` samples from (the
+    warps compose identically) — while the draft only moves latency.
+    Deterministic for a fixed ``seed``.
 
     Returns ``(tokens [B, Lp+new] int32, stats)`` where ``stats`` reports
     ``rounds`` (target verify passes), ``proposed``/``accepted`` draft
-    tokens and the ``acceptance`` rate. With a well-matched draft the
-    target runs ~``(accepted/rounds + 1)`` positions per pass instead
-    of 1 — the decode-latency lever when the target is bandwidth-bound.
+    tokens and the ``acceptance`` rate (final-round proposals that overhang
+    ``max_new_tokens`` are excluded from both counts). With a well-matched
+    draft the target runs ~``(accepted/rounds + 1)`` positions per pass
+    instead of 1 — the decode-latency lever when the target is
+    bandwidth-bound.
 
     Batched prompts are supported lockstep: each round advances every row
-    by the batch-minimum accepted length (still exact for every row).
+    by the batch-minimum accepted length (still exact for every row: at
+    the cut position each row emits its own accepted proposal / residual
+    resample, and discarded later proposals were never emitted).
     TPU shape discipline throughout: one jitted program, a
     ``lax.while_loop`` over rounds, static ``[B, K+1]`` verify blocks.
     Sliding-window (``attn_window``) models are not supported — their
@@ -728,14 +941,33 @@ def speculative_generate(target, target_params, draft, draft_params, prompt,
                 f"the {name}'s maxlen {m.maxlen} (the verify block probes "
                 f"spec_tokens positions past the emitted stream)"
             )
-    run = _speculative_program(tm, dm, int(max_new_tokens), K)
-    toks, rounds, accepted = run(target_params, draft_params, prompt)
-    rounds, accepted = int(rounds), int(accepted)
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k is not None and not 1 <= int(top_k) <= tm.vocab:
+        raise ValueError(
+            f"top_k must be in [1, vocab={tm.vocab}], got {top_k}"
+        )
+    if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if temperature == 0.0:
+        run = _speculative_program(tm, dm, int(max_new_tokens), K)
+        toks, rounds, accepted, proposed = run(
+            target_params, draft_params, prompt
+        )
+    else:
+        run = _speculative_sampled_program(
+            tm, dm, int(max_new_tokens), K, float(temperature), top_k,
+            None if top_p is None else float(top_p),
+        )
+        toks, rounds, accepted, proposed = run(
+            target_params, draft_params, prompt, jax.random.PRNGKey(seed)
+        )
+    rounds, accepted, proposed = int(rounds), int(accepted), int(proposed)
     stats = {
         "rounds": rounds,
-        "proposed": rounds * K,
+        "proposed": proposed,
         "accepted": accepted,
-        "acceptance": accepted / (rounds * K) if rounds else 0.0,
+        "acceptance": accepted / proposed if proposed else 0.0,
     }
     return np.asarray(toks), stats
 

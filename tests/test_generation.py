@@ -25,39 +25,85 @@ def lm():
     return spec, params
 
 
+def _assert_cached_decode_matches_full(module, params, toks, lp, *,
+                                       check_prefill_logits=True,
+                                       rtol=2e-4, atol=2e-4):
+    """Prefill on ``toks[:, :lp]`` + jitted cached decode over the rest must
+    match ONE full forward over the whole sequence, position by position:
+    causal attention makes ``full[:, pos]`` the prediction after consuming
+    exactly ``toks[:, :pos+1]`` (causality of the full forward itself is
+    pinned in test_decode_step_matches_full_forward). Returns the final
+    caches."""
+    full = np.asarray(module.apply({"params": params}, toks))
+    logits_pre, caches = module.apply(
+        {"params": params}, toks[:, :lp], method=TransformerLM.prefill
+    )
+    if check_prefill_logits:
+        np.testing.assert_allclose(
+            np.asarray(logits_pre), full[:, :lp], rtol=rtol, atol=atol
+        )
+    step = jax.jit(
+        lambda tok, caches, pos: module.apply(
+            {"params": params}, tok, caches, pos,
+            method=TransformerLM.decode_step,
+        )
+    )
+    for pos in range(lp, toks.shape[1]):
+        step_logits, caches = step(toks[:, pos], caches, pos)
+        np.testing.assert_allclose(
+            np.asarray(step_logits), full[:, pos],
+            rtol=rtol, atol=atol, err_msg=f"pos={pos}",
+        )
+    return caches
+
+
 def test_decode_step_matches_full_forward(lm):
     """Prefill + N cached decode steps == full forward logits, position by
-    position (f32, exact math path)."""
+    position (f32, exact math path).
+
+    The oracle is ONE full forward over the whole sequence: causal
+    attention makes ``full[:, pos]`` the model's prediction after
+    consuming exactly ``toks[:, :pos+1]`` — verified directly below by a
+    prefix re-run — so every decode position checks against it without
+    re-running a growing-prefix forward per step."""
     spec, params = lm
     module = spec.module
     rng = np.random.default_rng(0)
     toks = rng.integers(0, VOCAB, size=(3, 12)).astype(np.int32)
 
+    full = np.asarray(module.apply({"params": params}, toks))
+    # causality of the oracle itself: a prefix re-run reproduces its rows
     lp = 5
+    prefix = module.apply({"params": params}, toks[:, :lp])
+    np.testing.assert_allclose(np.asarray(prefix), full[:, :lp],
+                               rtol=2e-4, atol=2e-4)
+
     logits_pre, caches = module.apply(
         {"params": params}, toks[:, :lp], method=TransformerLM.prefill
     )
-    # full-forward oracle on each prefix
-    for pos in range(lp, toks.shape[1]):
-        step_logits, caches = module.apply(
-            {"params": params}, toks[:, pos], caches, pos,
+    np.testing.assert_allclose(
+        np.asarray(logits_pre), full[:, :lp], rtol=2e-4, atol=2e-4
+    )
+    step = jax.jit(
+        lambda tok, caches, pos: module.apply(
+            {"params": params}, tok, caches, pos,
             method=TransformerLM.decode_step,
         )
-        full = module.apply({"params": params}, toks[:, : pos + 1])
+    )
+    for pos in range(lp, toks.shape[1]):
+        step_logits, caches = step(toks[:, pos], caches, pos)
         np.testing.assert_allclose(
-            np.asarray(step_logits), np.asarray(full[:, -1]),
+            np.asarray(step_logits), full[:, pos],
             rtol=2e-4, atol=2e-4,
         )
-    # prefill's own logits match the full forward too
-    full = module.apply({"params": params}, toks[:, :lp])
-    np.testing.assert_allclose(
-        np.asarray(logits_pre), np.asarray(full), rtol=2e-4, atol=2e-4
-    )
 
 
 def test_greedy_generation_matches_uncached_argmax(lm):
-    """generate(temperature=0) equals the naive loop that re-runs the full
-    forward and argmaxes — the cache changes cost, not output."""
+    """generate(temperature=0) equals the uncached greedy stream — the
+    cache changes cost, not output. Greedy self-consistency needs one
+    full forward on the emitted sequence: token t+1 must be the argmax of
+    the full model's logits at position t given the emitted prefix (the
+    causal forward's row t sees exactly that prefix)."""
     spec, params = lm
     module = spec.module
     rng = np.random.default_rng(1)
@@ -66,12 +112,9 @@ def test_greedy_generation_matches_uncached_argmax(lm):
     assert out.shape == (2, 14)
     assert np.array_equal(out[:, :6], prompt)
 
-    seq = jnp.asarray(prompt)
-    for _ in range(8):
-        logits = module.apply({"params": params}, seq)
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
-    np.testing.assert_array_equal(out, np.asarray(seq))
+    full = np.asarray(module.apply({"params": params}, jnp.asarray(out)))
+    want = np.argmax(full[:, 5:-1], axis=-1)
+    np.testing.assert_array_equal(out[:, 6:], want)
 
 
 def test_sampled_generation_reproducible_and_valid(lm):
@@ -261,27 +304,9 @@ def test_windowed_lm_decode_matches_full_forward():
     spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS,
                           depth=DEPTH, dtype=jnp.float32, attn_window=6)
     params, _ = spec.init_np(0)
-    module = spec.module
     rng = np.random.default_rng(1)
     toks = rng.integers(0, VOCAB, size=(2, 14)).astype(np.int32)
-
-    lp = 4
-    logits_pre, caches = module.apply(
-        {"params": params}, toks[:, :lp], method=TransformerLM.prefill
-    )
-    full = module.apply({"params": params}, toks[:, :lp])
-    np.testing.assert_allclose(np.asarray(logits_pre), np.asarray(full),
-                               rtol=2e-4, atol=2e-4)
-    for pos in range(lp, toks.shape[1]):
-        step_logits, caches = module.apply(
-            {"params": params}, toks[:, pos], caches, pos,
-            method=TransformerLM.decode_step,
-        )
-        full = module.apply({"params": params}, toks[:, : pos + 1])
-        np.testing.assert_allclose(
-            np.asarray(step_logits), np.asarray(full[:, -1]),
-            rtol=2e-4, atol=2e-4, err_msg=f"pos={pos}",
-        )
+    _assert_cached_decode_matches_full(spec.module, params, toks, lp=4)
 
 
 def test_windowed_lm_generates(lm):
@@ -340,25 +365,12 @@ def test_gqa_decode_matches_full_forward():
     rng = np.random.default_rng(3)
     toks = rng.integers(0, VOCAB, size=(2, 12)).astype(np.int32)
 
-    lp = 4
-    logits_pre, caches = module.apply(
-        {"params": params}, toks[:, :lp], method=TransformerLM.prefill
+    _, caches = module.apply(
+        {"params": params}, toks[:, :4], method=TransformerLM.prefill
     )
     kc, vc = caches[0]
     assert kc.shape == (2, MAXLEN, 2, DIM // HEADS)  # Hkv-wide cache
-    full = module.apply({"params": params}, toks[:, :lp])
-    np.testing.assert_allclose(np.asarray(logits_pre), np.asarray(full),
-                               rtol=2e-4, atol=2e-4)
-    for pos in range(lp, toks.shape[1]):
-        step_logits, caches = module.apply(
-            {"params": params}, toks[:, pos], caches, pos,
-            method=TransformerLM.decode_step,
-        )
-        full = module.apply({"params": params}, toks[:, : pos + 1])
-        np.testing.assert_allclose(
-            np.asarray(step_logits), np.asarray(full[:, -1]),
-            rtol=2e-4, atol=2e-4, err_msg=f"pos={pos}",
-        )
+    _assert_cached_decode_matches_full(module, params, toks, lp=4)
 
 
 def test_mqa_trains_and_generates():
@@ -402,26 +414,9 @@ def test_rope_decode_matches_full_forward():
                           depth=DEPTH, dtype=jnp.float32,
                           pos_embedding="rope")
     params, _ = spec.init_np(0)
-    module = spec.module
     rng = np.random.default_rng(4)
     toks = rng.integers(0, VOCAB, size=(2, 12)).astype(np.int32)
-    lp = 4
-    logits_pre, caches = module.apply(
-        {"params": params}, toks[:, :lp], method=TransformerLM.prefill
-    )
-    full = module.apply({"params": params}, toks[:, :lp])
-    np.testing.assert_allclose(np.asarray(logits_pre), np.asarray(full),
-                               rtol=2e-4, atol=2e-4)
-    for pos in range(lp, toks.shape[1]):
-        step_logits, caches = module.apply(
-            {"params": params}, toks[:, pos], caches, pos,
-            method=TransformerLM.decode_step,
-        )
-        full = module.apply({"params": params}, toks[:, : pos + 1])
-        np.testing.assert_allclose(
-            np.asarray(step_logits), np.asarray(full[:, -1]),
-            rtol=2e-4, atol=2e-4, err_msg=f"pos={pos}",
-        )
+    _assert_cached_decode_matches_full(spec.module, params, toks, lp=4)
 
 
 def test_rope_is_relative():
@@ -538,7 +533,9 @@ def test_speculative_matches_greedy_any_draft(lm):
     )
     np.testing.assert_array_equal(out, greedy)
     assert stats["rounds"] >= 1
-    assert stats["proposed"] == 3 * stats["rounds"]
+    # proposals are clamped to the emission budget: the final round may
+    # overhang max_new_tokens, and those proposals don't count
+    assert 0 < stats["proposed"] <= 3 * stats["rounds"]
     assert 0 <= stats["accepted"] <= stats["proposed"]
     assert 0.0 <= stats["acceptance"] <= 1.0
 
@@ -582,6 +579,171 @@ def test_speculative_composes_with_gqa_and_rope():
         spec, params, draft, dparams, prompt, 8, spec_tokens=4
     )
     np.testing.assert_array_equal(out, greedy)
+
+
+def test_speculative_stats_clamped_to_budget(lm):
+    """The final verify round's proposals that overhang max_new_tokens are
+    excluded from proposed/accepted, so a perfect draft still reports
+    acceptance == 1.0 (not >1 or a deflated proposed count)."""
+    from distkeras_tpu.models import speculative_generate
+
+    spec, params = lm
+    prompt = np.ones((2, 5), np.int32)
+    # new=9, K=4: with self-draft every round emits K+1=5, so the second
+    # round overhangs (n=6, room=3) and only 3 of its 4 proposals count
+    out, stats = speculative_generate(
+        spec, params, spec, params, prompt, 9, spec_tokens=4
+    )
+    np.testing.assert_array_equal(
+        out, generate(spec, params, prompt, max_new_tokens=9)
+    )
+    assert stats["rounds"] == 2
+    assert stats["proposed"] == 7           # 4 + min(4, room=3)
+    assert stats["accepted"] == 7
+    assert stats["acceptance"] == 1.0
+
+
+def test_speculative_sampled_reproducible_and_valid(lm):
+    """temperature>0 speculative decoding: same seed → same stream, tokens
+    in-vocab, stats well-formed."""
+    from distkeras_tpu.models import speculative_generate
+
+    spec, params = lm
+    draft = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=16, heads=2,
+                           depth=1, dtype=jnp.float32)
+    dparams, _ = draft.init_np(99)
+    prompt = np.ones((3, 5), np.int32)
+    a, sa = speculative_generate(spec, params, draft, dparams, prompt, 8,
+                                 spec_tokens=3, temperature=1.0, seed=5)
+    b, _ = speculative_generate(spec, params, draft, dparams, prompt, 8,
+                                spec_tokens=3, temperature=1.0, seed=5)
+    c, _ = speculative_generate(spec, params, draft, dparams, prompt, 8,
+                                spec_tokens=3, temperature=1.0, seed=6)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    assert a.shape == (3, 13) and a.min() >= 0 and a.max() < VOCAB
+    assert np.array_equal(a[:, :5], prompt)
+    assert 0 <= sa["accepted"] <= sa["proposed"] <= 3 * sa["rounds"]
+
+
+def test_speculative_sampled_topk1_degenerates_to_greedy(lm):
+    """top_k=1 makes both warped distributions one-hot: any-temperature
+    sampled speculation must emit exactly the target's greedy stream."""
+    from distkeras_tpu.models import speculative_generate
+
+    spec, params = lm
+    draft = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=16, heads=2,
+                           depth=1, dtype=jnp.float32)
+    dparams, _ = draft.init_np(7)
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, VOCAB, size=(2, 6)).astype(np.int32)
+    greedy = generate(spec, params, prompt, max_new_tokens=9)
+    out, _ = speculative_generate(spec, params, draft, dparams, prompt, 9,
+                                  spec_tokens=3, temperature=2.0, top_k=1,
+                                  seed=11)
+    np.testing.assert_array_equal(out, greedy)
+
+
+def test_speculative_sampled_self_draft_accepts_everything(lm):
+    """draft == target ⇒ p == q at every position ⇒ min(1, p/q) == 1:
+    acceptance is ~1.0. (Not asserted exact: q comes from decode_step and
+    p from the extend verify pass — different XLA programs whose logits
+    differ at f32 epsilon, and a top-k/top-p warp can flip a boundary
+    token between the two truncated supports. Pure-temperature warps keep
+    the ratio within e^±ε, so acceptance stays at 1.0 up to measure-zero
+    draws; truncation makes the rare boundary rejection possible.)"""
+    from distkeras_tpu.models import speculative_generate
+
+    spec, params = lm
+    prompt = np.ones((2, 5), np.int32)
+    out, stats = speculative_generate(
+        spec, params, spec, params, prompt, 12, spec_tokens=3,
+        temperature=1.3, seed=2,
+    )
+    assert stats["acceptance"] >= 0.95
+    assert out.shape == (2, 17) and out.max() < VOCAB
+    # with the truncating warps, boundary flips may reject a token or two
+    out2, stats2 = speculative_generate(
+        spec, params, spec, params, prompt, 12, spec_tokens=3,
+        temperature=1.3, top_k=8, top_p=0.9, seed=2,
+    )
+    assert stats2["acceptance"] >= 0.8
+    assert out2.shape == (2, 17) and out2.max() < VOCAB
+
+
+def test_speculative_sampled_preserves_target_distribution():
+    """The Leviathan guarantee, measured: the token histogram of sampled
+    speculative decoding matches plain sampled generate() on the same
+    target (both draw from the identically-warped p). Aggregated over
+    seeds × rows × positions; total-variation tolerance sized ~3× the
+    expected sampling fluctuation at this n."""
+    from distkeras_tpu.models import speculative_generate
+
+    V = 16
+    spec = transformer_lm(vocab=V, maxlen=16, dim=16, heads=2, depth=1,
+                          dtype=jnp.float32)
+    params, _ = spec.init_np(0)
+    draft = transformer_lm(vocab=V, maxlen=16, dim=8, heads=2, depth=1,
+                           dtype=jnp.float32)
+    dparams, _ = draft.init_np(1)
+    B, new, seeds = 64, 6, 12
+    prompt = np.zeros((B, 2), np.int32)
+
+    h_plain = np.zeros(V)
+    h_spec = np.zeros(V)
+    for s in range(seeds):
+        g = generate(spec, params, prompt, new, temperature=1.5,
+                     seed=1000 + s)
+        h_plain += np.bincount(g[:, 2:].ravel(), minlength=V)
+        o, _ = speculative_generate(spec, params, draft, dparams, prompt,
+                                    new, spec_tokens=3, temperature=1.5,
+                                    seed=2000 + s)
+        h_spec += np.bincount(o[:, 2:].ravel(), minlength=V)
+    n = h_plain.sum()
+    assert n == h_spec.sum() == B * new * seeds
+    tv = 0.5 * np.abs(h_plain / n - h_spec / n).sum()
+    # expected TV between two empirical draws of p at n≈4600, V=16 is
+    # ~0.02; 0.08 is a 3-4σ gate that still catches a wrong distribution
+    # (e.g. greedy-biased acceptance shifts TV to ~0.3)
+    assert tv < 0.08, f"token distributions diverge: TV={tv:.3f}"
+
+
+def test_speculative_sampled_composes_with_gqa_rope_topk_topp():
+    """Sampled verify rides the same block machinery: GQA caches, RoPE
+    offsets, and the top-k/top-p warp all compose."""
+    from distkeras_tpu.models import speculative_generate
+
+    spec = transformer_lm(vocab=32, maxlen=48, dim=32, heads=4, depth=2,
+                          kv_heads=2, pos_embedding="rope",
+                          dtype=jnp.float32)
+    params, _ = spec.init_np(3)
+    draft = transformer_lm(vocab=32, maxlen=48, dim=16, heads=2, depth=1,
+                           kv_heads=1, pos_embedding="rope",
+                           dtype=jnp.float32)
+    dparams, _ = draft.init_np(4)
+    prompt = np.arange(10, dtype=np.int32).reshape(2, 5) % 32
+    out, stats = speculative_generate(
+        spec, params, draft, dparams, prompt, 8, spec_tokens=4,
+        temperature=0.8, top_k=12, top_p=0.95, seed=1,
+    )
+    assert out.shape == (2, 13) and out.max() < 32
+    assert 0.0 <= stats["acceptance"] <= 1.0
+
+
+def test_speculative_sampled_validates_inputs(lm):
+    from distkeras_tpu.models import speculative_generate
+
+    spec, params = lm
+    prompt = np.zeros((1, 4), np.int32)
+    with pytest.raises(ValueError, match="temperature"):
+        speculative_generate(spec, params, spec, params, prompt, 4,
+                             temperature=-1.0)
+    with pytest.raises(ValueError, match="top_k"):
+        speculative_generate(spec, params, spec, params, prompt, 4,
+                             temperature=1.0, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        speculative_generate(spec, params, spec, params, prompt, 4,
+                             temperature=1.0, top_p=1.5)
 
 
 def test_speculative_validates_inputs(lm):
@@ -628,22 +790,13 @@ def test_ring_cache_shape_and_long_wraparound():
     rng = np.random.default_rng(6)
     toks = rng.integers(0, VOCAB, size=(2, 28)).astype(np.int32)
 
-    lp = 3
-    logits_pre, caches = module.apply(
-        {"params": params}, toks[:, :lp], method=TransformerLM.prefill
+    _, caches = module.apply(
+        {"params": params}, toks[:, :3], method=TransformerLM.prefill
     )
     kc, vc = caches[0]
     assert kc.shape == (2, W, 2, DIM // HEADS)   # ring: window, not maxlen
-    for pos in range(lp, toks.shape[1]):          # 25 steps = 5 full wraps
-        step_logits, caches = module.apply(
-            {"params": params}, toks[:, pos], caches, pos,
-            method=TransformerLM.decode_step,
-        )
-        full = module.apply({"params": params}, toks[:, : pos + 1])
-        np.testing.assert_allclose(
-            np.asarray(step_logits), np.asarray(full[:, -1]),
-            rtol=2e-4, atol=2e-4, err_msg=f"pos={pos}",
-        )
+    # 25 steps = 5 full wraps
+    _assert_cached_decode_matches_full(module, params, toks, lp=3)
 
 
 def test_ring_cache_prompt_longer_than_window():
@@ -653,23 +806,12 @@ def test_ring_cache_prompt_longer_than_window():
     spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS,
                           depth=1, dtype=jnp.float32, attn_window=W)
     params, _ = spec.init_np(0)
-    module = spec.module
     rng = np.random.default_rng(7)
     toks = rng.integers(0, VOCAB, size=(2, 16)).astype(np.int32)
-    lp = 11                                       # prompt >> window
-    _, caches = module.apply(
-        {"params": params}, toks[:, :lp], method=TransformerLM.prefill
-    )
-    for pos in range(lp, toks.shape[1]):
-        step_logits, caches = module.apply(
-            {"params": params}, toks[:, pos], caches, pos,
-            method=TransformerLM.decode_step,
-        )
-        full = module.apply({"params": params}, toks[:, : pos + 1])
-        np.testing.assert_allclose(
-            np.asarray(step_logits), np.asarray(full[:, -1]),
-            rtol=2e-4, atol=2e-4, err_msg=f"pos={pos}",
-        )
+    # prompt (11) >> window (4); skip the prefill-logits check — it's the
+    # ring seeding + continued decode under test here
+    _assert_cached_decode_matches_full(spec.module, params, toks, lp=11,
+                                       check_prefill_logits=False)
 
 
 # -- beam search --------------------------------------------------------------
