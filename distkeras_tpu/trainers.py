@@ -218,6 +218,21 @@ def _ema_tracking(center_like, decay, use_resident):
     return use_resident, ema, ema_step
 
 
+def _drain(x):
+    """Synchronize for TIMING: host-fetch a compute-dependent value.
+
+    ``jax.block_until_ready`` alone can return one dispatch early through a
+    device tunnel (measured in this environment: the first post-warm epoch
+    reads ~0.1 ms while its compute is still in flight — the source of the
+    physically impossible round-4 bench record). A host transfer of any
+    program output only completes when the dispatch has actually drained,
+    so per-epoch metrics stay honest at the cost of one small round trip
+    (~5 ms) per epoch — only on the ``log_metrics`` paths.
+    """
+    jax.block_until_ready(x)
+    jax.tree.map(np.asarray, x)
+
+
 def _profile_trace_ctx(profile_dir):
     """``jax.profiler.trace`` context for a training run (or a no-op).
 
@@ -524,7 +539,7 @@ class DistributedTrainer(Trainer):
                  device_data: bool | None = None,
                  ps_transport: str = "inprocess", ps_port: int = 0,
                  ps_host: str | None = None, worker_id_offset: int = 0,
-                 compression=None,
+                 compression=None, pull_compression: str | None = None,
                  checkpoint_dir=None, checkpoint_every: int = 1,
                  resume: bool = False, checkpoint_async: bool = False,
                  profile_dir=None,
@@ -602,6 +617,24 @@ class DistributedTrainer(Trainer):
                     "use 'socket' for other codecs"
                 )
         self.compression = compression
+        # Lossy PULL compression (the other wire direction): int8 block/
+        # leaf quantization of the center with SERVER-side per-worker error
+        # feedback (DoubleSqueeze-style bidirectional compression) — the
+        # stream of decoded pulls telescopes to the true center stream.
+        # With compression='int8' too, the PS round-trip moves ~2/8 of the
+        # uncompressed bytes. Default None = exact f32 pulls.
+        if pull_compression is not None:
+            from distkeras_tpu.parallel.compression import (
+                validate_pull_compression,
+            )
+
+            validate_pull_compression(pull_compression)
+            if backend != "ps":
+                raise ValueError(
+                    "pull_compression applies to backend='ps' only "
+                    "(collective merges ride ICI psums, not a wire)"
+                )
+        self.pull_compression = pull_compression
         # device_data=True stages each epoch in HBM and scans all windows in
         # one dispatch; None = auto (on when the epoch fits the budget).
         # NOTE on shuffle semantics: with shuffle=False the two paths are
@@ -782,7 +815,7 @@ class DistributedTrainer(Trainer):
                 # unless metrics are being streamed
                 self.history.append(losses=losses, epoch=epoch)
                 if self.log_metrics:
-                    jax.block_until_ready(losses)
+                    _drain(losses)
                     self._epoch_metrics(
                         epoch, epoch_rows, n_windows, time.perf_counter() - t0
                     )
@@ -815,7 +848,7 @@ class DistributedTrainer(Trainer):
                     self.history.append(loss=loss, epoch=epoch)
                     n_windows += 1
                 if self.log_metrics and n_windows:
-                    jax.block_until_ready(loss)
+                    _drain(loss)
                     self._epoch_metrics(
                         epoch, n_windows * win_rows, n_windows,
                         time.perf_counter() - t0,
@@ -1390,9 +1423,10 @@ class MeshTrainer(Trainer):
                     )
                     self.history.append(losses=losses, epoch=epoch)
                     if self.log_metrics:
-                        # block on params too: loss scalars can stream back
-                        # before the epoch's update compute drains
-                        jax.block_until_ready((params, losses))
+                        # params too: loss scalars can stream back before
+                        # the epoch's update compute drains
+                        jax.block_until_ready(params)
+                        _drain(losses)
                         self._epoch_metrics(
                             epoch, rows, rows // self.batch_size,
                             time.perf_counter() - t0,
@@ -1419,7 +1453,7 @@ class MeshTrainer(Trainer):
                         self.history.append(loss=loss, epoch=epoch)
                         n_steps += 1
                     if self.log_metrics and n_steps:
-                        jax.block_until_ready(loss)
+                        _drain(loss)
                         self._epoch_metrics(
                             epoch, n_steps * self.batch_size, n_steps,
                             time.perf_counter() - t0,

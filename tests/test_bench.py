@@ -26,7 +26,7 @@ def test_measure_runs_tiny_mlp_on_cpu():
         mlp(input_shape=(28,), hidden=(16,), num_classes=2, dtype=jnp.float32),
         ADAGMerge(), optax.sgd(0.01), train, ["features", "label"],
         batch_size=32, window=2, epochs_timed=1,
-    )
+    )[0]
     assert sps > 0 and np.isfinite(sps)
 
 
@@ -45,7 +45,7 @@ def test_measure_stacked_workers_on_one_device():
         mlp(input_shape=(28,), hidden=(16,), num_classes=2, dtype=jnp.float32),
         ADAGMerge(), optax.sgd(0.01), train, ["features", "label"],
         batch_size=32, window=2, num_workers=4, epochs_timed=1,
-    )
+    )[0]
     assert sps > 0
 
 
