@@ -673,7 +673,8 @@ def _speculative_program(target: TransformerLM, draft: TransformerLM,
             # so emitting props[:, :a] + g[:, a] is exact for every row —
             # uniform positions keep the cache writes dynamic_update_slice
             match = (props == g[:, :K]).astype(jnp.int32)
-            a = jnp.min(jnp.sum(jnp.cumprod(match, axis=1), axis=1))
+            a_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B]
+            a = jnp.min(a_row)
 
             cols = jnp.arange(K + 1)[None, :]
             emit = jnp.where(
@@ -689,11 +690,14 @@ def _speculative_program(target: TransformerLM, draft: TransformerLM,
             )[:, 0]
             # stats clamp to the emission budget: the final round's block
             # may overhang max_new_tokens; proposals (and accepts) beyond
-            # the budget never land in `out`, so they don't count
+            # the budget never land in `out`, so they don't count.
+            # PER-ROW sums (ADVICE r4): acceptance reports mean draft/
+            # target agreement across rows, not the batch-min lockstep
+            # advancement (which `rounds` captures).
             room = max_new_tokens - n
             return (out, n + a + 1, last, t_caches, d_caches, rounds + 1,
-                    accepted + jnp.minimum(a, room),
-                    proposed + jnp.minimum(K, room))
+                    accepted + jnp.sum(jnp.minimum(a_row, room)),
+                    proposed + B * jnp.minimum(K, room))
 
         out, _, _, _, _, rounds, accepted, proposed = jax.lax.while_loop(
             cond,
@@ -845,11 +849,13 @@ def _speculative_sampled_program(target: TransformerLM,
                 ),
             )
             out = jax.lax.dynamic_update_slice(out, emit, (0, n))
+            # per-row stat sums, clamped to the emission budget (see the
+            # greedy program): acceptance is mean per-row agreement
             room = max_new_tokens - n
             return (out, n + a + 1, cut_tok, t_caches, d_caches,
                     rounds + 1,
-                    accepted + jnp.minimum(a, room),
-                    proposed + jnp.minimum(K, room))
+                    accepted + jnp.sum(jnp.minimum(a_row, room)),
+                    proposed + B * jnp.minimum(K, room))
 
         out, _, _, _, _, rounds, accepted, proposed = jax.lax.while_loop(
             cond,
@@ -896,11 +902,14 @@ def speculative_generate(target, target_params, draft, draft_params, prompt,
 
     Returns ``(tokens [B, Lp+new] int32, stats)`` where ``stats`` reports
     ``rounds`` (target verify passes), ``proposed``/``accepted`` draft
-    tokens and the ``acceptance`` rate (final-round proposals that overhang
-    ``max_new_tokens`` are excluded from both counts). With a well-matched
-    draft the target runs ~``(accepted/rounds + 1)`` positions per pass
-    instead of 1 — the decode-latency lever when the target is
-    bandwidth-bound.
+    tokens SUMMED PER ROW (final-round proposals that overhang
+    ``max_new_tokens`` are excluded from both counts), and the
+    ``acceptance`` rate — the mean per-row draft/target agreement.
+    Latency is governed separately by the batch-minimum lockstep: every
+    row advances ``~max_new_tokens/rounds`` positions per verify pass, so
+    per-pass progress can trail ``acceptance·K`` when one slow row drags
+    the batch — ``rounds`` is the latency stat, ``acceptance`` the
+    draft-quality stat.
 
     Batched prompts are supported lockstep: each round advances every row
     by the batch-minimum accepted length (still exact for every row: at
