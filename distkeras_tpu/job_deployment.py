@@ -11,9 +11,11 @@ cluster over SSH (SURVEY.md §3.5). The TPU-pod equivalent has two parts:
   backend works unchanged — replica placement needs no scheduler at all.
 - :class:`Job` — host-fan-out helper: renders the per-host launch commands
   (``ssh host python script.py`` with coordinator env) from a
-  :class:`Punchcard` manifest, and can execute them via a pluggable runner.
-  With no SSH available (this build environment has zero egress) the default
-  runner just returns the commands; operators or tests inject their own.
+  :class:`Punchcard` manifest, and can execute them via a pluggable runner:
+  :class:`LocalRunner` (localhost subprocesses — the CI path),
+  :class:`SSHRunner` (one ssh client per host — the reference's remote
+  submission transport; injectable for tests), or any custom callable.
+  With no runner the commands are just returned.
 """
 
 from __future__ import annotations
@@ -126,38 +128,30 @@ class Job:
         return self.commands
 
 
-class LocalRunner:
-    """Execute rendered commands as local subprocesses — the single-host
-    fan-out (and the CI stand-in for an SSH runner): every host in the
-    Punchcard maps to one local process, which is exactly how a multi-process
-    `jax.distributed` CPU/GPU cluster is brought up on one machine.
-    End-to-end launch is pinned by tests/test_aux.py (2-process cluster,
-    cross-process allgather).
-    """
+class _SubprocessRunner:
+    """Shared wait/poll/capture machinery for runners that launch real
+    subprocesses (:class:`LocalRunner` locally, :class:`SSHRunner` through
+    an ``ssh`` client process per host)."""
 
     def __init__(self):
         self.procs: list = []
 
-    def validate(self, host: str) -> None:
-        """Called by :meth:`Job.run` for every host before any launch."""
-        if host not in ("localhost", "127.0.0.1"):
-            raise ValueError(
-                f"LocalRunner only launches on localhost, got {host!r}; "
-                f"use an SSH runner for remote hosts"
-            )
-
-    def __call__(self, host: str, command: str) -> None:
-        self.validate(host)
+    def _launch(self, argv_or_cmd, shell: bool) -> None:
         # temp files, not pipes: cluster processes block on each other at
         # collectives, so a sequential pipe drain could deadlock against a
         # full pipe buffer. New session so a timeout can kill the whole
         # process GROUP (the `sh -c` shell plus anything it spawned).
         out = tempfile.TemporaryFile(mode="w+")
         err = tempfile.TemporaryFile(mode="w+")
-        p = subprocess.Popen(command, shell=True, stdout=out, stderr=err,
-                             text=True, start_new_session=True)
+        p = subprocess.Popen(argv_or_cmd, shell=shell, stdout=out,
+                             stderr=err, text=True, start_new_session=True)
         p._out_file, p._err_file = out, err
         self.procs.append(p)
+
+    def poll(self) -> list[int | None]:
+        """Non-blocking status of every launched process (None = running) —
+        the reference Job's poll loop equivalent."""
+        return [p.poll() for p in self.procs]
 
     def wait(self, timeout: float | None = None) -> list[int]:
         """Wait for every launched process (one overall deadline, not
@@ -195,6 +189,96 @@ class LocalRunner:
                 f.seek(0)
                 setattr(p, attr, f.read())
                 f.close()
+
+
+class LocalRunner(_SubprocessRunner):
+    """Execute rendered commands as local subprocesses — the single-host
+    fan-out (and the CI stand-in for an SSH runner): every host in the
+    Punchcard maps to one local process, which is exactly how a multi-process
+    `jax.distributed` CPU/GPU cluster is brought up on one machine.
+    End-to-end launch is pinned by tests/test_aux.py (2-process cluster,
+    cross-process allgather).
+    """
+
+    def validate(self, host: str) -> None:
+        """Called by :meth:`Job.run` for every host before any launch."""
+        if host not in ("localhost", "127.0.0.1"):
+            raise ValueError(
+                f"LocalRunner only launches on localhost, got {host!r}; "
+                f"use an SSH runner for remote hosts"
+            )
+
+    def __call__(self, host: str, command: str) -> None:
+        self.validate(host)
+        self._launch(command, shell=True)
+
+
+class SSHRunner(_SubprocessRunner):
+    """Execute rendered commands on remote hosts over SSH — the transport
+    of the reference's remote submission (reference
+    ``distkeras/job_deployment.py :: Job``: SSH to the cluster head,
+    submit, poll — SURVEY.md §3.5). Each host in the Punchcard gets one
+    ``ssh host 'ENV=… python script.py …'`` client process; ``wait``/
+    ``poll`` then track the remote jobs through their ssh exit codes, and
+    each process's remote output lands in ``captured_stdout``/``stderr``.
+
+    The ssh invocation is INJECTABLE for tests and for operators with a
+    non-standard client: ``transport(argv) -> None`` receives the full
+    argv list (default: launch it as a subprocess). ``BatchMode=yes``
+    ensures a missing key fails fast instead of prompting.
+
+    NOTE: rendered against the OpenSSH CLI but untested against a real SSH
+    daemon in this build environment (zero egress); the command/env
+    rendering and fan-out ordering are pinned by unit tests with a fake
+    transport (tests/test_aux.py).
+    """
+
+    def __init__(self, user: str | None = None, port: int = 22,
+                 identity_file: str | None = None,
+                 ssh_options: Sequence[str] = (),
+                 connect_timeout: float = 10.0,
+                 transport: Callable[[list[str]], None] | None = None):
+        super().__init__()
+        self.user = user
+        self.port = int(port)
+        self.identity_file = identity_file
+        self.ssh_options = list(ssh_options)
+        self.connect_timeout = float(connect_timeout)
+        self._transport = transport
+        self.launched: list[tuple[str, list[str]]] = []
+
+    def validate(self, host: str) -> None:
+        """Called by :meth:`Job.run` for every host before any launch."""
+        if not host or host != host.strip() or " " in host:
+            raise ValueError(f"invalid ssh host {host!r}")
+        if host.startswith("-"):
+            raise ValueError(
+                f"ssh host {host!r} would be parsed as an option"
+            )
+
+    def ssh_argv(self, host: str, command: str) -> list[str]:
+        """The exact client argv for one host (also what tests assert)."""
+        argv = ["ssh", "-o", "BatchMode=yes",
+                "-o", f"ConnectTimeout={int(self.connect_timeout)}"]
+        if self.port != 22:
+            argv += ["-p", str(self.port)]
+        if self.identity_file:
+            argv += ["-i", self.identity_file]
+        argv += self.ssh_options
+        target = f"{self.user}@{host}" if self.user else host
+        # one argument: the remote shell re-parses it, exactly like the
+        # reference's ssh command string
+        argv += [target, command]
+        return argv
+
+    def __call__(self, host: str, command: str) -> None:
+        self.validate(host)
+        argv = self.ssh_argv(host, command)
+        self.launched.append((host, argv))
+        if self._transport is not None:
+            self._transport(argv)
+        else:
+            self._launch(argv, shell=False)
 
 
 def cluster_args_from_env() -> dict:

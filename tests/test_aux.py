@@ -205,6 +205,69 @@ def test_job_renders_per_host_commands():
     assert "DISTKERAS_PROCESS_ID=1" in cmd1
 
 
+def test_ssh_runner_renders_and_fans_out():
+    """SSHRunner (VERDICT r4 #6: the reference Job's remote-submission
+    seam): a 2-host punchcard fans out one ssh client argv per host, with
+    the coordinator env + script inside the single remote-command
+    argument. Fake transport — no real SSH in this environment."""
+    from distkeras_tpu.job_deployment import Job, Punchcard, SSHRunner
+
+    calls = []
+    runner = SSHRunner(user="ops", port=2222, identity_file="/k/id",
+                       ssh_options=["-o", "StrictHostKeyChecking=no"],
+                       transport=calls.append)
+    pc = Punchcard(script="train.py", hosts=["tpu-a", "tpu-b"],
+                   args=["--epochs", "3"], env={"FOO": "1"})
+    cmds = Job(pc, runner=runner).run()
+    assert len(calls) == len(cmds) == 2
+    argv0, argv1 = calls
+    assert argv0[0] == "ssh"
+    assert ["-o", "BatchMode=yes"] == argv0[1:3]
+    assert ["-p", "2222"] in (argv0[i:i + 2] for i in range(len(argv0)))
+    assert ["-i", "/k/id"] in (argv0[i:i + 2] for i in range(len(argv0)))
+    assert "StrictHostKeyChecking=no" in argv0
+    # target and remote command are the final two arguments
+    assert argv0[-2] == "ops@tpu-a" and argv1[-2] == "ops@tpu-b"
+    remote0, remote1 = argv0[-1], argv1[-1]
+    assert "DISTKERAS_COORDINATOR=tpu-a:8476" in remote0
+    assert "DISTKERAS_NUM_PROCESSES=2" in remote0
+    assert "DISTKERAS_PROCESS_ID=0" in remote0
+    assert "DISTKERAS_PROCESS_ID=1" in remote1
+    assert "FOO=1" in remote0
+    assert "train.py --epochs 3" in remote0
+    # the rendered remote command is EXACTLY what LocalRunner would run
+    assert [c for _, c in cmds] == [remote0, remote1]
+    assert runner.launched[0][0] == "tpu-a"
+
+
+def test_ssh_runner_validates_hosts_before_launch():
+    """A bad host anywhere in the list must fail BEFORE any launch (a
+    mid-launch rejection would leak cluster processes blocking in
+    jax.distributed.initialize)."""
+    import pytest
+
+    from distkeras_tpu.job_deployment import Job, Punchcard, SSHRunner
+
+    calls = []
+    runner = SSHRunner(transport=calls.append)
+    pc = Punchcard(script="t.py", hosts=["good-host", "-oProxyCommand=x"])
+    with pytest.raises(ValueError, match="option"):
+        Job(pc, runner=runner).run()
+    assert calls == []  # nothing launched
+    with pytest.raises(ValueError, match="invalid ssh host"):
+        SSHRunner(transport=calls.append).validate("bad host")
+
+
+def test_ssh_runner_default_argv_minimal():
+    """No user/port/identity → bare `ssh -o BatchMode… host cmd` (and the
+    default transport would Popen this argv; not executed here)."""
+    from distkeras_tpu.job_deployment import SSHRunner
+
+    argv = SSHRunner().ssh_argv("node1", "echo hi")
+    assert argv[0] == "ssh" and argv[-2:] == ["node1", "echo hi"]
+    assert "-p" not in argv and "-i" not in argv
+
+
 def test_punchcard_save_load(tmp_path):
     from distkeras_tpu.job_deployment import Punchcard
 
@@ -285,6 +348,7 @@ def test_trainer_elastic_resume_changes_worker_count(tmp_path):
     assert jax.tree.leaves(p)[0] is not None
 
 
+@pytest.mark.slow  # 2-process jax.distributed cluster; command-render pin stays fast
 def test_job_local_runner_launches_real_cluster(tmp_path):
     """End-to-end launch: Punchcard → Job → LocalRunner actually starts a
     2-process `jax.distributed` cluster on localhost; both processes see
