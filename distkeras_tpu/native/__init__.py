@@ -89,6 +89,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dkps_client_set_timeout_ms.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.dkps_client_pull.restype = ctypes.c_int64
     lib.dkps_client_pull.argtypes = [ctypes.c_void_p, f32p]
+    lib.dkps_client_pull_int8.restype = ctypes.c_int64
+    lib.dkps_client_pull_int8.argtypes = [ctypes.c_void_p, f32p]
     lib.dkps_client_commit.restype = ctypes.c_int
     lib.dkps_client_commit.argtypes = [ctypes.c_void_p, f32p]
     lib.dkps_client_commit_int8.restype = ctypes.c_int

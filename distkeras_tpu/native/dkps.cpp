@@ -32,8 +32,16 @@
 //              f32 scale) headers with sum(len) validated == n, then n int8
 //              bytes — the compressed-commit wire: 4x fewer payload bytes,
 //              dequantized per segment into the fold, matching
-//              parallel/compression.py's Int8Codec per-leaf scales)
-//   reply:     PULL -> u64 center_version + n*4 bytes; COMMIT -> u8 ack
+//              parallel/compression.py's Int8Codec per-leaf scales),
+//              5=PULL_INT8 (compressed-pull wire: the server block-
+//              quantizes center+error_feedback in kPullBlock runs with one
+//              f32 absmax scale per block and keeps the per-worker
+//              quantization residual server-side — DoubleSqueeze-style
+//              bidirectional compression, Tang et al. 2019; with int8
+//              commits the round-trip moves ~2n bytes instead of 8n)
+//   reply:     PULL -> u64 center_version + n*4 bytes; COMMIT -> u8 ack;
+//              PULL_INT8 -> u64 version + u32 nblocks + nblocks*f32 scales
+//              + n int8 bytes
 //
 // Concurrency model matches the reference: accept loop + one handler thread
 // per connection + one mutex around the center. The difference is what runs
@@ -43,6 +51,7 @@
 #include <algorithm>
 #include <atomic>
 #include <cerrno>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -62,6 +71,14 @@ namespace {
 constexpr char kMagic[6] = {'D', 'K', 'P', 'S', '1', '\n'};
 constexpr int MODE_FIXED = 0;
 constexpr int MODE_INV_STALENESS = 1;
+// compressed-pull quantization granularity: one f32 scale per 1024 values
+// (scale overhead 4/4096 of the int8 payload; fine enough that a block's
+// absmax never couples distant layers the way a whole-vector scale would)
+constexpr uint64_t kPullBlock = 1024;
+
+inline uint64_t pull_blocks(uint64_t n) {
+  return (n + kPullBlock - 1) / kPullBlock;
+}
 
 bool send_all(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
@@ -108,6 +125,12 @@ struct Server {
   std::mutex mu;
   uint64_t num_updates = 0;
   std::unordered_map<uint32_t, uint64_t> pull_versions;
+  // per-worker compressed-pull quantization residual (error feedback): the
+  // part of center+e the int8 wire dropped, re-added to that worker's next
+  // compressed pull so its received stream telescopes to the true center
+  // stream. Sized lazily on a worker's first PULL_INT8; exact pulls and
+  // workers that never compress cost nothing.
+  std::unordered_map<uint32_t, std::vector<float>> pull_errors;
 
   int listen_fd = -1;
   int port = 0;
@@ -147,6 +170,7 @@ struct Server {
     std::vector<int8_t> qbuf;
     std::vector<uint64_t> lens;
     std::vector<float> scales;
+    std::vector<float> pscales;  // compressed-pull per-block scales
     for (;;) {
       uint8_t action;
       if (!recv_all(fd, &action, 1)) break;
@@ -164,6 +188,46 @@ struct Server {
         }
         if (!send_all(fd, &version, 8)) break;
         if (!send_all(fd, buf.data(), n * sizeof(float))) break;
+      } else if (action == 5) {  // PULL_INT8: block-quantized center + EF
+        const uint64_t nb = pull_blocks(n);
+        if (qbuf.size() != n) qbuf.resize(n);
+        if (pscales.size() != nb) pscales.resize(nb);
+        uint64_t version;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          version = num_updates;
+          pull_versions[conn_wid_] = num_updates;  // same staleness
+          auto& err = pull_errors[conn_wid_];      // bookkeeping as PULL
+          if (err.size() != n) err.assign(n, 0.0f);
+          const float* c = center.data();
+          for (uint64_t b = 0; b < nb; ++b) {
+            const uint64_t lo = b * kPullBlock;
+            const uint64_t hi = std::min(lo + kPullBlock, n);
+            float amax = 0.0f;
+            for (uint64_t i = lo; i < hi; ++i) {
+              const float v = c[i] + err[i];
+              err[i] = v;  // stage v; residual subtracted below
+              const float a = v < 0 ? -v : v;
+              if (a > amax) amax = a;
+            }
+            const float scale = amax > 0 ? amax / 127.0f : 0.0f;
+            pscales[b] = scale;
+            const float inv = scale > 0 ? 1.0f / scale : 0.0f;
+            for (uint64_t i = lo; i < hi; ++i) {
+              const float v = err[i];
+              float qf = v * inv;
+              qf = qf < -127.0f ? -127.0f : (qf > 127.0f ? 127.0f : qf);
+              const int8_t q = static_cast<int8_t>(std::lround(qf));
+              qbuf[i] = q;
+              err[i] = v - scale * static_cast<float>(q);
+            }
+          }
+        }
+        uint32_t nb32 = static_cast<uint32_t>(nb);
+        if (!send_all(fd, &version, 8) || !send_all(fd, &nb32, 4) ||
+            !send_all(fd, pscales.data(), nb * sizeof(float)) ||
+            !send_all(fd, qbuf.data(), n))
+          break;
       } else if (action == 2) {  // COMMIT
         if (!recv_all(fd, buf.data(), n * sizeof(float))) break;
         uint8_t ack = 1;
@@ -504,6 +568,34 @@ int dkps_client_commit_int8(void* h, const int8_t* q, const uint64_t* lens,
       !send_all(c->fd, q, c->n) || !recv_all(c->fd, &ack, 1) || ack != 1)
     return -1;
   return 0;
+}
+
+// compressed pull (action 5): decodes the block-quantized reply into `out`
+// (n floats). Returns the center version (>= 0) or -1 on transport failure
+// or a malformed reply. The server holds this worker's quantization
+// residual, so repeated compressed pulls telescope to the exact center.
+int64_t dkps_client_pull_int8(void* h, float* out) {
+  auto* c = static_cast<Client*>(h);
+  uint8_t action = 5;
+  uint64_t version;
+  uint32_t nb;
+  const uint64_t expect_nb = pull_blocks(c->n);
+  if (!send_all(c->fd, &action, 1) || !recv_all(c->fd, &version, 8) ||
+      !recv_all(c->fd, &nb, 4) || nb != expect_nb)
+    return -1;
+  std::vector<float> scales(nb);
+  std::vector<int8_t> q(c->n);
+  if (!recv_all(c->fd, scales.data(), nb * sizeof(float)) ||
+      !recv_all(c->fd, q.data(), c->n))
+    return -1;
+  for (uint64_t b = 0; b < nb; ++b) {
+    const uint64_t lo = b * kPullBlock;
+    const uint64_t hi = std::min(lo + kPullBlock, c->n);
+    const float s = scales[b];
+    for (uint64_t i = lo; i < hi; ++i)
+      out[i] = s * static_cast<float>(q[i]);
+  }
+  return static_cast<int64_t>(version);
 }
 
 void dkps_client_close(void* h) {

@@ -208,9 +208,15 @@ class NativePSClient:
     ``ParameterServerClient``, GIL released for the whole round-trip."""
 
     def __init__(self, host: str, port: int, worker_id: int, spec: FlatSpec,
-                 connect_timeout: float = 30.0):
+                 connect_timeout: float = 30.0,
+                 pull_compression: str | None = None):
         import socket as _socket
 
+        from distkeras_tpu.parallel.compression import (
+            validate_pull_compression,
+        )
+
+        self.pull_compression = validate_pull_compression(pull_compression)
         self._lib = load_dkps(required=True)
         self.worker_id = int(worker_id)
         self.spec = spec
@@ -247,7 +253,16 @@ class NativePSClient:
 
     def pull(self, worker_id: int | None = None) -> Pytree:
         out = np.empty(self.spec.n, dtype=np.float32)
-        version = self._lib.dkps_client_pull(self._handle, _f32p(out))
+        if self.pull_compression == "int8":
+            # compressed-pull wire (action 5): ~n payload bytes instead of
+            # 4n; the server holds this worker's quantization residual
+            # (error feedback), so the received stream telescopes to the
+            # exact center stream — see dkps.cpp PULL_INT8
+            version = self._lib.dkps_client_pull_int8(
+                self._handle, _f32p(out)
+            )
+        else:
+            version = self._lib.dkps_client_pull(self._handle, _f32p(out))
         if version < 0:
             raise ConnectionError("dkps pull failed (server gone?)")
         return self.spec.unflatten(out)

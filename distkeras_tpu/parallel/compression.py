@@ -19,8 +19,12 @@ Codecs encode a pytree into a **wire-safe** blob: plain dicts/lists of numpy
 arrays and primitives, so it travels the existing restricted-pickle frames
 (``networking.py``) unchanged, and the PS decodes before folding
 (``ParameterServer.commit`` calls :func:`maybe_decode`). The pull direction
-stays exact: a lossily-compressed center would inject persistent error the
-worker-side feedback loop cannot see.
+compresses separately via ``pull_compression="int8"``: the SERVER holds a
+per-worker quantization residual and re-adds it to that worker's next pull
+(bidirectional error feedback — DoubleSqueeze, Tang et al. 2019), so the
+decoded-pull stream telescopes to the true center stream; worker-side
+feedback alone could not see that error, which is why the server owns it.
+Pulls default to exact f32.
 
 Select with ``compression="int8"`` / ``"topk"`` / ``TopKCodec(0.01)`` on any
 async trainer (PS backend; the collective backend's merges are XLA psums
@@ -205,6 +209,18 @@ def resolve_codec(compression) -> Codec | None:
         )
     raise TypeError(f"compression must be None, str, or Codec, "
                     f"got {type(compression)}")
+
+
+def validate_pull_compression(value):
+    """Shared validator for the ``pull_compression`` knob (trainer kwarg
+    and every PS client constructor): only the int8 block/leaf scheme has
+    a server-side error-feedback implementation today. Returns the value.
+    """
+    if value not in (None, "int8"):
+        raise ValueError(
+            f"pull_compression must be None or 'int8', got {value!r}"
+        )
+    return value
 
 
 def is_encoded(payload) -> bool:

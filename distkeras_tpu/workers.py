@@ -259,6 +259,9 @@ def run_async_training(trainer, ds, shuffle: bool):
     external_host = getattr(trainer, "ps_host", None)
     offset = int(getattr(trainer, "worker_id_offset", 0))
     codec = resolve_codec(getattr(trainer, "compression", None))
+    # clients validate the value; direct-runner callers without the
+    # trainer-constructor check still fail fast in each constructor
+    pull_comp = getattr(trainer, "pull_compression", None)
     if codec is not None and transport == "native":
         # exact type, not isinstance: the C++ fold implements the STOCK
         # Int8Codec semantics — silently swapping a subclass's custom
@@ -295,7 +298,7 @@ def run_async_training(trainer, ds, shuffle: bool):
             clients = [
                 NativePSClient(
                     external_host, int(getattr(trainer, "ps_port", 0)),
-                    offset + i, flat_spec,
+                    offset + i, flat_spec, pull_compression=pull_comp,
                 )
                 for i in range(W)
             ]
@@ -303,7 +306,7 @@ def run_async_training(trainer, ds, shuffle: bool):
             clients = [
                 ParameterServerClient(
                     external_host, int(getattr(trainer, "ps_port", 0)),
-                    offset + i,
+                    offset + i, pull_compression=pull_comp,
                 )
                 for i in range(W)
             ]
@@ -320,7 +323,9 @@ def run_async_training(trainer, ds, shuffle: bool):
         ps.initialize()
         ps.start()
         clients = [
-            NativePSClient("127.0.0.1", ps.port, i, ps.spec) for i in range(W)
+            NativePSClient("127.0.0.1", ps.port, i, ps.spec,
+                           pull_compression=pull_comp)
+            for i in range(W)
         ]
     elif transport == "socket":
         ps = SocketParameterServer(
@@ -330,13 +335,16 @@ def run_async_training(trainer, ds, shuffle: bool):
         ps.initialize()
         ps.start()
         clients = [
-            ParameterServerClient("127.0.0.1", ps.port, i) for i in range(W)
+            ParameterServerClient("127.0.0.1", ps.port, i,
+                                  pull_compression=pull_comp)
+            for i in range(W)
         ]
     elif transport == "inprocess":
         ps = ParameterServer(
             params, rule, W, ema_decay=getattr(trainer, "ema_decay", None)
         )
-        clients = [_BoundPS(ps, i) for i in range(W)]
+        clients = [_BoundPS(ps, i, pull_compression=pull_comp)
+                   for i in range(W)]
     else:
         raise ValueError(f"unknown ps_transport {transport!r}")
 
@@ -491,13 +499,29 @@ def run_async_training(trainer, ds, shuffle: bool):
 
 
 class _BoundPS:
-    """In-process client proxy: binds a worker_id to the shared PS object."""
+    """In-process client proxy: binds a worker_id to the shared PS object.
 
-    def __init__(self, ps: ParameterServer, worker_id: int):
+    ``pull_compression="int8"`` round-trips the compressed-pull encode/
+    decode even though no wire is crossed — it keeps the in-process
+    transport a faithful oracle for the socket/native ones (same
+    quantization, same server-side error feedback)."""
+
+    def __init__(self, ps: ParameterServer, worker_id: int,
+                 pull_compression: str | None = None):
+        from distkeras_tpu.parallel.compression import (
+            validate_pull_compression,
+        )
+
         self._ps = ps
         self.worker_id = worker_id
+        self.pull_compression = validate_pull_compression(pull_compression)
 
     def pull(self, worker_id: int | None = None):
+        from distkeras_tpu.parallel.compression import maybe_decode
+
+        if self.pull_compression == "int8":
+            return maybe_decode(self._ps.pull(self.worker_id,
+                                              compressed=True))
         return self._ps.pull(self.worker_id)
 
     def commit(self, worker_id: int | None, payload):
