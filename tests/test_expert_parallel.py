@@ -132,6 +132,7 @@ def test_moe_transformer_mesh_matches_reference(rng):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # MoE training integration; gradient-flow + mesh oracle stay fast
 def test_moe_transformer_trains_with_aux_loss(rng):
     from distkeras_tpu.models.moe import (
         MoETransformerClassifier,
@@ -169,6 +170,7 @@ def test_moe_transformer_trains_with_aux_loss(rng):
     assert losses[-1] < 0.6 * losses[0]
 
 
+@pytest.mark.slow  # trainer-API integration; gradient-flow + mesh oracle stay fast
 def test_moe_model_trains_through_trainer_api(rng):
     """The MoE family is a first-class citizen of the reference trainer API:
     ADAG over stacked workers vmaps the (single-device-math) MoE blocks."""

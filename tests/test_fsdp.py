@@ -9,6 +9,7 @@ weights on every worker).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import optax
 from jax.sharding import PartitionSpec as P
 
@@ -263,6 +264,7 @@ def test_grad_accum_matches_full_batch(rng):
         bad.run_step(bp, bnt, bopt, b)
 
 
+@pytest.mark.slow  # everything-at-once composition; parts pinned separately in the fast tier
 def test_kitchen_sink_composition(rng):
     """Everything at once: ZeRO-3 over dp × Megatron over tp, grad_accum=2,
     remat=True — still exactly the single-device full-batch step."""
@@ -308,6 +310,7 @@ def test_mesh_trainer_rejects_sync_bn_model():
         t.train(ds)
 
 
+@pytest.mark.slow  # fsdp x megatron variant; plain fsdp e2e stays fast
 def test_mesh_trainer_fsdp_megatron_end_to_end(rng):
     """The combined mode through the user API: ZeRO over dp × Megatron over
     tp on one 2-D mesh, training the transformer to a falling loss."""

@@ -251,6 +251,7 @@ def test_fused_ce_through_mesh_trainer_fsdp(rng):
     assert np.mean(losses[-2:]) < 0.5 * np.mean(losses[:2])
 
 
+@pytest.mark.slow  # remat+fused-ce composition; classifier remat equality pins stay fast
 def test_lm_remat_gradient_and_decode_equality(rng):
     """transformer_lm(remat=True): same params tree, same gradients, same
     decode — only the backward's memory schedule changes; composes with

@@ -50,6 +50,7 @@ def assert_learns(trainer):
     assert np.mean(losses[-4:]) < 0.6 * np.mean(losses[:4])
 
 
+@pytest.mark.slow  # trainer-level pipeline integration; stage math pinned in test_pipeline_parallel
 def test_pipeline_strategy_trainer_learns(rng):
     """dp×pp: encoder blocks as GPipe stages, driven by trainer.train only.
     The returned params are in model layout (blocks unstacked) and usable
@@ -116,6 +117,7 @@ def test_sequence_strategy_trainer_learns(rng):
     assert out.shape == (8, CLASSES)
 
 
+@pytest.mark.slow  # trainer-level EP integration; EP math pinned in test_expert_parallel
 def test_expert_strategy_trainer_learns(rng):
     """ep: GShard MoE, experts sharded over the mesh, trainer-driven; the
     expert leaves really live sharded over ep."""
@@ -256,6 +258,7 @@ def test_sequence_strategy_with_grad_accum(rng):
     assert len(losses) == 4 and np.isfinite(losses).all()
 
 
+@pytest.mark.slow  # checkpoint x pipeline composition; both pinned separately in the fast tier
 def test_pipeline_strategy_checkpoint_resume(rng, tmp_path):
     """Resume with strategy='pipeline': the engine-layout checkpoint (stages
     stacked [S, …]) restores through place_state back onto the pp axis and

@@ -29,6 +29,7 @@ def downscale(ds, factor=2):
     })
 
 
+@pytest.mark.slow  # conv-trainer integration; MLP/LSTM mesh trainings pin the engine in the fast tier
 def test_lenet_trains_on_mesh():
     train, _ = mnist(n_train=512, n_test=16)
     t = ADAG(lenet(input_shape=(14, 14, 1), dtype=jnp.float32),
@@ -267,6 +268,7 @@ def test_sync_bn_rejected_on_ps_backend():
         t.train(train)
 
 
+@pytest.mark.slow  # model-level window equality; kernel-level windowed pins stay fast
 def test_transformer_windowed_flash_equals_reference():
     """Model-level sliding window: the classifier with attn_impl='flash'
     (Pallas, interpret here) and attn_impl='reference' agree on logits and

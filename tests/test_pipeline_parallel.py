@@ -125,6 +125,7 @@ def test_stack_stage_params_roundtrip(rng):
     np.testing.assert_allclose(np.asarray(stacked["b"][2]), per_stage[2]["b"])
 
 
+@pytest.mark.slow  # forward match; the gradient oracle subsumes it in the fast tier
 def test_pipelined_transformer_matches_plain_forward(rng):
     """The full model family composition: encoder blocks over 'pp'."""
     from distkeras_tpu.models import transformer_classifier
@@ -165,6 +166,7 @@ def test_validation_errors(rng):
                        np.zeros((8, D), np.float32), mesh)
 
 
+@pytest.mark.slow  # pp x dp composition; pipeline gradient oracle stays fast
 def test_pipeline_composes_with_data_parallel(rng):
     """dp×pp on one 2-D mesh: forward equals sequential, and stage-param
     gradients of a batch-mean loss equal the single-device gradients (the
@@ -208,6 +210,7 @@ def test_pipeline_composes_with_data_parallel(rng):
                        batch_axis="dp")
 
 
+@pytest.mark.slow  # batch-axis variant; gradient oracle stays fast
 def test_pipelined_transformer_with_batch_axis(rng):
     """Model-level dp×pp: the pipelined transformer forward on a 2-D mesh."""
     from distkeras_tpu.models import transformer_classifier

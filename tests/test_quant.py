@@ -189,6 +189,7 @@ def test_quantize_serving_mlp_logits_track_fp32(rng):
     assert rel < 0.05, rel
 
 
+@pytest.mark.slow  # classifier serving integration; lm logits-tracking pin stays fast
 def test_quantize_serving_transformer_classifier(rng):
     """The interceptor reaches Dense layers created inside functional
     sublayers (named qkv/attn_out/mlp_up/mlp_down) too."""

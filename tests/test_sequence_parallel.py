@@ -57,6 +57,7 @@ def test_sequence_parallel_transformer_matches_plain_forward():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # sp trainer integration; sp forward-match pin stays fast
 def test_sequence_parallel_transformer_trains():
     """Gradients flow through the ring; one adam step reduces the loss."""
     import jax.numpy as jnp
@@ -104,6 +105,7 @@ def test_sequence_parallel_transformer_trains():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # 2-D mesh composition; sp forward/grad pins stay fast
 def test_dp_sp_composed_training_step():
     """2-D mesh: batch over 'dp' × sequence over 'sp' in ONE program; the
     train step's math equals the single-device step on the global batch."""

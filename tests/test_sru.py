@@ -41,6 +41,7 @@ def test_unknown_impl_rejected(rng):
         sru_recurrence(jnp.zeros((1, 4, 6)), impl="nope")
 
 
+@pytest.mark.slow  # impl-agreement integration; assoc-scan oracle stays fast
 def test_classifier_impls_agree_and_mask_ignores_padding(rng):
     spec_a = sru_classifier(vocab=50, maxlen=12, embed_dim=16, hidden_dim=8,
                             depth=2, dtype=jnp.float32, impl="assoc")
@@ -63,6 +64,7 @@ def test_classifier_impls_agree_and_mask_ignores_padding(rng):
                                rtol=1e-6)
 
 
+@pytest.mark.slow  # end-to-end training; assoc grads oracle stays fast
 def test_sru_trains_on_imdb_config(rng):
     """Same trainer/columns as the IMDB BASELINE config (DynSGD, padded
     tokens + mask) — the SRU must learn the synthetic sentiment task."""
