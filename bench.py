@@ -9,7 +9,7 @@ accuracy → CPU proxy → **headline JSON on stdout**, and only then the
 beyond-reference legs (transformer/LM training, decode, speculative,
 composed serving), each emitting its stderr record as it completes and
 each gated on an elapsed-time budget (``DISTKERAS_BENCH_BUDGET`` seconds,
-default 780; ``--full`` disables the gate). A harness timeout can then
+default 1500; ``--full`` disables the gate). A harness timeout can then
 only truncate extras — never the headline record.
 
 Everything except the headline goes to stderr: one JSON line per config
@@ -113,7 +113,7 @@ def peak_flops(device) -> float | None:
 
 
 def measure(device, spec, rule, optimizer, train, cols, batch_size, window,
-            num_workers=1, epochs_timed=3):
+            num_workers=1, epochs_timed=3, reduce="median"):
     from distkeras_tpu.ops.losses import sparse_softmax_cross_entropy
     from distkeras_tpu.parallel.local_sgd import LocalSGDEngine
     from distkeras_tpu.parallel.mesh import get_mesh
@@ -163,12 +163,18 @@ def measure(device, spec, rule, optimizer, train, cols, batch_size, window,
         jax.block_until_ready(state)
         epoch_losses.append(float(np.asarray(losses[-1])))  # forces drain
         per_epoch.append(epoch_rows / (time.perf_counter() - t0))
-    sps = float(np.median(per_epoch))
-    spread = ((max(per_epoch) - min(per_epoch)) / sps if sps else 0.0)
+    # reduce="max" (CPU-proxy denominator only): the fastest epoch is the
+    # least CPU-contended one, i.e. the closest to the uncontended truth —
+    # and a FASTER denominator makes vs_baseline a conservative lower
+    # bound, so contention can only understate the ratio, never inflate it
+    sps = float(max(per_epoch) if reduce == "max" else np.median(per_epoch))
+    med = float(np.median(per_epoch))
+    spread = ((max(per_epoch) - min(per_epoch)) / med if med else 0.0)
     # chained state ⇒ every epoch's final loss must differ; a bit-identical
     # pair means a dispatch was dropped/memoized and the timing is garbage
     distinct = len(set(epoch_losses)) == len(epoch_losses)
-    log(f"  {sps:,.0f} samples/sec median of {epochs_timed} epochs "
+    stat = "max" if reduce == "max" else "median"
+    log(f"  {sps:,.0f} samples/sec {stat} of {epochs_timed} epochs "
         f"(spread {100 * spread:.0f}%, {n_windows} windows × {num_workers}w, "
         f"final loss {epoch_losses[-1]:.4f})")
     if not distinct:
@@ -390,13 +396,13 @@ def run_transformer_handrolled(accel, attn_impl="flash", n_steps=20):
     step = jax.jit(step, donate_argnums=(0, 1))
     t0 = time.perf_counter()
     params, opt, nt, loss = step(params, opt, nt)
-    jax.block_until_ready(loss)
+    float(np.asarray(loss))  # host fetch: full drain (see measure())
     log(f"  [handrolled/{attn_impl}] compile+first step: "
         f"{time.perf_counter() - t0:.1f}s")
     t0 = time.perf_counter()
     for _ in range(n_steps):
         params, opt, nt, loss = step(params, opt, nt)
-    jax.block_until_ready(loss)
+    float(np.asarray(loss))
     dt = time.perf_counter() - t0
     tok_s = n_steps * B * L / dt
     log(f"  [handrolled/{attn_impl}] {tok_s:,.0f} tokens/sec "
@@ -666,6 +672,55 @@ def run_lm_decode_int8(accel):
     return out
 
 
+def _greedy_consistent(spec, params, toks, prompt_len):
+    """Tie-aware greedy check: is every emitted token argmax-of-its-context
+    within one bf16 ulp? Saturated bf16 models produce EXACT logit ties
+    (measured: a 4-way tie at 22.375 on the trained 400M cycle-language
+    model), and the multi-token verify pass (`extend`) can resolve a tie
+    one ulp differently than the single-token decode path — both streams
+    are then legitimate greedy decodes that differ bitwise. One full
+    forward over the emitted stream settles it: the emitted token's logit
+    must be within a bf16 ulp of the row max at every position."""
+    import jax.numpy as jnp
+
+    logits = spec.module.apply(
+        {"params": params}, jnp.asarray(toks[:, :-1])
+    )
+    lg = np.asarray(logits[:, prompt_len - 1:], np.float32)
+    emitted = toks[:, prompt_len:]
+    mx = lg.max(-1)
+    got = np.take_along_axis(lg, emitted[..., None], -1)[..., 0]
+    # ulp(x) for |x| in [2^e, 2^(e+1)) is 2^(e-7), so |mx|·2^-7 lies in
+    # [1, 2) true ulps at every magnitude. Measured calibration on the
+    # trained 400M model: the PLAIN GREEDY stream itself shows gaps up to
+    # exactly one true ulp (0.125 at logit ~22) against this full-forward
+    # oracle — the decode program's logits legitimately round differently
+    # — and the spec stream's gap distribution matches it (56 vs 58
+    # positions beyond 2^-8, max 0.125 both). A real emission bug on the
+    # cycle language would gap by whole units.
+    tol = np.maximum(np.abs(mx) * 2.0 ** -7, 2.0 ** -7)
+    ok = got >= mx - tol
+    return bool(np.all(ok)), int(np.sum(~ok))
+
+
+def _check_greedy_stream(name, spec, params, toks, greedy, prompt_len):
+    """Assert a speculative stream equals the plain greedy stream, falling
+    back to the tie-aware check when they differ bitwise (bf16 ties)."""
+    if np.array_equal(toks, greedy):
+        return
+    n_diff = int(np.sum(toks != greedy))
+    ok, bad = _greedy_consistent(spec, params, toks, prompt_len)
+    if not ok:
+        raise AssertionError(
+            f"{name}: {bad} emitted tokens are not argmax-within-ulp of "
+            f"their context — a real divergence, not a bf16 tie"
+        )
+    log(f"  [{name}] stream differs from plain greedy at {n_diff} "
+        f"positions but every token is argmax-within-a-bf16-ulp (logit "
+        f"ties resolve differently across the decode/verify programs; "
+        f"both streams are valid greedy decodes)")
+
+
 def run_lm_speculative_config(accel):
     """Beyond-reference leg: greedy speculative decoding (SCALING.md
     "Speculative decoding"). Target (dim 512 / depth 8) and draft
@@ -679,26 +734,31 @@ def run_lm_speculative_config(accel):
                                       speculative_generate, transformer_lm)
     from distkeras_tpu.trainers import SingleTrainer
 
+    # 2048 rows x 2 epochs: the cycle language saturates fast, so the
+    # TARGET trains in 2 epochs (the training exec is this leg's budget
+    # cost), but the tiny DRAFT gets 4 - its sampled-q quality gates the
+    # sampled-spec acceptance (1024x2 measured greedy 0.947 but sampled
+    # 0.43; the round-5 sweep at fuller training measured 0.62 at T=1.0)
     period, L, rows = 256, 128, 2048
     rng = np.random.default_rng(0)
     starts = rng.integers(0, period, size=(rows, 1))
     grid = (starts + np.arange(L + 1)[None]) % period
     ds = next_token_dataset(grid)
 
-    def trained(dim, heads, depth):
+    def trained(dim, heads, depth, epochs):
         spec = transformer_lm(vocab=period, maxlen=2048, dim=dim,
                               heads=heads, depth=depth,
                               pos_embedding="rope", attn_impl="flash",
                               dtype=jnp.bfloat16)
         tr = SingleTrainer(spec, loss="sparse_softmax_cross_entropy",
                            worker_optimizer="adam", learning_rate=3e-3,
-                           batch_size=64, num_epoch=3)
+                           batch_size=64, num_epoch=epochs)
         tr.train(ds, shuffle=True)
         return spec, jax.device_put(tr.trained_params_, accel)
 
     t0 = time.perf_counter()
-    target, tparams = trained(512, 8, 8)
-    draft, dparams = trained(128, 4, 2)
+    target, tparams = trained(512, 8, 8, 2)
+    draft, dparams = trained(128, 4, 2, 4)
     log(f"  [lm_spec] trained target+draft in {time.perf_counter()-t0:.0f}s")
 
     B, LP, NEW = 8, 64, 1024
@@ -730,10 +790,8 @@ def run_lm_speculative_config(accel):
         toks, stats = speculative_generate(
             target, tparams, draft, dparams, prompt, NEW, spec_tokens=K
         )
-        if not np.array_equal(toks, greedy):
-            raise AssertionError(
-                "speculative output diverged from the greedy stream"
-            )
+        _check_greedy_stream(f"lm_spec_k{K}", target, tparams, toks,
+                             greedy, LP)
         t_spec, ts = med3(lambda: speculative_generate(
             target, tparams, draft, dparams, prompt, NEW, spec_tokens=K
         )[0])
@@ -853,12 +911,12 @@ def run_composed_decode_config(accel):
 
     out = {}
 
-    def time_leg(name, fn, oracle=None, stats=None):
+    def time_leg(name, fn, oracle=None, oracle_model=None, stats=None):
         t0 = time.perf_counter()
         toks = fn()
         log(f"  [{name}] compile+first decode: {time.perf_counter()-t0:.1f}s")
-        if oracle is not None and not np.array_equal(toks, oracle):
-            raise AssertionError(f"{name} diverged from its greedy stream")
+        if oracle is not None:
+            _check_greedy_stream(name, *oracle_model, toks, oracle, LP)
         t, ts = med3(fn)
         rec = {
             "config": name,
@@ -887,14 +945,15 @@ def run_composed_decode_config(accel):
         "composed_400m_spec_k8",
         lambda: speculative_generate(target, tparams, draft, dparams,
                                      prompt, NEW, spec_tokens=K)[0],
-        oracle=greedy_bf16, stats=stats_s)
+        oracle=greedy_bf16, oracle_model=(target, tparams), stats=stats_s)
     _, stats_si = speculative_generate(target_q, tparams_q, draft_q,
                                        dparams_q, prompt, NEW, spec_tokens=K)
     _, rec_si = time_leg(
         "composed_400m_int8_spec_k8",
         lambda: speculative_generate(target_q, tparams_q, draft_q, dparams_q,
                                      prompt, NEW, spec_tokens=K)[0],
-        oracle=greedy_int8, stats=stats_si)
+        oracle=greedy_int8, oracle_model=(target_q, tparams_q),
+        stats=stats_si)
 
     base_tps = base["decode_tokens_per_sec"]
     summary = {
@@ -1042,9 +1101,18 @@ def run_proxy_only():
     # 2048 rows is the MINIMUM at the matched b256/w8 config (one
     # superbatch); the ~2-4 min XLA:CPU compile dominates the leg
     train, _ = mnist(n_train=2048, n_test=64)
+    # reduce="max": this subprocess shares the 1-core host with the main
+    # process's tracing bursts, which SLOW proxy epochs (measured 37%
+    # spread in a contended run vs 3% serial). The fastest of 5 epochs is
+    # the least-contended estimate, and a faster denominator can only
+    # UNDERSTATE vs_baseline — conservative by construction, so the
+    # spread gate does not apply to this leg (distinct still does).
+    # The fastest of 4 timed epochs (~136 s each): the proxy is the
+    # headline's critical path even concurrent, so every epoch counts.
     sps, spread, distinct = measure(
         cpu, lenet(dtype=jnp.float32), ADAGMerge(), optax.adam(1e-3),
-        train, ["features", "label"], batch_size=256, window=8)
+        train, ["features", "label"], batch_size=256, window=8,
+        epochs_timed=4, reduce="max")
     print(json.dumps({"proxy_samples_per_sec": sps,
                       "spread": round(spread, 3),
                       "distinct": distinct}))
@@ -1077,7 +1145,7 @@ def main():
     # remaining budget. --full disables the guard. Legs run in priority
     # order (flagship training/serving first), so a tight budget truncates
     # the least important legs, not the most.
-    budget = float(os.environ.get("DISTKERAS_BENCH_BUDGET", 1380))
+    budget = float(os.environ.get("DISTKERAS_BENCH_BUDGET", 1500))
 
     import optax
 
@@ -1148,7 +1216,11 @@ def main():
             rec = json.loads(out.strip().splitlines()[-1])
             log(f"[proxy] {rec['proxy_samples_per_sec']:.0f} samples/sec "
                 f"(spread {rec['spread']:.0%})")
-            if rec["spread"] > MAX_SPREAD or not rec.get("distinct", True):
+            # no spread gate here: the proxy reports its FASTEST epoch (see
+            # run_proxy_only — contention only slows epochs, so the ratio
+            # is a conservative lower bound); a memoized dispatch would
+            # still trip `distinct`
+            if not rec.get("distinct", True):
                 log("[proxy] INVALID timing — omitting vs_baseline")
             else:
                 vs = (ratio_leg["samples_per_sec"]
@@ -1226,11 +1298,11 @@ def _LEGS_IN_PRIORITY_ORDER(accel, results):
         ("[config 9] causal-LM training via MeshTrainer",
          lambda: results.update(run_lm_train_config(accel)), 150),
         ("[config 10] composed serving: 400M MQA + int8 + speculative",
-         lambda: results.update(run_composed_decode_config(accel)), 240),
+         lambda: results.update(run_composed_decode_config(accel)), 360),
         ("[config 7b] int8 weight-only serving @400M params",
          lambda: results.update(run_lm_decode_int8(accel)), 120),
         ("[config 8] speculative decoding (greedy-exact + sampled)",
-         lambda: results.update(run_lm_speculative_config(accel)), 260),
+         lambda: results.update(run_lm_speculative_config(accel)), 300),
         ("[config 6] transformer encoder training", config6, 180),
         ("[config 7] causal-LM KV-cached decode (MHA vs GQA vs MQA)",
          lambda: results.update(run_lm_decode_config(accel)), 120),

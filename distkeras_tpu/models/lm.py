@@ -886,9 +886,17 @@ def speculative_generate(target, target_params, draft, draft_params, prompt,
     number of target passes (latency), never the tokens. (Exactness rides
     on both paths sharing ONE attention/cache body — ``decode_step`` and
     ``extend`` route through the same block code — so the verify block's
-    logits are the same program XLA compiles for plain decode; bf16
-    near-ties under a different reduction schedule would otherwise be a
-    hazard. The test suite and the bench assert stream equality in-run.)
+    logits are the same program XLA compiles for plain decode. The
+    remaining hazard is EXACT bf16 logit ties: a saturated bf16 model can
+    emit several identically-rounded max logits (measured: a 4-way tie on
+    a 400M model trained to saturation), and the multi-token verify
+    matmul may round a tie one ulp differently than the single-token
+    step, after which the two streams are different-but-equally-valid
+    greedy decodes. The test suite asserts bitwise equality on f32
+    models, where ties have measure zero; the bench's bf16 legs fall
+    back to an argmax-within-two-ulps check when streams differ (one
+    true ulp is the measured drift of plain greedy itself against a
+    full-forward oracle).)
 
     ``temperature>0`` is the paper's rejection-sampling scheme: the draft
     SAMPLES each proposal from its warped distribution ``q``; proposal
