@@ -125,12 +125,21 @@ struct Server {
   std::mutex mu;
   uint64_t num_updates = 0;
   std::unordered_map<uint32_t, uint64_t> pull_versions;
-  // per-worker compressed-pull quantization residual (error feedback): the
+  // Per-worker compressed-pull quantization residual (error feedback): the
   // part of center+e the int8 wire dropped, re-added to that worker's next
   // compressed pull so its received stream telescopes to the true center
   // stream. Sized lazily on a worker's first PULL_INT8; exact pulls and
-  // workers that never compress cost nothing.
-  std::unordered_map<uint32_t, std::vector<float>> pull_errors;
+  // workers that never compress cost nothing. Each worker's state carries
+  // its OWN mutex: quantization runs outside the center lock so different
+  // workers' pulls overlap, but a reconnecting client reusing a worker id
+  // while the old handler is mid-quantize must serialize against it, not
+  // race on the shared residual (map nodes are reference-stable, so the
+  // struct address stays valid across other workers' insertions).
+  struct PullErr {
+    std::mutex m;
+    std::vector<float> err;
+  };
+  std::unordered_map<uint32_t, PullErr> pull_errors;
 
   int listen_fd = -1;
   int port = 0;
@@ -192,35 +201,47 @@ struct Server {
         const uint64_t nb = pull_blocks(n);
         if (qbuf.size() != n) qbuf.resize(n);
         if (pscales.size() != nb) pscales.resize(nb);
+        // Only the center SNAPSHOT needs the center mutex; quantization
+        // holds the WORKER's own mutex instead, so different workers'
+        // pulls overlap while a same-wid reconnect (old handler still
+        // mid-quantize) serializes instead of racing on the residual.
         uint64_t version;
+        PullErr* pe;
         {
           std::lock_guard<std::mutex> g(mu);
           version = num_updates;
           pull_versions[conn_wid_] = num_updates;  // same staleness
-          auto& err = pull_errors[conn_wid_];      // bookkeeping as PULL
-          if (err.size() != n) err.assign(n, 0.0f);
-          const float* c = center.data();
-          for (uint64_t b = 0; b < nb; ++b) {
-            const uint64_t lo = b * kPullBlock;
-            const uint64_t hi = std::min(lo + kPullBlock, n);
-            float amax = 0.0f;
-            for (uint64_t i = lo; i < hi; ++i) {
-              const float v = c[i] + err[i];
-              err[i] = v;  // stage v; residual subtracted below
-              const float a = v < 0 ? -v : v;
-              if (a > amax) amax = a;
-            }
-            const float scale = amax > 0 ? amax / 127.0f : 0.0f;
-            pscales[b] = scale;
-            const float inv = scale > 0 ? 1.0f / scale : 0.0f;
-            for (uint64_t i = lo; i < hi; ++i) {
-              const float v = err[i];
-              float qf = v * inv;
-              qf = qf < -127.0f ? -127.0f : (qf > 127.0f ? 127.0f : qf);
-              const int8_t q = static_cast<int8_t>(std::lround(qf));
-              qbuf[i] = q;
-              err[i] = v - scale * static_cast<float>(q);
-            }
+          pe = &pull_errors[conn_wid_];            // bookkeeping as PULL
+          std::memcpy(buf.data(), center.data(), n * sizeof(float));
+        }
+        std::lock_guard<std::mutex> wg(pe->m);
+        std::vector<float>& err = pe->err;
+        if (err.size() != n) err.assign(n, 0.0f);
+        const float* c = buf.data();
+        for (uint64_t b = 0; b < nb; ++b) {
+          const uint64_t lo = b * kPullBlock;
+          const uint64_t hi = std::min(lo + kPullBlock, n);
+          float amax = 0.0f;
+          for (uint64_t i = lo; i < hi; ++i) {
+            const float v = c[i] + err[i];
+            err[i] = v;  // stage v; residual subtracted below
+            const float a = v < 0 ? -v : v;
+            amax = a > amax ? a : amax;
+          }
+          const float scale = amax > 0 ? amax / 127.0f : 0.0f;
+          pscales[b] = scale;
+          const float inv = scale > 0 ? 1.0f / scale : 0.0f;
+          for (uint64_t i = lo; i < hi; ++i) {
+            const float v = err[i];
+            float qf = v * inv;
+            qf = qf < -127.0f ? -127.0f : (qf > 127.0f ? 127.0f : qf);
+            // branchless round-half-away (std::lround is a per-element
+            // libm call that blocks auto-vectorization; EF absorbs the
+            // half-ulp tie-rule difference vs rint)
+            qf += qf >= 0.0f ? 0.5f : -0.5f;
+            const int8_t q = static_cast<int8_t>(qf);
+            qbuf[i] = q;
+            err[i] = v - scale * static_cast<float>(q);
           }
         }
         uint32_t nb32 = static_cast<uint32_t>(nb);
