@@ -439,6 +439,7 @@ def test_rope_is_relative():
     np.testing.assert_allclose(s1, s0, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow  # rope x gqa x window training composition; each part pinned separately in the fast tier
 def test_rope_gqa_window_compose_and_train():
     """The modern-LM combo — RoPE + GQA + sliding window — trains through
     the trainer API and the cached decode continues the learned rule."""
@@ -713,6 +714,7 @@ def test_speculative_sampled_preserves_target_distribution():
     assert tv < 0.08, f"token distributions diverge: TV={tv:.3f}"
 
 
+@pytest.mark.slow  # sampled-spec x gqa x rope x warp composition; TV gate + reproducibility pins stay fast
 def test_speculative_sampled_composes_with_gqa_rope_topk_topp():
     """Sampled verify rides the same block machinery: GQA caches, RoPE
     offsets, and the top-k/top-p warp all compose."""
@@ -1006,6 +1008,7 @@ def test_tied_fused_ce_matches_unfused():
                                    rtol=5e-4, atol=1e-6)
 
 
+@pytest.mark.slow  # tied train+generate+quantize integration; tied structure/logits pin stays fast
 def test_tied_lm_trains_generates_and_quantizes():
     """End to end on the cycle language: the tied model (V·dim fewer
     params) learns, decodes the cycle, beam-decodes it, and survives int8

@@ -100,6 +100,7 @@ def test_pipeline_stage_params_stored_sharded(rng):
     )
 
 
+@pytest.mark.slow  # trainer-level sp integration; sp forward/grad math pinned in test_sequence_parallel
 def test_sequence_strategy_trainer_learns(rng):
     """dp×sp: ring attention, activations sharded along L, trainer-driven."""
     spec = small_transformer(depth=2)
@@ -144,6 +145,7 @@ def test_expert_strategy_trainer_learns(rng):
     assert out.shape == (8, CLASSES)
 
 
+@pytest.mark.slow  # ep x dp composition; EP math pinned in test_expert_parallel
 def test_expert_strategy_composes_with_dp(rng):
     """dp×ep through the trainer: batch over dp, experts over ep, one 2-D
     mesh, driven by trainer.train only."""
