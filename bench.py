@@ -874,7 +874,7 @@ def run_composed_decode_config(accel):
     grid = (starts + np.arange(L + 1)[None]) % period
     ds = next_token_dataset(grid)
 
-    def trained(name, **kw):
+    def trained(name, lr, **kw):
         # reference (XLA) attention for the short-L training pass: at
         # L=128 the flash kernels buy nothing and their fwd+bwd compiles
         # dominated this leg's wall time; decode throughput below is
@@ -883,17 +883,21 @@ def run_composed_decode_config(accel):
                               pos_embedding="rope", dtype=jnp.bfloat16,
                               **kw)
         tr = SingleTrainer(spec, loss="sparse_softmax_cross_entropy",
-                           worker_optimizer="adam", learning_rate=3e-3,
+                           worker_optimizer="adam", learning_rate=lr,
                            batch_size=64, num_epoch=2)
         t0 = time.perf_counter()
         tr.train(ds, shuffle=True)
         log(f"  [composed] trained {name} in {time.perf_counter()-t0:.0f}s")
         return spec, jax.device_put(tr.trained_params_, accel)
 
-    # ~400M params: the config 7b model, MQA cache
-    target, tparams = trained("400M target", dim=2048, heads=16, depth=8,
-                              kv_heads=1)
-    draft, dparams = trained("draft", dim=128, heads=4, depth=2)
+    # ~400M params: the config 7b model, MQA cache. lr 3e-4: the dim-512
+    # models train fine at 3e-3, but the 400M target COLLAPSES there
+    # (greedy stream oscillated instead of following the cycle, measured
+    # acceptance 0.001); at 3e-4 it follows the cycle 100% and the pair
+    # measures acceptance 0.98.
+    target, tparams = trained("400M target", 3e-4, dim=2048, heads=16,
+                              depth=8, kv_heads=1)
+    draft, dparams = trained("draft", 3e-3, dim=128, heads=4, depth=2)
     target_q, tparams_q = quantize_lm(target, tparams)
     draft_q, dparams_q = quantize_lm(draft, dparams)
 

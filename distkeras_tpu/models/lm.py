@@ -979,6 +979,13 @@ def speculative_generate(target, target_params, draft, draft_params, prompt,
         toks, rounds, accepted, proposed = run(
             target_params, draft_params, prompt, jax.random.PRNGKey(seed)
         )
+    # ONE device->host transfer for all four outputs: separate fetches cost
+    # a full device round-trip EACH (~100 ms through a tunnel-attached
+    # host — measured ~0.47 s of fixed cost per call as four fetches,
+    # which alone erased the speculative win at 400M params)
+    toks, rounds, accepted, proposed = jax.device_get(
+        (toks, rounds, accepted, proposed)
+    )
     rounds, accepted, proposed = int(rounds), int(accepted), int(proposed)
     stats = {
         "rounds": rounds,
@@ -1108,7 +1115,7 @@ def beam_search(model, params, prompt, max_new_tokens: int, *,
         module, int(max_new_tokens), int(beams), float(length_penalty),
         None if eos_id is None else int(eos_id),
     )
-    toks, scores = run(params, prompt)
+    toks, scores = jax.device_get(run(params, prompt))  # one transfer
     return np.asarray(toks), np.asarray(scores)
 
 
