@@ -36,7 +36,11 @@ def test_reference_routes_to_argmax_expert(rng):
     assert np.isfinite(float(aux))
 
 
-@pytest.mark.parametrize("top_k", [1, 2])
+@pytest.mark.parametrize("top_k", [
+    # top_k=2 is the production-shaped oracle and exercises the same
+    # routing machinery; the top_k=1 variant rides the slow tier
+    pytest.param(1, marks=pytest.mark.slow), 2,
+])
 def test_mesh_matches_reference(rng, top_k):
     assert len(jax.devices()) == 8
     mesh = get_mesh_nd({"ep": 8})

@@ -324,6 +324,7 @@ def test_mesh_trainer_resident_equals_stream(rng):
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # validation-pipeline integration; validation_data semantics pinned in test_trainers
 def test_mesh_trainer_validation_data_pipeline(rng):
     """validation_data scores the engine-layout params through from_engine
     every epoch: one val record per epoch with sane accuracy bounds, and
