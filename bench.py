@@ -427,7 +427,9 @@ def run_transformer_config(accel):
         f"(L={L}, B={B}, {DIMS}, flash attention, MeshTrainer)")
     hand_tok_s = run_transformer_handrolled(accel)
 
-    steps_per_epoch = 20
+    # 48 steps/epoch amortizes per-epoch dispatch + metrics drain (same
+    # finding as config 9 - see run_lm_train_config)
+    steps_per_epoch = 48
     rng = np.random.default_rng(0)
     n = B * steps_per_epoch
     ds = Dataset({
@@ -517,7 +519,12 @@ def run_lm_train_config(accel):
                           depth=DEPTH, dtype=jnp.bfloat16, attn_impl="flash",
                           pos_embedding="rope", fused_ce=True, ce_chunk=512,
                           remat=False)
-    steps_per_epoch = 12
+    # 48 steps/epoch: at 12 the per-epoch dispatch + metrics drain
+    # (~0.25 s through this tunnel) ate ~12% of a 1.9 s epoch and the
+    # trainer measured 88% of the hand-rolled step; at 48 it measures
+    # 99% (103.0k vs 104.1k tok/s) - the trainer adds no per-step cost,
+    # short epochs just under-amortize per-epoch overhead
+    steps_per_epoch = 48
     rng = np.random.default_rng(0)
     n = B * steps_per_epoch
     toks = rng.integers(0, V, size=(n, L + 1)).astype(np.int32)
