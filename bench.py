@@ -1114,12 +1114,13 @@ def run_proxy_only():
     train, _ = mnist(n_train=2048, n_test=64)
     # reduce="max": this subprocess shares the 1-core host with the main
     # process's tracing bursts, which SLOW proxy epochs (measured 37%
-    # spread in a contended run vs 3% serial). The fastest of 5 epochs is
-    # the least-contended estimate, and a faster denominator can only
-    # UNDERSTATE vs_baseline — conservative by construction, so the
-    # spread gate does not apply to this leg (distinct still does).
-    # The fastest of 4 timed epochs (~136 s each): the proxy is the
-    # headline's critical path even concurrent, so every epoch counts.
+    # spread in a contended run vs 3% serial). The fastest of 4 timed
+    # epochs (~136 s each) is the least-contended estimate, and a faster
+    # denominator can only UNDERSTATE vs_baseline — conservative by
+    # construction, so the spread gate does not apply to this leg
+    # (distinct still does). Four epochs, not fewer: max-of-N is only as
+    # conservative as its sample count — with too few epochs they can
+    # ALL land on contended windows and the ratio inflates.
     sps, spread, distinct = measure(
         cpu, lenet(dtype=jnp.float32), ADAGMerge(), optax.adam(1e-3),
         train, ["features", "label"], batch_size=256, window=8,
