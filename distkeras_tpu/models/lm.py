@@ -693,7 +693,11 @@ def _speculative_program(target: TransformerLM, draft: TransformerLM,
             # the budget never land in `out`, so they don't count.
             # PER-ROW sums (ADVICE r4): acceptance reports mean draft/
             # target agreement across rows, not the batch-min lockstep
-            # advancement (which `rounds` captures).
+            # advancement (which `rounds` captures). Rows past the
+            # batch-min re-propose their overhang next round, so the same
+            # POSITION can be counted in proposed/accepted more than once
+            # — agreement-per-proposal semantics, documented in
+            # speculative_generate's docstring.
             room = max_new_tokens - n
             return (out, n + a + 1, last, t_caches, d_caches, rounds + 1,
                     accepted + jnp.sum(jnp.minimum(a_row, room)),
@@ -850,7 +854,10 @@ def _speculative_sampled_program(target: TransformerLM,
             )
             out = jax.lax.dynamic_update_slice(out, emit, (0, n))
             # per-row stat sums, clamped to the emission budget (see the
-            # greedy program): acceptance is mean per-row agreement
+            # greedy program): acceptance is mean per-row agreement per
+            # PROPOSAL — overhang positions past the batch-min cut are
+            # re-proposed (and re-counted) next round, as documented in
+            # speculative_generate's docstring
             room = max_new_tokens - n
             return (out, n + a + 1, cut_tok, t_caches, d_caches,
                     rounds + 1,
@@ -912,7 +919,17 @@ def speculative_generate(target, target_params, draft, draft_params, prompt,
     ``rounds`` (target verify passes), ``proposed``/``accepted`` draft
     tokens SUMMED PER ROW (final-round proposals that overhang
     ``max_new_tokens`` are excluded from both counts), and the
-    ``acceptance`` rate — the mean per-row draft/target agreement.
+    ``acceptance`` rate — the mean per-row draft/target agreement PER
+    PROPOSAL, not per distinct emitted position. Because the lockstep
+    advances every row by the batch-MINIMUM accepted length, a row that
+    accepted further than the minimum re-proposes the overhang positions
+    next round, and those re-proposals are counted again in both
+    ``proposed`` and ``accepted`` (typically re-accepted, having already
+    agreed once). The per-position sums can therefore exceed the number
+    of distinct emitted positions — ``acceptance`` remains an unbiased
+    estimate of P(draft token == target token at a sampled proposal),
+    which is the draft-quality number the ratio is meant to report, but
+    ``accepted`` is NOT "distinct tokens emitted via the draft".
     Latency is governed separately by the batch-minimum lockstep: every
     row advances ``~max_new_tokens/rounds`` positions per verify pass, so
     per-pass progress can trail ``acceptance·K`` when one slow row drags

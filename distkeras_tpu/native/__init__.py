@@ -77,6 +77,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dkps_server_get_ema.argtypes = [ctypes.c_void_p, f32p]
     lib.dkps_server_record_pull.restype = None
     lib.dkps_server_record_pull.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.dkps_server_stats.restype = None
+    lib.dkps_server_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+    ]
     lib.dkps_client_connect.restype = ctypes.c_void_p
     lib.dkps_client_connect.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32, ctypes.c_uint64,

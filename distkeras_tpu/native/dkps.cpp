@@ -51,6 +51,8 @@
 #include <algorithm>
 #include <atomic>
 #include <cerrno>
+#include <cfloat>
+#include <chrono>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -141,6 +143,20 @@ struct Server {
   };
   std::unordered_map<uint32_t, PullErr> pull_errors;
 
+  // Contention/throughput counters (parity with the Python PS's stats():
+  // same semantics, read via dkps_server_stats). Atomics: bumped from
+  // handler threads, read lock-free by the stats call. Byte counters are
+  // PAYLOAD bytes (weights/quantized values + per-segment scale metadata)
+  // — the few fixed per-op protocol bytes (action, version, counts) are
+  // excluded, matching the Python side's "framing excluded" accounting.
+  // Lock wait/hold cover the CENTER mutex's hot-path sections only (pull
+  // snapshot, commit fold) — admin reads (get_center etc.) stay
+  // unlogged, same as the Python side.
+  std::atomic<uint64_t> st_pulls{0}, st_cpulls{0}, st_commits{0};
+  std::atomic<uint64_t> st_bytes_in{0}, st_bytes_out{0};
+  std::atomic<uint64_t> st_lock_acquires{0}, st_lock_wait_ns{0},
+      st_lock_hold_ns{0};
+
   int listen_fd = -1;
   int port = 0;
   std::atomic<bool> running{false};
@@ -148,6 +164,29 @@ struct Server {
   std::mutex conn_mu;
   std::vector<int> conn_fds;
   std::vector<std::thread> handlers;
+
+  // RAII center-mutex guard with wait/hold accounting (steady_clock ns)
+  // for the hot-path sections feeding dkps_server_stats
+  struct StatGuard {
+    Server* s;
+    std::chrono::steady_clock::time_point t_acq;
+    explicit StatGuard(Server* srv) : s(srv) {
+      const auto t0 = std::chrono::steady_clock::now();
+      s->mu.lock();
+      t_acq = std::chrono::steady_clock::now();
+      s->st_lock_wait_ns +=
+          std::chrono::duration_cast<std::chrono::nanoseconds>(t_acq - t0)
+              .count();
+      s->st_lock_acquires += 1;
+    }
+    ~StatGuard() {
+      s->st_lock_hold_ns += std::chrono::duration_cast<
+                                std::chrono::nanoseconds>(
+                                std::chrono::steady_clock::now() - t_acq)
+                                .count();
+      s->mu.unlock();
+    }
+  };
 
   // EMA fold after a commit landed in the center — call under mu
   void ema_fold_locked() {
@@ -188,7 +227,7 @@ struct Server {
         {
           // copy under the lock, send outside it: a slow client must not
           // serialize every other worker's fold behind its TCP window
-          std::lock_guard<std::mutex> g(mu);
+          StatGuard g(this);
           version = num_updates;
           // staleness bookkeeping, exactly the Python PS's pull():
           // tau at the next commit = center updates since this pull
@@ -197,6 +236,8 @@ struct Server {
         }
         if (!send_all(fd, &version, 8)) break;
         if (!send_all(fd, buf.data(), n * sizeof(float))) break;
+        st_pulls += 1;
+        st_bytes_out += n * sizeof(float);
       } else if (action == 5) {  // PULL_INT8: block-quantized center + EF
         const uint64_t nb = pull_blocks(n);
         if (qbuf.size() != n) qbuf.resize(n);
@@ -208,7 +249,7 @@ struct Server {
         uint64_t version;
         PullErr* pe;
         {
-          std::lock_guard<std::mutex> g(mu);
+          StatGuard g(this);
           version = num_updates;
           pull_versions[conn_wid_] = num_updates;  // same staleness
           pe = &pull_errors[conn_wid_];            // bookkeeping as PULL
@@ -230,7 +271,13 @@ struct Server {
           }
           const float scale = amax > 0 ? amax / 127.0f : 0.0f;
           pscales[b] = scale;
-          const float inv = scale > 0 ? 1.0f / scale : 0.0f;
+          // Subnormal-scale guard (parity with the Python encode's
+          // degenerate path): for a tiny block, 1/scale overflows to inf
+          // and a zero element would make qf = 0·inf = NaN, which the
+          // clamp passes through into an undefined int8 cast. Sending
+          // zeros keeps the whole block in the residual instead — the EF
+          // stream still telescopes, with defined behavior.
+          const float inv = scale >= FLT_MIN ? 1.0f / scale : 0.0f;
           for (uint64_t i = lo; i < hi; ++i) {
             const float v = err[i];
             float qf = v * inv;
@@ -247,13 +294,30 @@ struct Server {
         uint32_t nb32 = static_cast<uint32_t>(nb);
         if (!send_all(fd, &version, 8) || !send_all(fd, &nb32, 4) ||
             !send_all(fd, pscales.data(), nb * sizeof(float)) ||
-            !send_all(fd, qbuf.data(), n))
+            !send_all(fd, qbuf.data(), n)) {
+          // Dropped reply: the client never received this blob, so roll
+          // the residual back to its pre-pull state (err_old = v − c;
+          // err currently holds v − scale·q and qbuf/pscales/buf still
+          // hold q, the scales, and the center snapshot). Without this,
+          // a reconnecting worker's EF stream would silently absorb one
+          // phantom pull — bounded (≤ half a step per element) but
+          // avoidable. Still under the worker mutex (wg).
+          for (uint64_t b = 0; b < nb; ++b) {
+            const uint64_t lo = b * kPullBlock;
+            const uint64_t hi = std::min(lo + kPullBlock, n);
+            const float scale = pscales[b];
+            for (uint64_t i = lo; i < hi; ++i)
+              err[i] += scale * static_cast<float>(qbuf[i]) - c[i];
+          }
           break;
+        }
+        st_cpulls += 1;
+        st_bytes_out += nb * sizeof(float) + n;
       } else if (action == 2) {  // COMMIT
         if (!recv_all(fd, buf.data(), n * sizeof(float))) break;
         uint8_t ack = 1;
         {
-          std::lock_guard<std::mutex> g(mu);
+          StatGuard g(this);
           const float s = fold_scale_locked();
           float* c = center.data();
           const float* d = buf.data();
@@ -261,6 +325,8 @@ struct Server {
           ema_fold_locked();
           num_updates += 1;
         }
+        st_commits += 1;
+        st_bytes_in += n * sizeof(float);
         if (!send_all(fd, &ack, 1)) break;
       } else if (action == 4) {  // COMMIT_INT8: per-segment scaled int8
         uint32_t segs;
@@ -289,7 +355,7 @@ struct Server {
         if (!recv_all(fd, qbuf.data(), n)) break;
         uint8_t ack = 1;
         {
-          std::lock_guard<std::mutex> g(mu);
+          StatGuard g(this);
           const float s = fold_scale_locked();
           float* c = center.data();
           uint64_t off = 0;
@@ -303,6 +369,8 @@ struct Server {
           ema_fold_locked();
           num_updates += 1;
         }
+        st_commits += 1;
+        st_bytes_in += static_cast<uint64_t>(segs) * 12 + n;
         if (!send_all(fd, &ack, 1)) break;
       } else {  // BYE or garbage: drop the connection either way
         break;
@@ -499,6 +567,23 @@ int dkps_server_get_ema(void* h, float* out) {
 // folds without the wire; wire pulls record via the PULL action below)
 void dkps_server_record_pull(void* h, uint32_t wid) {
   static_cast<Server*>(h)->record_pull_version(wid);
+}
+
+// Contention/throughput counters (parity with the Python PS's stats()).
+// Fills out[8]: pulls, compressed_pulls, commits, bytes_in, bytes_out,
+// center_lock_acquires, center_lock_wait_ns, center_lock_hold_ns.
+// Lock-free reads of monotone atomics: values may lag in-flight ops by
+// one — telemetry semantics, same as the Python side.
+void dkps_server_stats(void* h, uint64_t* out) {
+  auto* s = static_cast<Server*>(h);
+  out[0] = s->st_pulls.load();
+  out[1] = s->st_cpulls.load();
+  out[2] = s->st_commits.load();
+  out[3] = s->st_bytes_in.load();
+  out[4] = s->st_bytes_out.load();
+  out[5] = s->st_lock_acquires.load();
+  out[6] = s->st_lock_wait_ns.load();
+  out[7] = s->st_lock_hold_ns.load();
 }
 
 // ---------------------------------------------------------------- client --

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import ctypes
 import struct
+import time
 from typing import Any
 
 import numpy as np
@@ -156,6 +157,7 @@ class NativeSocketParameterServer:
             )
         self._handle = h
         self.port = int(self._lib.dkps_server_port(h))
+        self._t_start = time.monotonic()  # stats() rate denominator
 
     def start(self) -> None:
         self._lib.dkps_server_start(self._handle)
@@ -201,6 +203,26 @@ class NativeSocketParameterServer:
         if self._lib.dkps_server_get_ema(self._handle, _f32p(out)) != 0:
             return None
         return self.spec.unflatten(out)
+
+    def stats(self) -> dict:
+        """Contention + throughput counters — the SAME keys and derived
+        math as ``ParameterServer.stats()`` (shared ``build_ps_stats``
+        assembler; parity pinned by test_native_ps.py), sourced from the
+        C++ server's atomics: op counts, payload bytes moved, and
+        center-mutex wait/hold totals for the hot-path sections (pull
+        snapshot memcpy, commit fold). Rates are computed here against
+        the time since ``initialize()``."""
+        from distkeras_tpu.parameter_servers import build_ps_stats
+
+        raw = (ctypes.c_uint64 * 8)()
+        self._lib.dkps_server_stats(self._handle, raw)
+        pulls, cpulls, commits, bytes_in, bytes_out, acq, wait, hold = (
+            int(v) for v in raw
+        )
+        return build_ps_stats(
+            pulls, cpulls, commits, bytes_in, bytes_out, acq, wait, hold,
+            time.monotonic() - self._t_start,
+        )
 
 
 class NativePSClient:

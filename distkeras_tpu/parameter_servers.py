@@ -17,12 +17,35 @@ variant is the DCN story: a PS reachable across pod slices.
 Staleness is tracked for real here: ``pull`` records the center version a
 worker saw; ``commit`` computes τ = center updates since that pull and hands
 it to the rule (DynSGD scales by 1/(τ+1); other rules ignore it).
+
+Locking discipline (mirrors ``native/dkps.cpp``; see DESIGN.md):
+
+- ``_lock`` (center lock) protects ``center``/``num_updates``/
+  ``_pull_versions`` and the ``_pull_errors`` map itself. Its critical
+  sections are O(fold): commit's fold runs under it (each fold REBINDS
+  ``center`` to a fresh tree, so the published tree is immutable and acts
+  as a copy-on-write snapshot), while pulls only record the version and
+  grab the snapshot reference — never an O(model) encode or copy.
+- each ``_PullState.lock`` (per-worker residual lock) protects that
+  worker's compressed-pull error-feedback residual and scratch; int8
+  quantization runs under it, so different workers' compressed pulls
+  overlap instead of serializing behind the center.
+- ``_ema_lock`` protects the EMA tree; the per-commit EMA fold runs under
+  it, fed by the post-fold center snapshot, ordered by center version
+  (a fold racing behind a newer one is dropped, not applied stale).
+- lock ordering: the center lock is never held while taking a worker or
+  EMA lock and vice versa — each section takes exactly one lock, so no
+  ordering cycle exists.
+
+``stats()`` exposes contention counters (pulls/commits, bytes moved, center
+lock wait/hold ns) — the same counter set ``native/dkps.cpp`` tracks.
 """
 
 from __future__ import annotations
 
 import pickle
 import threading
+import time
 from typing import Any
 
 import numpy as np
@@ -32,6 +55,58 @@ from distkeras_tpu.parallel.compression import is_encoded, maybe_decode
 from distkeras_tpu.parallel.merge_rules import MergeRule
 
 Pytree = Any
+
+
+class _TimedLock:
+    """``threading.Lock`` with wait/hold accounting (monotonic ns).
+
+    The counters feed ``ParameterServer.stats()``: mean hold time is the
+    review-time proof that the center lock's critical sections stayed
+    O(fold). Counter updates happen while the lock is held, so they need no
+    extra synchronization; reads from ``stats()`` are approximate (a torn
+    read can lag by one in-flight acquire, which is fine for telemetry).
+    """
+
+    __slots__ = ("_lock", "acquires", "wait_ns", "hold_ns", "_t_acq")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.acquires = 0
+        self.wait_ns = 0
+        self.hold_ns = 0
+        self._t_acq = 0
+
+    def __enter__(self):
+        t0 = time.perf_counter_ns()
+        self._lock.acquire()
+        t1 = time.perf_counter_ns()
+        self.wait_ns += t1 - t0
+        self.acquires += 1
+        self._t_acq = t1
+        return self
+
+    def __exit__(self, *exc):
+        self.hold_ns += time.perf_counter_ns() - self._t_acq
+        self._lock.release()
+
+
+class _PullState:
+    """One worker's compressed-pull state: error-feedback residual plus
+    encode scratch, guarded by its OWN lock (mirrors dkps.cpp's per-worker
+    ``PullErr`` mutex). Quantization holds this lock — not the center lock —
+    so different workers' compressed pulls overlap, while a reconnecting
+    client reusing a worker id serializes against the old handler instead
+    of racing on the residual. Residual/scratch lists are allocated lazily
+    under this lock on the first compressed pull (never under the center
+    lock: allocation is O(model))."""
+
+    __slots__ = ("lock", "err", "qf", "epoch")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.err: list | None = None   # per-leaf f32 residuals (None = exact)
+        self.qf: list | None = None    # per-leaf f32 scratch: quantized vals
+        self.epoch = 0                 # encode counter: guards late rollbacks
 
 
 class ParameterServer:
@@ -48,7 +123,9 @@ class ParameterServer:
         self.rule = rule
         self.num_workers = int(num_workers)
         self.num_updates = 0
-        self._lock = threading.Lock()
+        # center lock (timed: stats() reports its wait/hold) — see the
+        # module docstring for the full locking discipline
+        self._lock = _TimedLock()
         self._pull_versions: dict[int, int] = {}
         # Polyak/EMA averaging of the center, updated per commit (the
         # classic async-SGD companion — the EASGD paper evaluates the
@@ -63,15 +140,39 @@ class ParameterServer:
         self._ema = (
             jax_tree_copy(self.center) if ema_decay is not None else None
         )
-        # per-leaf scratch reused across commits: the fold runs under the
-        # serializing lock, so it must not allocate model-sized temporaries
+        # EMA state lives under its OWN lock, fed by the post-fold center
+        # snapshot: the O(model) fma never runs under the center lock.
+        # _ema_version orders racing folds — a fold that lost the race to a
+        # newer center is dropped (its update is subsumed, not applied
+        # stale); sequential commits always fold exactly once, in order.
+        self._ema_lock = threading.Lock()
+        self._ema_version = 0
+        # per-leaf scratch reused across EMA folds (no model-sized
+        # temporaries per commit); guarded by _ema_lock
         self._ema_scratch = (
             None if self._ema is None
             else _tree_map(np.empty_like, self._ema)
         )
-        # per-worker compressed-pull residuals (error feedback), allocated
-        # lazily on a worker's first compressed pull — see pull()
-        self._pull_errors: dict[int, list] = {}
+        # per-worker compressed-pull state (error-feedback residual + its
+        # lock + encode scratch), created on a worker's first compressed
+        # pull — see pull()
+        self._pull_errors: dict[int, _PullState] = {}
+        # contention/throughput counters behind stats(); the center lock
+        # carries its own timing, these cover op counts and bytes. bytes
+        # are array payload bytes AS MOVED (encoded size for codec blobs;
+        # framing/pickle overhead excluded); raw pulls/commits are costed
+        # at the center's size, computed once here (structure is fixed
+        # for the server's lifetime).
+        self._stats_lock = threading.Lock()
+        self._n_pulls = 0
+        self._n_compressed_pulls = 0
+        self._n_commits = 0
+        self._bytes_in = 0
+        self._bytes_out = 0
+        self._t_start = time.monotonic()
+        self._center_nbytes = sum(
+            np.asarray(l).nbytes for l in _tree_leaves(self.center)
+        )
 
     # -- service lifecycle (no-ops for the in-process PS) --------------------
 
@@ -98,48 +199,138 @@ class ParameterServer:
         center stream even though each individual pull is lossy. Combined
         with int8 commits the PS round-trip moves ~2/8 of the uncompressed
         bytes. Staleness bookkeeping is identical to an exact pull.
+
+        Hot-path structure (the DOWNPOUR lesson — the center lock covers
+        only the fold, never O(model) encode/copy work): the center lock
+        section is O(1) — record the version and grab the published center
+        snapshot (immutable: every commit rebinds ``center`` to a fresh
+        tree). The O(model) work — the exact-pull copy, or int8
+        quantization against this worker's residual — happens OUTSIDE it,
+        quantization under the per-worker residual lock, mirroring the C++
+        PULL_INT8 structure in ``native/dkps.cpp``.
         """
+        snap, st = self._begin_pull(worker_id, compressed)
+        if not compressed:
+            out = jax_tree_copy(snap)  # O(model), off the center lock
+            self._count(pulls=1, bytes_out=self._center_nbytes)
+            return out
+        with st.lock:
+            blob, nbytes = self._encode_pull(st, snap)
+        self._count(compressed_pulls=1, bytes_out=nbytes)
+        return blob
+
+    def _begin_pull(self, worker_id: int, compressed: bool) -> tuple:
+        """The ONE center-lock pull preamble (shared by ``pull`` and the
+        socket wire path, so the staleness/snapshot bookkeeping cannot
+        diverge between transports): O(1) — record the version this
+        worker saw, grab the immutable center snapshot, and resolve this
+        worker's residual state when compressing."""
         with self._lock:
             self._pull_versions[worker_id] = self.num_updates
-            if not compressed:
-                return jax_tree_copy(self.center)
-            return self._encode_pull_locked(worker_id)
+            snap = self.center
+            st = None
+            if compressed:
+                st = self._pull_errors.get(worker_id)
+                if st is None:
+                    st = self._pull_errors[worker_id] = _PullState()
+        return snap, st
 
-    def _encode_pull_locked(self, worker_id: int) -> dict:
+    def _encode_pull(self, st: _PullState, snapshot: Pytree) -> tuple:
+        """Quantize ``snapshot + residual`` to int8, updating the residual.
+
+        Runs under the worker's residual lock. The arithmetic is
+        bit-identical to the historical under-center-lock encode (same
+        add → absmax → divide → rint → dequant-subtract sequence in f32;
+        the old clip pass was a provable no-op, see below), but runs in
+        preallocated per-worker scratch: one int8 output allocation per
+        float leaf instead of ~10 model-sized temporaries — most of the
+        measured single-stream speedup comes from here, the rest from
+        pulls no longer serializing behind the center lock.
+        """
         import jax
 
         from distkeras_tpu.parallel.compression import _LEAF, _MARK
 
-        leaves, treedef = jax.tree.flatten(self.center)
-        err = self._pull_errors.get(worker_id)
-        if err is None:
-            err = self._pull_errors[worker_id] = [
+        leaves, treedef = jax.tree.flatten(snapshot)
+        if st.err is None:
+            st.err = [
                 np.zeros(np.shape(l), np.float32)
                 if _is_floatish(np.asarray(l)) else None
                 for l in leaves
             ]
+            st.qf = [None if e is None else np.empty_like(e) for e in st.err]
         enc = []
+        nbytes = 0
         for i, leaf in enumerate(leaves):
             arr = np.asarray(leaf)
-            if err[i] is None:
-                enc.append(np.copy(arr))  # integer/bool leaves: exact
+            err = st.err[i]
+            if err is None:
+                out = np.copy(arr)  # integer/bool leaves: exact
+                enc.append(out)
+                nbytes += out.nbytes
                 continue
-            v = arr.astype(np.float32) + err[i]
-            amax = float(np.max(np.abs(v))) if v.size else 0.0
+            dt = arr.dtype.name
+            if arr.dtype != np.float32:
+                arr = arr.astype(np.float32)
+            qf = st.qf[i]
+            # err doubles as the v = center + residual accumulator: after
+            # the add it holds v, and the final subtract turns it back
+            # into the new residual — two persistent buffers per worker
+            # instead of three keeps the 4-worker working set cache-honest
+            np.add(arr, err, out=err)
+            amax = (max(float(err.max()), -float(err.min()))
+                    if err.size else 0.0)
             scale = amax / 127.0 if amax > 0 else 1.0
-            q = np.clip(np.rint(v / scale), -127, 127).astype(np.int8)
-            err[i] = v - q.astype(np.float32) * np.float32(scale)
-            enc.append({_LEAF: "int8", "dt": arr.dtype.name,
-                        "q": q, "s": scale})
-        return {_MARK: "int8", "tree": jax.tree.unflatten(treedef, enc)}
+            if np.float32(scale) >= np.finfo(np.float32).tiny:
+                # fast path (every non-degenerate leaf): no clip pass —
+                # with a NORMAL f32 scale ≥ amax/127 up to one rounding,
+                # |v/scale| ≤ 127·(1 + ~2⁻²²) < 127.5 for every element,
+                # so rint already lands in [-127, 127] and the historical
+                # clip is a provable no-op (bit-identical removal)
+                np.divide(err, np.float32(scale), out=qf)
+                np.rint(qf, out=qf)
+                q = qf.astype(np.int8)
+                # residual: v − q·scale; qf holds exactly q's values
+                np.multiply(qf, np.float32(scale), out=qf)
+                np.subtract(err, qf, out=err)
+            else:
+                # degenerate leaf: amax is so small that f32(scale)
+                # underflows to zero or subnormal, where the divide can
+                # produce inf (residual-poisoning NaNs downstream) or
+                # round past 127.5 (int8 wrap). Keep the historical
+                # clipped encode for exactly this case — same observable
+                # behavior as the old code (decoded values ≈ 0, the
+                # whole magnitude stays in the residual), cost irrelevant
+                # at these magnitudes.
+                with np.errstate(divide="ignore", invalid="ignore",
+                                 over="ignore"):
+                    qi = np.clip(np.rint(err / np.float32(scale)),
+                                 -127, 127)
+                    np.nan_to_num(qi, copy=False, nan=0.0,
+                                  posinf=127.0, neginf=-127.0)
+                    q = qi.astype(np.int8)
+                    np.subtract(
+                        err,
+                        q.astype(np.float32) * np.float32(scale),
+                        out=err,
+                    )
+            enc.append({_LEAF: "int8", "dt": dt, "q": q, "s": scale})
+            nbytes += q.nbytes + 8  # payload + per-leaf scale
+        st.epoch += 1  # this encode supersedes any pending late rollback
+        return ({_MARK: "int8", "tree": jax.tree.unflatten(treedef, enc)},
+                nbytes)
 
     def commit(self, worker_id: int, payload: Pytree) -> None:
-        """Fold one worker's commit into the center under the lock.
+        """Fold one worker's commit into the center under the center lock.
 
         Commits may arrive codec-compressed (``parallel.compression`` —
         int8 / top-k wire blobs); the fold always sees the decoded dense
-        tree, so merge-rule semantics are codec-independent.
+        tree, so merge-rule semantics are codec-independent. Decode runs
+        before the lock and the per-commit EMA fold after it (under the
+        EMA lock, against the just-published snapshot) — the center lock's
+        critical section is exactly the fold.
         """
+        nbytes = self._payload_nbytes(payload)  # wire size: BEFORE decode
         payload = maybe_decode(payload)
         with self._lock:
             staleness = self.num_updates - self._pull_versions.get(worker_id, 0)
@@ -149,27 +340,151 @@ class ParameterServer:
                 )
             )
             self.num_updates += 1
-            if self._ema is not None:
-                # in place via the preallocated scratch: the lock
-                # serializes every worker, so the fold allocates nothing
-                d = self.ema_decay
+            version = self.num_updates
+            snap = self.center
+        self._count(commits=1, bytes_in=nbytes)
+        if self._ema is not None:
+            d = self.ema_decay
 
-                def fma(e, c, s):
-                    np.multiply(np.asarray(c, dtype=e.dtype), 1.0 - d,
-                                out=s)
-                    e *= d
-                    e += s
+            def fma(e, c, s):
+                np.multiply(np.asarray(c, dtype=e.dtype), 1.0 - d, out=s)
+                e *= d
+                e += s
 
-                _tree_map(fma, self._ema, self.center, self._ema_scratch)
+            with self._ema_lock:
+                # version-ordered: if a concurrent commit already folded a
+                # NEWER center, this fold is subsumed — dropping it keeps
+                # the EMA a well-formed average of center snapshots instead
+                # of applying an older center after a newer one.
+                if version > self._ema_version:
+                    self._ema_version = version
+                    _tree_map(fma, self._ema, snap, self._ema_scratch)
 
     def get_model(self) -> Pytree:
         with self._lock:
-            return jax_tree_copy(self.center)
+            snap = self.center
+        return jax_tree_copy(snap)  # snapshot is immutable; copy off-lock
 
     def get_ema(self) -> Pytree:
         """The Polyak-averaged center (None unless ``ema_decay`` was set)."""
-        with self._lock:
-            return None if self._ema is None else jax_tree_copy(self._ema)
+        if self._ema is None:
+            return None
+        with self._ema_lock:
+            # the EMA tree is folded in place, so the copy must stay under
+            # its lock (unlike the copy-on-write center)
+            return jax_tree_copy(self._ema)
+
+    def _rollback_encode_locked(self, st: _PullState, snapshot: Pytree,
+                                blob: dict) -> None:
+        """Undo one ``_encode_pull``'s residual advance (call under
+        ``st.lock``, with the SAME snapshot the encode saw): the blob was
+        never delivered, so the EF stream must not account for it.
+        Restores ``err_old = v − c`` from ``err = v − s·q`` (mirrors the
+        dkps.cpp PULL_INT8 send-failure rollback). Error path only — the
+        per-element temporaries here don't matter."""
+        import jax
+
+        from distkeras_tpu.parallel.compression import _LEAF
+
+        enc_leaves = jax.tree.flatten(
+            blob["tree"],
+            is_leaf=lambda x: isinstance(x, dict) and _LEAF in x,
+        )[0]
+        snap_leaves = jax.tree.flatten(snapshot)[0]
+        for i, (enc, c) in enumerate(zip(enc_leaves, snap_leaves)):
+            err = st.err[i]
+            if err is None:
+                continue
+            dq = np.multiply(enc["q"], np.float32(enc["s"]),
+                             dtype=np.float32)
+            np.add(err, dq, out=err)                       # back to v
+            np.subtract(err, np.asarray(c, np.float32), out=err)  # v − c
+
+    # -- observability -------------------------------------------------------
+
+    def _payload_nbytes(self, payload: Pytree) -> int:
+        """Wire size of one commit payload: array bytes of the tree as it
+        ARRIVED (codec blobs count their encoded arrays plus ~8 bytes per
+        scalar field, so int8 commits report ~1/4 of dense — matching the
+        native server's wire accounting); raw trees cost the center's
+        size, computed once at construction."""
+        from distkeras_tpu.parallel.compression import is_encoded
+
+        if not is_encoded(payload):
+            return self._center_nbytes
+        total = 0
+        for leaf in _tree_leaves(payload):
+            if isinstance(leaf, np.ndarray):
+                total += leaf.nbytes
+            else:
+                total += 8  # scale floats / dtype tags / codec marks
+        return total
+
+    def _count(self, pulls=0, compressed_pulls=0, commits=0,
+               bytes_in=0, bytes_out=0):
+        with self._stats_lock:
+            self._n_pulls += pulls
+            self._n_compressed_pulls += compressed_pulls
+            self._n_commits += commits
+            self._bytes_in += bytes_in
+            self._bytes_out += bytes_out
+
+    def stats(self) -> dict:
+        """Contention + throughput counters (cheap, approximate under load).
+
+        Keys (the native PS exposes the identical set — parity pinned by
+        tests/test_native_ps.py):
+
+        - ``pulls`` / ``compressed_pulls`` / ``commits``: op counts.
+        - ``bytes_in`` / ``bytes_out``: array payload bytes moved (commit /
+          pull directions) at their WIRE size — codec-compressed commits
+          and int8 pulls count encoded bytes, so the compression win is
+          visible here; framing overhead excluded.
+        - ``center_lock_acquires`` / ``center_lock_wait_ns`` /
+          ``center_lock_hold_ns``: hot-path center-lock contention totals;
+          ``center_lock_mean_hold_ns`` is the per-acquire mean — the number
+          that proves the critical sections stayed O(fold).
+        - ``elapsed_s``, ``pulls_per_sec``, ``commits_per_sec``: since
+          construction (compressed pulls count toward the pull rate).
+        """
+        elapsed = time.monotonic() - self._t_start
+        with self._stats_lock:
+            pulls = self._n_pulls
+            cpulls = self._n_compressed_pulls
+            commits = self._n_commits
+            bytes_in, bytes_out = self._bytes_in, self._bytes_out
+        return build_ps_stats(
+            pulls, cpulls, commits, bytes_in, bytes_out,
+            self._lock.acquires, self._lock.wait_ns, self._lock.hold_ns,
+            elapsed,
+        )
+
+
+def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
+                   bytes_in: int, bytes_out: int, lock_acquires: int,
+                   lock_wait_ns: int, lock_hold_ns: int,
+                   elapsed_s: float) -> dict:
+    """The ONE stats-dict builder both PS transports share (Python counters
+    here, C++ atomics via ``native_ps.NativeSocketParameterServer.stats``):
+    key set and derived-value math are pinned by construction, so the
+    transports cannot drift."""
+    elapsed_s = max(elapsed_s, 1e-9)
+    return {
+        "pulls": pulls,
+        "compressed_pulls": compressed_pulls,
+        "commits": commits,
+        "bytes_in": bytes_in,
+        "bytes_out": bytes_out,
+        "center_lock_acquires": lock_acquires,
+        "center_lock_wait_ns": lock_wait_ns,
+        "center_lock_hold_ns": lock_hold_ns,
+        "center_lock_mean_hold_ns": (
+            lock_hold_ns // lock_acquires if lock_acquires else 0
+        ),
+        "elapsed_s": elapsed_s,
+        "pulls_per_sec": (pulls + compressed_pulls) / elapsed_s,
+        "commits_per_sec": commits / elapsed_s,
+    }
 
 
 def _is_floatish(arr: np.ndarray) -> bool:
@@ -183,6 +498,12 @@ def _tree_map(fn, *trees):
     import jax
 
     return jax.tree.map(fn, *trees)
+
+
+def _tree_leaves(tree: Pytree) -> list:
+    import jax
+
+    return jax.tree.leaves(tree)
 
 
 def jax_tree_copy(tree: Pytree) -> Pytree:
@@ -254,17 +575,13 @@ class SocketParameterServer(ParameterServer):
                 msg = networking.recv_data(conn)
                 action = msg.get("action")
                 if action == "pull":
-                    networking.send_data(
-                        conn, {"weights": self.pull(msg["worker_id"])}
-                    )
+                    self._serve_pull(conn, msg["worker_id"])
                 elif action == "pull_int8":
                     # compressed pull: int8 blob + server-side error
-                    # feedback (see ParameterServer.pull)
-                    networking.send_data(
-                        conn,
-                        {"weights": self.pull(msg["worker_id"],
-                                              compressed=True)},
-                    )
+                    # feedback (see ParameterServer.pull), with the send
+                    # coupled to the residual advance (rollback on a
+                    # dropped reply — parity with dkps.cpp PULL_INT8)
+                    self._serve_compressed_pull(conn, msg["worker_id"])
                 elif action == "commit":
                     self.commit(msg["worker_id"], msg["payload"])
                     networking.send_data(conn, {"ok": True})
@@ -280,6 +597,41 @@ class SocketParameterServer(ParameterServer):
             pass
         finally:
             conn.close()
+
+    def _serve_pull(self, conn, worker_id: int) -> None:
+        """Wire variant of the exact ``pull``: serializes the immutable
+        center snapshot straight onto the wire (pickling already copies,
+        so the in-process path's defensive tree copy would be a second,
+        redundant O(model) pass here) and counts the pull only once the
+        reply is fully sent — delivered-traffic semantics, matching the
+        compressed path and the native server."""
+        snap, _ = self._begin_pull(worker_id, compressed=False)
+        networking.send_data(conn, {"weights": snap})
+        self._count(pulls=1, bytes_out=self._center_nbytes)
+
+    def _serve_compressed_pull(self, conn, worker_id: int) -> None:
+        """Wire variant of ``pull(compressed=True)`` with a dropped-reply
+        rollback (parity with dkps.cpp PULL_INT8): a reply the client
+        never received must not advance its EF residual. The send runs
+        OUTSIDE the residual lock — a stalled client must not wedge the
+        worker id's lock against a same-id reconnect — so the rollback is
+        guarded by the encode epoch: it applies only if no newer encode
+        raced in between; losing that (rare) race degrades to the old
+        bounded phantom-pull behavior instead of corrupting the newer
+        encode's residual. The center-lock section is the same O(1)
+        version-record + snapshot grab as ``pull``."""
+        snap, st = self._begin_pull(worker_id, compressed=True)
+        with st.lock:
+            blob, nbytes = self._encode_pull(st, snap)
+            epoch = st.epoch
+        try:
+            networking.send_data(conn, {"weights": blob})
+        except (ConnectionError, OSError):
+            with st.lock:
+                if st.epoch == epoch:
+                    self._rollback_encode_locked(st, snap, blob)
+            raise
+        self._count(compressed_pulls=1, bytes_out=nbytes)
 
     def stop(self) -> None:
         """Shut down, unblocking ``accept`` via the reference's self-connect

@@ -487,6 +487,19 @@ def run_async_training(trainer, ds, shuffle: bool):
     if snap_client is not None:
         snap_client.close()
     if ps is not None:
+        # PS hot-path observability: stash the contention/throughput
+        # counters (see ParameterServer.stats) on the trainer and stream
+        # one JSON line alongside the other metrics when logging is on.
+        # Kept OUT of the history: history records are per-worker loss rows
+        # and downstream consumers key on their schema.
+        trainer.ps_stats_ = ps.stats() if hasattr(ps, "stats") else None
+        if trainer.ps_stats_ is not None \
+                and getattr(trainer, "log_metrics", False):
+            import json
+            import sys
+
+            print(json.dumps({"ps_stats": trainer.ps_stats_}),
+                  file=sys.stderr, flush=True)
         ps.stop()
         if getattr(trainer, "ema_decay", None) is not None:
             trainer.ema_params_ = ps.get_ema()

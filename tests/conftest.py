@@ -26,17 +26,17 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Persistent compile cache: identical programs (shared model configs across
-# tests, reruns of either tier) skip XLA compilation — the dominant cost on
-# this 1-core CI host. One code path with the user-facing helper.
-import tempfile
+# NO persistent compile cache for the test suite: this jaxlib (0.4.37,
+# XLA:CPU) aborts the whole process (SIGSEGV/SIGABRT) when certain
+# 8-device sharded executables are RELOADED from the persistent cache —
+# observed on the FSDP and megatron-TP run_step programs; a warm-cache
+# tier-1 run died at the first such reload, losing every test after it.
+# Cold compiles are fine and the full suite fits the CI budget without
+# the cache, so determinism wins. (bench.py keeps its own repo-local
+# cache: its single-device programs don't hit the bug.)
+import jax as _jax
 
-from distkeras_tpu.utils import enable_compilation_cache
-
-enable_compilation_cache(os.environ.get(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(tempfile.gettempdir(), "distkeras-jax-test-cache"),
-))
+_jax.config.update("jax_enable_compilation_cache", False)
 
 import numpy as np
 import pytest

@@ -49,6 +49,19 @@ def test_measure_stacked_workers_on_one_device():
     assert sps > 0
 
 
+def test_ps_microbench_smoke():
+    """--ps-bench plumbing: a tiny in-process run produces positive rates
+    and carries the contention counters (full-size runs are manual)."""
+    out = bench.run_ps_microbench(n_params=16_384, workers=2, seconds=0.2,
+                                  transports=("inprocess",))
+    assert set(out) == {"ps_inprocess_raw", "ps_inprocess_int8"}
+    for rec in out.values():
+        assert rec["pulls_per_sec"] > 0
+        assert rec["commits_per_sec"] > 0
+        assert rec["mixed_rounds_per_sec"] > 0
+        assert rec["center_lock_mean_hold_ns"] >= 0
+
+
 def test_analytic_flop_models():
     # hand-checked reference points (training = 3× forward)
     assert bench.mlp_flops((784, 500, 300, 10)) == 3 * 2 * (

@@ -262,7 +262,11 @@ def test_block_ladders_scale_with_length():
     # non-512-multiples keep the small-tile fallbacks
     assert (_pick_block_q(4480), _pick_block_k(4480)) == (128, 128)
     assert (_pick_block_q(256), _pick_block_k(256)) == (128, 256)
-    for L in (1024, 2048, 4096, 4480, 8192, 8320, 16384):
+    # L = 512 is BELOW the measured range (round 5 stopped at 1024): a
+    # 512-row tile there would be a single-tile config no measurement
+    # covered, so the gate keeps the default ladder
+    assert (_pick_block_q(512), _pick_block_k(512)) == (128, 512)
+    for L in (512, 1024, 2048, 4096, 4480, 8192, 8320, 16384):
         bq, bk = _pick_block_q(L), _pick_block_k(L)
         assert L % bq == 0 and L % bk == 0
         assert bk % bq == 0 or bq % bk == 0

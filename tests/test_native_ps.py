@@ -347,6 +347,52 @@ def test_native_transport_trains_with_int8_compression():
     assert final_loss(t) < 0.6, final_loss(t)
 
 
+def test_native_stats_parity_with_python_ps():
+    """stats() key parity: the C++ server exposes the identical counter
+    set the Python PS does, and counts wire ops the same way (one pull,
+    one compressed pull, one raw + one int8 commit here)."""
+    from distkeras_tpu.native_ps import FlatSpec, NativePSClient
+    from distkeras_tpu.parallel.compression import Int8Codec
+
+    rng = np.random.default_rng(9)
+    center = {"w": rng.normal(size=(40, 40)).astype(np.float32)}
+    delta = {"w": rng.normal(size=(40, 40)).astype(np.float32)}
+    ps = make_server(center, DownpourMerge(), 2)
+    try:
+        c0 = make_client(ps, 0)
+        c1 = NativePSClient("127.0.0.1", ps.port, 1, FlatSpec(center),
+                            pull_compression="int8")
+        c0.pull()
+        c0.commit(0, delta)
+        c1.pull()
+        c1.commit(1, Int8Codec(min_size=1).encode(delta))
+        s = ps.stats()
+
+        py = ParameterServer(center, DownpourMerge(), 2)
+        py.pull(0)
+        py.commit(0, delta)
+        py.pull(1, compressed=True)
+        py.commit(1, delta)
+        ps_keys, py_keys = set(s), set(py.stats())
+        assert ps_keys == py_keys, ps_keys ^ py_keys
+        assert s["pulls"] == 1
+        assert s["compressed_pulls"] == 1
+        assert s["commits"] == 2
+        # payload accounting: raw pull reply moves 40·40 f32, plus the
+        # compressed pull's scales + int8 payload (protocol headers are
+        # excluded on both transports)
+        assert s["bytes_out"] >= 40 * 40 * 4 + 40 * 40
+        assert s["bytes_in"] >= 40 * 40 * 4 + 40 * 40
+        # 2 pull snapshots + 2 commit folds under the center mutex
+        assert s["center_lock_acquires"] == 4
+        assert s["center_lock_mean_hold_ns"] >= 0
+        assert s["pulls_per_sec"] > 0 and s["commits_per_sec"] > 0
+        c0.close()
+        c1.close()
+    finally:
+        ps.stop()
+
+
 def test_native_ema_matches_python_ps(rng):
     """The C++ per-commit EMA fold equals the Python PS's, commit for
     commit (same decay, same fold sequence)."""

@@ -97,6 +97,110 @@ def test_ps_staleness_tracking_dynsgd():
     assert np.allclose(ps.get_model()["w"], 3.0 + 4.0 + 1.0)
 
 
+def test_ps_concurrent_mixed_compressed_pulls_and_commits():
+    """The decontended hot path under real interleaving: ≥4 threads doing
+    mixed compressed pulls + commits against ONE in-process PS must (a)
+    neither deadlock nor raise, (b) count every commit exactly once, and
+    (c) keep the per-worker error-feedback residuals telescoping — after
+    the storm, a worker's decoded compressed-pull stream still converges
+    to the (now static) true center, i.e. the interleaving never corrupted
+    its residual."""
+    from distkeras_tpu.parallel.compression import maybe_decode
+
+    W, ROUNDS = 4, 24
+    rng = np.random.default_rng(11)
+    center = {"w": rng.normal(size=(64, 32)).astype(np.float32),
+              "b": rng.normal(size=(17,)).astype(np.float32)}
+    ps = ParameterServer(center, DownpourMerge(), num_workers=W)
+    delta = {"w": np.full((64, 32), 1e-3, np.float32),
+             "b": np.full((17,), 1e-3, np.float32)}
+    errors = []
+
+    def worker(i):
+        try:
+            for r in range(ROUNDS):
+                dec = maybe_decode(ps.pull(i, compressed=True))
+                assert dec["w"].shape == (64, 32)
+                if r % 3 == 0:
+                    ps.pull(i)  # mix exact pulls into the interleaving
+                ps.commit(i, delta)
+        except BaseException as e:  # pragma: no cover - fails the test
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(W)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "deadlocked"
+    assert not errors, errors
+    # (b) every commit folded exactly once
+    assert ps.num_updates == W * ROUNDS
+    final = ps.get_model()
+    np.testing.assert_allclose(final["w"], center["w"] + W * ROUNDS * 1e-3,
+                               atol=1e-4)
+    # (c) telescoping survived the interleaving: worker 0's residual is
+    # whatever the storm left it, but the EF recurrence bounds it by half
+    # a quantization step, so the running mean of T more decoded pulls
+    # converges to the static center at O(1/T) — far below one pull's
+    # quantization error
+    T = 64
+    acc = None
+    for _ in range(T):
+        dec = maybe_decode(ps.pull(0, compressed=True))
+        leaf = np.concatenate([np.ravel(dec["w"]), np.ravel(dec["b"])])
+        acc = leaf if acc is None else acc + leaf
+    true = np.concatenate([np.ravel(final["w"]), np.ravel(final["b"])])
+    amax = float(np.max(np.abs(true)))
+    one_pull_err = amax / 127.0 * 0.51
+    assert float(np.max(np.abs(acc / T - true))) <= one_pull_err / 8
+    # residual state exists for every worker that compressed-pulled
+    assert set(ps._pull_errors) == set(range(W))
+
+
+def test_ps_stats_counters():
+    """stats() counts ops/bytes and reports center-lock hold time; the
+    center lock's critical sections must stay cheap (no O(model) encode)."""
+    center = {"w": np.zeros((256, 64), np.float32)}
+    ps = ParameterServer(center, DownpourMerge(), num_workers=2)
+    ps.pull(0)
+    ps.pull(0, compressed=True)
+    ps.commit(0, {"w": np.ones((256, 64), np.float32)})
+    s = ps.stats()
+    assert s["pulls"] == 1
+    assert s["compressed_pulls"] == 1
+    assert s["commits"] == 1
+    # raw pull moves the full tree; the compressed pull ~1/4 of it
+    assert s["bytes_out"] >= 256 * 64 * 4 + 256 * 64
+    assert s["bytes_in"] == 256 * 64 * 4
+    # pull + commit acquire the center lock once each; compressed pull's
+    # encode runs OUTSIDE it (per-worker lock), so at most a handful of
+    # acquires ever happen
+    assert 3 <= s["center_lock_acquires"] <= 6
+    assert s["center_lock_hold_ns"] >= 0
+    assert s["center_lock_mean_hold_ns"] >= 0
+    assert s["pulls_per_sec"] > 0 and s["commits_per_sec"] > 0
+    assert s["elapsed_s"] > 0
+
+
+def test_socket_ps_stats_served_over_wire():
+    """The socket PS inherits the counters: wire pulls/commits land in the
+    same stats() the in-process PS reports."""
+    center = {"w": np.zeros(8, np.float32)}
+    ps = SocketParameterServer(center, ADAGMerge(), num_workers=1)
+    ps.initialize()
+    ps.start()
+    try:
+        c = ParameterServerClient("127.0.0.1", ps.port, 0)
+        c.pull()
+        c.commit(0, {"w": np.ones(8, np.float32)})
+        c.close()
+        s = ps.stats()
+        assert s["pulls"] == 1 and s["commits"] == 1
+    finally:
+        ps.stop()
+
+
 def test_socket_ps_pull_commit_concurrent():
     center = {"w": np.zeros(4, np.float32), "b": np.zeros(2, np.float32)}
     ps = SocketParameterServer(center, ADAGMerge(), num_workers=4)

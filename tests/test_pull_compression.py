@@ -124,6 +124,85 @@ def test_socket_transport_compressed_pull():
         ps.stop()
 
 
+def test_socket_compressed_pull_rolls_back_residual_on_dropped_reply(
+        monkeypatch):
+    """A reply the client never received must not advance its EF residual
+    (parity with the dkps.cpp PULL_INT8 send-failure rollback): after an
+    injected send failure, a reconnecting client's first successful pull
+    decodes exactly what a never-failed server would have sent."""
+    from distkeras_tpu import networking
+    from distkeras_tpu.parallel.compression import is_encoded as _enc
+
+    center = _center(7)
+    ps = SocketParameterServer(center, ADAGMerge(), num_workers=1)
+    oracle = ParameterServer(center, ADAGMerge(), num_workers=1)
+    orig = networking.send_data
+    state = {"failed": False}
+
+    def flaky(conn, payload):
+        if (not state["failed"] and isinstance(payload, dict)
+                and _enc(payload.get("weights"))):
+            state["failed"] = True
+            raise ConnectionError("injected mid-reply drop")
+        return orig(conn, payload)
+
+    monkeypatch.setattr(networking, "send_data", flaky)
+    ps.initialize()
+    ps.start()
+    try:
+        cli = ParameterServerClient("127.0.0.1", ps.port, 0,
+                                    pull_compression="int8")
+        with pytest.raises((ConnectionError, EOFError, OSError)):
+            cli.pull()  # server residual advanced, reply dropped, rolled back
+        assert state["failed"]
+        cli2 = ParameterServerClient("127.0.0.1", ps.port, 0,
+                                     pull_compression="int8")
+        got = cli2.pull()
+        want = maybe_decode(oracle.pull(0, compressed=True))
+        np.testing.assert_array_equal(got["dense"]["w"], want["dense"]["w"])
+        np.testing.assert_array_equal(got["dense"]["b"], want["dense"]["b"])
+        cli2.close()
+    finally:
+        ps.stop()
+
+
+def test_compressed_pull_subnormal_leaf_keeps_residual_finite():
+    """A leaf whose absmax underflows f32 at scale granularity (amax/127
+    subnormal or zero in f32) must not poison the error-feedback residual
+    with inf/NaN: the encode takes the guarded clipped path, the decoded
+    leaf is ~0, and the magnitude stays in the residual — repeated pulls
+    stay finite (regression for the no-clip fast path's domain bound)."""
+    center = {"tiny": np.array([1e-44, -5e-45, 0.0, 2e-42], np.float32),
+              "normal": np.array([1.0, -2.0], np.float32)}
+    ps = ParameterServer(center, ADAGMerge(), num_workers=1)
+    for _ in range(4):
+        dec = maybe_decode(ps.pull(0, compressed=True))
+        assert np.all(np.isfinite(dec["tiny"])), dec["tiny"]
+        assert np.all(np.isfinite(dec["normal"]))
+        st = ps._pull_errors[0]
+        assert all(np.all(np.isfinite(e)) for e in st.err if e is not None)
+    # the normal leaf still round-trips accurately
+    amax = 2.0
+    assert np.max(np.abs(dec["normal"] - center["normal"])) <= amax / 127
+
+
+def test_commit_bytes_counted_at_wire_size():
+    """stats()['bytes_in'] reports the ENCODED size for codec commits (the
+    compression win must be visible in the counters, matching the native
+    server's wire accounting), and the dense size for raw commits."""
+    from distkeras_tpu.parallel.compression import Int8Codec
+
+    center = {"w": np.zeros((64, 64), np.float32)}
+    delta = {"w": np.ones((64, 64), np.float32)}
+    ps = ParameterServer(center, ADAGMerge(), num_workers=1)
+    ps.commit(0, delta)
+    dense = 64 * 64 * 4
+    assert ps.stats()["bytes_in"] == dense
+    ps.commit(0, Int8Codec(min_size=1).encode(delta))
+    extra = ps.stats()["bytes_in"] - dense
+    assert 64 * 64 <= extra <= 64 * 64 + 64  # q bytes + scalar fields
+
+
 def test_socket_client_rejects_bad_pull_compression():
     with pytest.raises(ValueError, match="pull_compression"):
         ParameterServerClient("127.0.0.1", 1, 0, pull_compression="fp4")
@@ -176,6 +255,35 @@ def test_native_transport_compressed_pull(native_lib):
         np.testing.assert_array_equal(exact["a"], center["a"])
         cli.close()
         cli2.close()
+    finally:
+        ps.stop()
+
+
+def test_native_compressed_pull_subnormal_block_stays_finite(native_lib):
+    """C++ twin of the subnormal-scale guard: a block whose absmax makes
+    1/scale overflow must decode to finite (~0) values, not NaN/garbage
+    from an undefined int8 cast, and keep telescoping on later pulls."""
+    from distkeras_tpu.native_ps import (
+        FlatSpec,
+        NativePSClient,
+        NativeSocketParameterServer,
+    )
+
+    center = {"tiny": np.array([1e-44, -5e-45, 0.0, 2e-42] * 8, np.float32),
+              "pad": np.zeros(1024 - 32, np.float32),
+              "normal": np.full(64, 1.5, np.float32)}
+    ps = NativeSocketParameterServer(center, ADAGMerge(), num_workers=1)
+    ps.initialize()
+    ps.start()
+    try:
+        cli = NativePSClient("127.0.0.1", ps.port, 0, FlatSpec(center),
+                             pull_compression="int8")
+        for _ in range(3):
+            dec = cli.pull()
+            assert np.all(np.isfinite(dec["tiny"])), dec["tiny"]
+            assert np.all(np.isfinite(dec["normal"]))
+            assert np.max(np.abs(dec["normal"] - 1.5)) <= 1.5 / 127 * 1.01
+        cli.close()
     finally:
         ps.stop()
 
