@@ -1245,6 +1245,83 @@ def run_ps_microbench(n_params=10_000_000, workers=4, seconds=4.0,
     return out
 
 
+def run_ps_chaos_bench(n_params=1_000_000, workers=4, seconds=4.0,
+                       drop_recv=0.02, delay=0.05, delay_s=0.002, seed=0):
+    """PS throughput under injected chaos (--chaos): the same mixed
+    pull+commit hammer as --ps-bench, but over the socket transport with a
+    seeded FaultPlan dropping replies and delaying frames, the clients
+    wrapped in ResilientPSClient (reconnect + retry + seqno'd commits +
+    heartbeats). Reports the surviving round rate plus the resilience
+    counters, and asserts the dedup oracle: folds applied == logical
+    commits issued, no matter how many retries replayed."""
+    from distkeras_tpu.parallel.merge_rules import DownpourMerge
+    from distkeras_tpu.parameter_servers import (
+        ParameterServerClient,
+        SocketParameterServer,
+    )
+    from distkeras_tpu.resilience import FaultPlan, ResilientPSClient, RetryPolicy
+
+    center = _ps_bench_tree(n_params)
+    delta = {
+        "emb": np.full_like(center["emb"], 1e-6),
+        "dense": {"w": np.full_like(center["dense"]["w"], 1e-6),
+                  "b": np.full_like(center["dense"]["b"], 1e-6)},
+    }
+    log(f"[ps-chaos] socket + faults: {workers} workers, "
+        f"{n_params / 1e6:.1f}M params, drop_recv={drop_recv}, "
+        f"delay={delay}@{delay_s * 1e3:.0f}ms")
+    ps = SocketParameterServer(center, DownpourMerge(), workers,
+                               lease_timeout=1.0)
+    ps.initialize()
+    ps.start()
+    policy = RetryPolicy(base_delay=0.01, max_delay=0.2, deadline=60.0,
+                         seed=seed)
+    clients = [
+        ResilientPSClient(
+            lambda i=i: ParameterServerClient("127.0.0.1", ps.port, i),
+            i, policy=policy, heartbeat_interval=0.2,
+        )
+        for i in range(workers)
+    ]
+    plan = FaultPlan(seed=seed, drop_recv=drop_recv, delay=delay,
+                     delay_s=delay_s)
+    try:
+        with plan:
+            def op(c, i):
+                c.pull()
+                c.commit(i, delta)
+                c.maybe_heartbeat()
+
+            rounds, t = _ps_bench_phase(clients, op, seconds)
+        logical = sum(c.seq for c in clients)
+        s = ps.stats()
+        rec = {
+            "config": "ps_chaos_socket",
+            "workers": workers,
+            "params": n_params,
+            "rounds_per_sec": round(rounds / t, 2),
+            "logical_commits": logical,
+            "applied_commits": s["commits"],
+            "dup_commits": s["dup_commits"],
+            "dedup_exact_once": s["commits"] == logical,
+            "retries": sum(c.retries for c in clients),
+            "evicted_workers": s["evicted_workers"],
+            "heartbeats": s["heartbeats"],
+            "faults": plan.stats(),
+        }
+        if not rec["dedup_exact_once"]:
+            rec["invalid"] = True  # the oracle failing is a bug, not noise
+        log(json.dumps(rec))
+        return {"ps_chaos_socket": rec}
+    finally:
+        for c in clients:
+            try:
+                c.close()
+            except OSError:
+                pass
+        ps.stop()
+
+
 def run_proxy_only():
     """CPU-proxy denominator as a standalone process (spawned by main with
     ``JAX_PLATFORMS=cpu``): the ~550 s XLA:CPU compile+epochs run CONCURRENTLY
@@ -1306,13 +1383,35 @@ def main():
                     help="PS microbenchmark worker-thread count")
     ap.add_argument("--ps-bench-seconds", type=float, default=4.0,
                     help="PS microbenchmark seconds per phase")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run ONLY the PS chaos benchmark (socket transport "
+                         "under injected drops/delays with retry + seqno "
+                         "dedup + heartbeats; asserts exactly-once folds)")
+    ap.add_argument("--chaos-params", type=int, default=1_000_000,
+                    help="chaos benchmark tree size in float32 params")
     args = ap.parse_args()
 
-    if args.ps_bench:
-        # pure host-side numpy/threading — no accelerator, no proxy
-        run_ps_microbench(n_params=args.ps_bench_params,
-                          workers=args.ps_bench_workers,
-                          seconds=args.ps_bench_seconds)
+    if args.ps_bench or args.chaos:
+        # pure host-side numpy/threading — no accelerator, no proxy. Per-leg
+        # records stream to stderr; ONE headline JSON blob lands on stdout
+        # (same contract as the training headline), so the BENCH_*.json
+        # trajectory files capture PS perf history instead of staying empty.
+        legs = {}
+        if args.ps_bench:
+            legs.update(run_ps_microbench(n_params=args.ps_bench_params,
+                                          workers=args.ps_bench_workers,
+                                          seconds=args.ps_bench_seconds))
+        if args.chaos:
+            legs.update(run_ps_chaos_bench(n_params=args.chaos_params,
+                                           workers=args.ps_bench_workers,
+                                           seconds=args.ps_bench_seconds))
+        print(json.dumps({
+            "metric": "ps_bench",
+            "unit": "ops/sec",
+            "workers": args.ps_bench_workers,
+            "legs": legs,
+        }))
+        sys.stdout.flush()
         return
     t_start = time.perf_counter()
     # Elapsed-time budget for the beyond-reference legs (VERDICT r3 #1: the

@@ -32,6 +32,10 @@ os.environ.setdefault("KERAS_BACKEND", "jax")
 __version__ = "0.1.0"
 
 from distkeras_tpu import utils  # noqa: E402
+from distkeras_tpu.resilience import (  # noqa: E402
+    FaultPlan,
+    RetryPolicy,
+)
 from distkeras_tpu.trainers import (  # noqa: E402
     ADAG,
     AEASGD,
@@ -49,7 +53,9 @@ __all__ = [
     "DOWNPOUR",
     "DynSGD",
     "EAMSGD",
+    "FaultPlan",
     "MeshTrainer",
+    "RetryPolicy",
     "SingleTrainer",
     "Trainer",
     "utils",

@@ -306,7 +306,13 @@ def _restore_sharded(directory, step: int) -> Pytree:
     meta_path = _meta_file(directory, step)
     if not meta_path.exists():
         raise FileNotFoundError(f"no checkpoint {step} under {directory}")
-    meta = pickle.loads(meta_path.read_bytes())
+    try:
+        meta = pickle.loads(meta_path.read_bytes())
+    except Exception as e:
+        raise ValueError(
+            f"checkpoint {step} meta file {meta_path.name} is truncated "
+            f"or corrupt ({type(e).__name__}: {e})"
+        ) from e
     leaves = [np.zeros(s, d) for s, d in zip(meta["shapes"], meta["dtypes"])]
     covered = [0] * len(leaves)
     seen: set = set()
@@ -318,7 +324,16 @@ def _restore_sharded(directory, step: int) -> Pytree:
                 f"checkpoint {step} is missing shard file {path.name} "
                 f"(wrote from {pcount} processes)"
             )
-        payload = pickle.loads(path.read_bytes())
+        try:
+            payload = pickle.loads(path.read_bytes())
+        except Exception as e:
+            # a torn write (crash mid-copy on a non-atomic filesystem) or
+            # bit rot: name the file — "unpickling stack underflow" alone
+            # sends the operator grepping the wrong layer
+            raise ValueError(
+                f"checkpoint {step} shard file {path.name} is truncated "
+                f"or corrupt ({type(e).__name__}: {e})"
+            ) from e
         for (i, starts), data in payload["shards"].items():
             if (i, starts) in seen:
                 continue  # replicated across processes

@@ -55,7 +55,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dkps_server_create.restype = ctypes.c_void_p
     lib.dkps_server_create.argtypes = [
         f32p, ctypes.c_uint64, ctypes.c_int, ctypes.c_double,
-        ctypes.c_char_p, ctypes.c_int, ctypes.c_double,
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_double, ctypes.c_double,
     ]
     lib.dkps_server_port.restype = ctypes.c_int
     lib.dkps_server_port.argtypes = [ctypes.c_void_p]
@@ -102,6 +102,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int8),
         ctypes.POINTER(ctypes.c_uint64), f32p, ctypes.c_uint32,
     ]
+    lib.dkps_client_commit_seq.restype = ctypes.c_int
+    lib.dkps_client_commit_seq.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, f32p,
+    ]
+    lib.dkps_client_heartbeat.restype = ctypes.c_int
+    lib.dkps_client_heartbeat.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.dkps_client_deregister.restype = ctypes.c_int
+    lib.dkps_client_deregister.argtypes = [ctypes.c_void_p]
     lib.dkps_client_close.restype = None
     lib.dkps_client_close.argtypes = [ctypes.c_void_p]
     return lib

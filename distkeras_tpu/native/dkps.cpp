@@ -38,10 +38,25 @@
 //              f32 absmax scale per block and keeps the per-worker
 //              quantization residual server-side — DoubleSqueeze-style
 //              bidirectional compression, Tang et al. 2019; with int8
-//              commits the round-trip moves ~2n bytes instead of 8n)
+//              commits the round-trip moves ~2n bytes instead of 8n),
+//              6=HEARTBEAT (u32 cumulative client retry count: renews the
+//              worker's liveness lease, auto-registering — protocol parity
+//              with the Python PS's "heartbeat" action; a worker whose
+//              lease lapses past the server's lease_timeout is EVICTED:
+//              counted in stats and its pull_version forgotten, so DynSGD
+//              treats a zombie commit as maximally stale),
+//              7=COMMIT_SEQ (u64 per-worker seqno + n*4 payload bytes:
+//              the retry-safe commit — the server folds each (worker,
+//              seq) at most once, so a client replaying a commit whose
+//              ACK died cannot double-fold it; parity with the Python
+//              PS's "seq"-carrying commit),
+//              8=DEREGISTER (clean worker exit: drop the lease without
+//              counting an eviction)
 //   reply:     PULL -> u64 center_version + n*4 bytes; COMMIT -> u8 ack;
 //              PULL_INT8 -> u64 version + u32 nblocks + nblocks*f32 scales
-//              + n int8 bytes
+//              + n int8 bytes; HEARTBEAT -> u8 (1 = renewed, 2 =
+//              (re-)registered); COMMIT_SEQ -> u8 (1 = folded, 2 =
+//              duplicate, dropped); DEREGISTER -> u8 ack
 //
 // Concurrency model matches the reference: accept loop + one handler thread
 // per connection + one mutex around the center. The difference is what runs
@@ -142,6 +157,100 @@ struct Server {
     std::vector<float> err;
   };
   std::unordered_map<uint32_t, PullErr> pull_errors;
+
+  // Per-worker last APPLIED commit seqno (COMMIT_SEQ dedup) — under mu,
+  // probed once per seq'd commit, so the fold's critical section stays
+  // O(fold) + O(1).
+  std::unordered_map<uint32_t, uint64_t> last_seq;
+
+  // Liveness leases (HEARTBEAT/DEREGISTER; parity with the Python PS's
+  // resilience/heartbeat.py registry): renewed by heartbeats, scanned
+  // lazily (rate-limited to a quarter lease) under their OWN mutex —
+  // never while holding mu; eviction then takes mu to forget the dead
+  // worker's pull_version (zombie commits read as maximally stale).
+  struct Lease {
+    uint64_t deadline_ns = 0;
+    uint64_t renewals = 0;
+  };
+  double lease_timeout_s = 30.0;
+  std::mutex lease_mu;
+  std::unordered_map<uint32_t, Lease> leases;
+  uint64_t next_expiry_ns = 0;            // under lease_mu
+  // Latest cumulative client-reported retry count per worker id, kept
+  // across lease lifecycles (clients report running totals; folding into
+  // a sum at eviction would double-count after re-admission). Under
+  // lease_mu; summed at stats time.
+  std::unordered_map<uint32_t, uint32_t> retries_by_wid;
+  std::atomic<uint64_t> st_heartbeats{0}, st_evicted{0}, st_dups{0};
+
+  static uint64_t now_ns() {
+    return static_cast<uint64_t>(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now().time_since_epoch())
+            .count());
+  }
+
+  // Evict lapsed leases (rate-limited on the hot path; force=true skips
+  // the limiter so observability reads never see a lapsed lease as
+  // live). Lock order: lease_mu released BEFORE mu is taken for the
+  // pull_version cleanup.
+  void expire_leases(bool force = false) {
+    const uint64_t now = now_ns();
+    std::vector<uint32_t> dead;
+    {
+      std::lock_guard<std::mutex> g(lease_mu);
+      if (!force && now < next_expiry_ns) return;
+      const uint64_t every = static_cast<uint64_t>(
+          std::max(lease_timeout_s / 4.0, 1e-3) * 1e9);
+      next_expiry_ns = now + every;
+      for (auto it = leases.begin(); it != leases.end();) {
+        if (it->second.deadline_ns < now) {
+          dead.push_back(it->first);
+          it = leases.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      st_evicted += dead.size();
+    }
+    if (!dead.empty()) {
+      std::lock_guard<std::mutex> g(mu);
+      for (uint32_t wid : dead) pull_versions.erase(wid);
+    }
+  }
+
+  // returns true when the lease already existed (a renewal)
+  bool heartbeat(uint32_t wid, uint32_t retries) {
+    const uint64_t deadline =
+        now_ns() + static_cast<uint64_t>(lease_timeout_s * 1e9);
+    bool known;
+    {
+      std::lock_guard<std::mutex> g(lease_mu);
+      st_heartbeats += 1;
+      auto it = leases.find(wid);
+      known = it != leases.end();
+      Lease& l = known ? it->second : leases[wid];
+      l.deadline_ns = deadline;
+      l.renewals += 1;
+      if (retries) {
+        uint32_t& r = retries_by_wid[wid];
+        r = std::max(r, retries);
+      }
+    }
+    expire_leases();
+    return known;
+  }
+
+  void deregister(uint32_t wid) {
+    {
+      std::lock_guard<std::mutex> g(lease_mu);
+      leases.erase(wid);
+    }
+    // retire the seqno fence too (fresh clients start a new epoch; the
+    // fence would only grow the map) — lease_mu released before mu
+    std::lock_guard<std::mutex> g(mu);
+    last_seq.erase(wid);
+  }
 
   // Contention/throughput counters (parity with the Python PS's stats():
   // same semantics, read via dkps_server_stats). Atomics: bumped from
@@ -372,6 +481,43 @@ struct Server {
         st_commits += 1;
         st_bytes_in += static_cast<uint64_t>(segs) * 12 + n;
         if (!send_all(fd, &ack, 1)) break;
+      } else if (action == 7) {  // COMMIT_SEQ: retry-safe seq'd commit
+        uint64_t seq;
+        if (!recv_all(fd, &seq, 8)) break;
+        if (!recv_all(fd, buf.data(), n * sizeof(float))) break;
+        bool dup;
+        {
+          StatGuard g(this);
+          uint64_t& last = last_seq[conn_wid_];
+          dup = seq <= last;
+          if (!dup) {
+            last = seq;
+            const float s = fold_scale_locked();
+            float* c = center.data();
+            const float* d = buf.data();
+            for (uint64_t i = 0; i < n; ++i) c[i] += d[i] * s;
+            ema_fold_locked();
+            num_updates += 1;
+          }
+        }
+        if (dup) {
+          st_dups += 1;
+        } else {
+          st_commits += 1;
+        }
+        st_bytes_in += n * sizeof(float);
+        uint8_t ack = dup ? 2 : 1;
+        if (!send_all(fd, &ack, 1)) break;
+      } else if (action == 6) {  // HEARTBEAT: lease renewal
+        uint32_t retries;
+        if (!recv_all(fd, &retries, 4)) break;
+        const bool known = heartbeat(conn_wid_, retries);
+        uint8_t ack = known ? 1 : 2;
+        if (!send_all(fd, &ack, 1)) break;
+      } else if (action == 8) {  // DEREGISTER: clean exit, no eviction
+        deregister(conn_wid_);
+        uint8_t ack = 1;
+        if (!send_all(fd, &ack, 1)) break;
       } else {  // BYE or garbage: drop the connection either way
         break;
       }
@@ -434,7 +580,7 @@ extern "C" {
 
 void* dkps_server_create(const float* init, uint64_t n, int mode,
                          double fixed_scale, const char* host, int port,
-                         double ema_decay) {
+                         double ema_decay, double lease_timeout) {
   auto* s = new Server();
   s->center.assign(init, init + n);
   s->n = n;
@@ -442,6 +588,9 @@ void* dkps_server_create(const float* init, uint64_t n, int mode,
   s->fixed_scale = fixed_scale;
   s->ema_decay = ema_decay;
   if (ema_decay >= 0) s->ema = s->center;
+  // lease_timeout <= 0 keeps the 30 s default (leases only matter once a
+  // client heartbeats — a heartbeat-free run never evicts anything)
+  if (lease_timeout > 0) s->lease_timeout_s = lease_timeout;
 
   s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) {
@@ -570,12 +719,16 @@ void dkps_server_record_pull(void* h, uint32_t wid) {
 }
 
 // Contention/throughput counters (parity with the Python PS's stats()).
-// Fills out[8]: pulls, compressed_pulls, commits, bytes_in, bytes_out,
-// center_lock_acquires, center_lock_wait_ns, center_lock_hold_ns.
-// Lock-free reads of monotone atomics: values may lag in-flight ops by
-// one — telemetry semantics, same as the Python side.
+// Fills out[13]: pulls, compressed_pulls, commits, bytes_in, bytes_out,
+// center_lock_acquires, center_lock_wait_ns, center_lock_hold_ns,
+// dup_commits, active_workers, evicted_workers, heartbeats,
+// worker_retries. Runs a FORCED expiry pass first (a stats read must see
+// already-lapsed leases as evicted — no rate-limit window); the counter
+// reads stay lock-free atomics and may lag in-flight ops by one —
+// telemetry semantics, same as the Python side.
 void dkps_server_stats(void* h, uint64_t* out) {
   auto* s = static_cast<Server*>(h);
+  s->expire_leases(/*force=*/true);
   out[0] = s->st_pulls.load();
   out[1] = s->st_cpulls.load();
   out[2] = s->st_commits.load();
@@ -584,6 +737,16 @@ void dkps_server_stats(void* h, uint64_t* out) {
   out[5] = s->st_lock_acquires.load();
   out[6] = s->st_lock_wait_ns.load();
   out[7] = s->st_lock_hold_ns.load();
+  out[8] = s->st_dups.load();
+  {
+    std::lock_guard<std::mutex> g(s->lease_mu);
+    uint64_t retries = 0;
+    for (const auto& kv : s->retries_by_wid) retries += kv.second;
+    out[9] = s->leases.size();
+    out[10] = s->st_evicted.load();
+    out[11] = s->st_heartbeats.load();
+    out[12] = retries;
+  }
 }
 
 // ---------------------------------------------------------------- client --
@@ -672,6 +835,48 @@ int dkps_client_commit_int8(void* h, const int8_t* q, const uint64_t* lens,
   uint8_t ack = 0;
   if (!send_all(c->fd, header.data(), header.size()) ||
       !send_all(c->fd, q, c->n) || !recv_all(c->fd, &ack, 1) || ack != 1)
+    return -1;
+  return 0;
+}
+
+// seq'd commit (action 7): per-worker seqno dedup server-side — safe to
+// replay after a torn connection. Returns 0 = folded, 1 = duplicate
+// (already applied; the retry layer treats both as success), -1 =
+// transport failure.
+int dkps_client_commit_seq(void* h, uint64_t seq, const float* buf) {
+  auto* c = static_cast<Client*>(h);
+  char header[1 + 8];
+  header[0] = 7;
+  std::memcpy(header + 1, &seq, 8);
+  uint8_t ack = 0;
+  if (!send_all(c->fd, header, sizeof(header)) ||
+      !send_all(c->fd, buf, c->n * sizeof(float)) ||
+      !recv_all(c->fd, &ack, 1) || (ack != 1 && ack != 2))
+    return -1;
+  return ack == 2 ? 1 : 0;
+}
+
+// heartbeat (action 6): renew this worker's lease, reporting the client's
+// cumulative retry count. Returns 1 = renewed, 0 = (re-)registered,
+// -1 = transport failure.
+int dkps_client_heartbeat(void* h, uint32_t retries) {
+  auto* c = static_cast<Client*>(h);
+  char header[1 + 4];
+  header[0] = 6;
+  std::memcpy(header + 1, &retries, 4);
+  uint8_t ack = 0;
+  if (!send_all(c->fd, header, sizeof(header)) ||
+      !recv_all(c->fd, &ack, 1) || (ack != 1 && ack != 2))
+    return -1;
+  return ack == 1 ? 1 : 0;
+}
+
+// deregister (action 8): clean exit — drop the lease, no eviction counted
+int dkps_client_deregister(void* h) {
+  auto* c = static_cast<Client*>(h);
+  uint8_t action = 8;
+  uint8_t ack = 0;
+  if (!send_all(c->fd, &action, 1) || !recv_all(c->fd, &ack, 1) || ack != 1)
     return -1;
   return 0;
 }

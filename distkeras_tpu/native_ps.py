@@ -124,7 +124,8 @@ class NativeSocketParameterServer:
 
     def __init__(self, center: Pytree, rule: MergeRule, num_workers: int,
                  host: str = "127.0.0.1", port: int = 0,
-                 ema_decay: float | None = None):
+                 ema_decay: float | None = None,
+                 lease_timeout: float | None = None):
         self._lib = load_dkps(required=True)
         self.spec = FlatSpec(center)
         self.rule = rule
@@ -143,6 +144,14 @@ class NativeSocketParameterServer:
                     f"ema_decay must be in [0, 1), got {ema_decay}"
                 )
         self.ema_decay = ema_decay
+        # worker-lease timeout (HEARTBEAT wire action; parity with the
+        # Python PS's registry): <= 0 / None keeps the server's 30 s
+        # default — leases only bite once a client heartbeats
+        if lease_timeout is not None and lease_timeout <= 0:
+            raise ValueError(
+                f"lease_timeout must be positive, got {lease_timeout}"
+            )
+        self.lease_timeout = lease_timeout
 
     def initialize(self) -> None:
         mode, scale = fold_mode(self.rule, self.num_workers)
@@ -150,6 +159,7 @@ class NativeSocketParameterServer:
             _f32p(self._init_vec), self.spec.n, mode, scale,
             self.host.encode(), self._requested_port,
             -1.0 if self.ema_decay is None else self.ema_decay,
+            -1.0 if self.lease_timeout is None else self.lease_timeout,
         )
         if not h:
             raise OSError(
@@ -214,14 +224,15 @@ class NativeSocketParameterServer:
         the time since ``initialize()``."""
         from distkeras_tpu.parameter_servers import build_ps_stats
 
-        raw = (ctypes.c_uint64 * 8)()
+        raw = (ctypes.c_uint64 * 13)()
         self._lib.dkps_server_stats(self._handle, raw)
-        pulls, cpulls, commits, bytes_in, bytes_out, acq, wait, hold = (
-            int(v) for v in raw
-        )
+        (pulls, cpulls, commits, bytes_in, bytes_out, acq, wait, hold,
+         dups, active, evicted, heartbeats, retries) = (int(v) for v in raw)
         return build_ps_stats(
             pulls, cpulls, commits, bytes_in, bytes_out, acq, wait, hold,
-            time.monotonic() - self._t_start,
+            time.monotonic() - self._t_start, dup_commits=dups,
+            active_workers=active, evicted_workers=evicted,
+            heartbeats=heartbeats, worker_retries=retries,
         )
 
 
@@ -289,14 +300,46 @@ class NativePSClient:
             raise ConnectionError("dkps pull failed (server gone?)")
         return self.spec.unflatten(out)
 
-    def commit(self, worker_id: int | None, payload: Pytree) -> None:
+    def commit(self, worker_id: int | None, payload: Pytree,
+               seq: int | None = None) -> None:
         from distkeras_tpu.parallel.compression import is_encoded
 
         if is_encoded(payload):
+            if seq is not None:
+                # the segmented-int8 frame has no seq slot; the trainer
+                # rejects resilience+compression on the native transport
+                # up front — this guards direct callers
+                raise ValueError(
+                    "ps_transport='native' carries commit seqnos on the "
+                    "raw f32 wire only; use ps_transport='socket' to "
+                    "combine compression with retries"
+                )
             return self._commit_int8(payload)
         vec = np.ascontiguousarray(self.spec.flatten(payload))
+        if seq is not None:
+            # COMMIT_SEQ (action 7): server-side (worker, seq) dedup —
+            # replay-safe; a duplicate ack (rc 1) is success
+            rc = self._lib.dkps_client_commit_seq(
+                self._handle, int(seq), _f32p(vec)
+            )
+            if rc < 0:
+                raise ConnectionError("dkps commit failed (server gone?)")
+            return
         if self._lib.dkps_client_commit(self._handle, _f32p(vec)) != 0:
             raise ConnectionError("dkps commit failed (server gone?)")
+
+    def heartbeat(self, retries: int = 0) -> bool:
+        """Renew this worker's liveness lease (HEARTBEAT, action 6);
+        returns True when the lease already existed (a renewal)."""
+        rc = self._lib.dkps_client_heartbeat(self._handle, int(retries))
+        if rc < 0:
+            raise ConnectionError("dkps heartbeat failed (server gone?)")
+        return rc == 1
+
+    def deregister(self) -> None:
+        """Clean exit: drop this worker's lease without an eviction."""
+        if self._lib.dkps_client_deregister(self._handle) != 0:
+            raise ConnectionError("dkps deregister failed (server gone?)")
 
     def _commit_int8(self, blob: dict) -> None:
         """Ship an Int8Codec blob on the segmented-int8 wire (action 4):

@@ -35,6 +35,48 @@ _LEN = struct.Struct(">Q")
 #: far above any weight blob this framework ships in one frame.
 MAX_FRAME_BYTES = 2 * 1024 * 1024 * 1024
 
+#: Fault-injection seam (resilience/faults.py installs here): a callable
+#: ``hook(op, sock)`` with op in {"send", "recv"} invoked at the top of
+#: every framed wire operation. It may sleep (delay fault) or raise a
+#: ConnectionError subclass (drop/partition fault). None = production path,
+#: zero overhead beyond one attribute read.
+_fault_hook = None
+
+
+class ProtocolError(ConnectionError):
+    """A framed wire operation failed or produced a malformed frame.
+
+    Subclasses ConnectionError so every pre-existing ``except
+    (ConnectionError, ...)`` keeps catching it; the retry layer
+    (``resilience.retry``) looks at ``retryable`` to separate transient
+    transport failures (peer died mid-frame — reconnect and retry) from
+    protocol violations (oversized/garbled frames — a peer speaking a
+    different protocol, where retrying the same bytes can only fail again).
+    """
+
+    def __init__(self, message: str, *, frame_size: int | None = None,
+                 peer: str | None = None, retryable: bool = True):
+        ctx = []
+        if frame_size is not None:
+            ctx.append(f"frame={frame_size}B")
+        if peer:
+            ctx.append(f"peer={peer}")
+        super().__init__(f"{message} [{', '.join(ctx)}]" if ctx else message)
+        self.frame_size = frame_size
+        self.peer = peer
+        self.retryable = retryable
+
+
+def _peer_of(sock: socket.socket) -> str | None:
+    """Best-effort peer label for error context (never raises)."""
+    try:
+        peer = sock.getpeername()
+    except OSError:
+        return None
+    if isinstance(peer, tuple) and len(peer) >= 2:
+        return f"{peer[0]}:{peer[1]}"
+    return str(peer)
+
 
 class _RestrictedUnpickler(pickle.Unpickler):
     """Unpickler for control frames: primitives + numpy arrays only.
@@ -100,25 +142,43 @@ def connect(host: str, port: int, timeout: float | None = 30.0) -> socket.socket
 
 
 def send_data(sock: socket.socket, obj: Any) -> None:
+    if _fault_hook is not None:
+        _fault_hook("send", sock)
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int, expected: int | None = None) -> bytes:
+    """Read exactly ``n`` bytes; a mid-frame close raises a retryable
+    ProtocolError carrying the frame size and peer context. ``expected``
+    is the full frame length when known (body reads), so the error names
+    the frame being lost, not just the remaining bytes."""
     chunks = []
+    want = n
     while n:
         chunk = sock.recv(min(n, 1 << 20))
         if not chunk:
-            raise ConnectionError("socket closed mid-frame")
+            raise ProtocolError(
+                f"socket closed mid-frame ({want - n} of {want} bytes read)",
+                frame_size=expected if expected is not None else want,
+                peer=_peer_of(sock), retryable=True,
+            )
         chunks.append(chunk)
         n -= len(chunk)
     return b"".join(chunks)
 
 
 def recv_data(sock: socket.socket, max_bytes: int = MAX_FRAME_BYTES) -> Any:
+    if _fault_hook is not None:
+        _fault_hook("recv", sock)
     (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if length > max_bytes:
-        raise ConnectionError(
-            f"frame of {length} bytes exceeds the {max_bytes}-byte cap"
+        # NOT retryable: the peer is speaking a different (or hostile)
+        # protocol — the same frame would bust the cap on every retry
+        raise ProtocolError(
+            f"frame of {length} bytes exceeds the {max_bytes}-byte cap",
+            frame_size=int(length), peer=_peer_of(sock), retryable=False,
         )
-    return _RestrictedUnpickler(io.BytesIO(_recv_exact(sock, length))).load()
+    return _RestrictedUnpickler(
+        io.BytesIO(_recv_exact(sock, length, expected=int(length)))
+    ).load()

@@ -118,7 +118,10 @@ class ParameterServer:
     """
 
     def __init__(self, center: Pytree, rule: MergeRule, num_workers: int,
-                 ema_decay: float | None = None):
+                 ema_decay: float | None = None,
+                 lease_timeout: float | None = None):
+        from distkeras_tpu.resilience.heartbeat import WorkerRegistry
+
         self.center = utils.tree_to_numpy(center)
         self.rule = rule
         self.num_workers = int(num_workers)
@@ -127,6 +130,25 @@ class ParameterServer:
         # module docstring for the full locking discipline
         self._lock = _TimedLock()
         self._pull_versions: dict[int, int] = {}
+        # Liveness: worker leases renewed by heartbeats (resilience/
+        # heartbeat.py). Workers that never heartbeat are never leased, so
+        # nothing ever expires — legacy runs see zero overhead/behavior
+        # change. Eviction clears the worker's pull version (under the
+        # center lock — the registry holds no lock while calling back), so
+        # a zombie's post-eviction commit shows DynSGD the FULL center
+        # history as its staleness and gets down-weighted to ~nothing.
+        self.lease_timeout = (
+            30.0 if lease_timeout is None else float(lease_timeout)
+        )
+        self._registry = WorkerRegistry(
+            self.lease_timeout, on_evict=self._on_evict
+        )
+        # Commit dedup (resilience/retry.py): per-worker last APPLIED
+        # seqno; a replayed commit (same worker, seq <= last) is counted,
+        # not folded — the lost-ACK retry can never double-fold. Guarded
+        # by the center lock (the check is one dict probe, O(1)).
+        self._last_seq: dict[int, int] = {}
+        self._n_dup_commits = 0
         # Polyak/EMA averaging of the center, updated per commit (the
         # classic async-SGD companion — the EASGD paper evaluates the
         # averaged center). None = off; read with get_ema().
@@ -320,7 +342,8 @@ class ParameterServer:
         return ({_MARK: "int8", "tree": jax.tree.unflatten(treedef, enc)},
                 nbytes)
 
-    def commit(self, worker_id: int, payload: Pytree) -> None:
+    def commit(self, worker_id: int, payload: Pytree,
+               seq: int | None = None) -> bool:
         """Fold one worker's commit into the center under the center lock.
 
         Commits may arrive codec-compressed (``parallel.compression`` —
@@ -329,19 +352,40 @@ class ParameterServer:
         before the lock and the per-commit EMA fold after it (under the
         EMA lock, against the just-published snapshot) — the center lock's
         critical section is exactly the fold.
+
+        ``seq`` (per-worker, monotone, assigned by the resilient client)
+        makes the fold exactly-once under retries: a (worker, seq) pair
+        already applied is counted as a duplicate and skipped — the
+        retried-after-lost-ACK commit never double-folds. ``seq=None``
+        (legacy callers) keeps at-most-once-per-call semantics. Returns
+        True when the commit folded, False when it was a duplicate.
         """
         nbytes = self._payload_nbytes(payload)  # wire size: BEFORE decode
         payload = maybe_decode(payload)
         with self._lock:
-            staleness = self.num_updates - self._pull_versions.get(worker_id, 0)
-            self.center = utils.tree_to_numpy(
-                self.rule.fold(
-                    self.center, payload, self.num_workers, staleness
+            if seq is not None:
+                if seq <= self._last_seq.get(worker_id, 0):
+                    dup = True
+                else:
+                    self._last_seq[worker_id] = seq
+                    dup = False
+            else:
+                dup = False
+            if not dup:
+                staleness = (
+                    self.num_updates - self._pull_versions.get(worker_id, 0)
                 )
-            )
-            self.num_updates += 1
-            version = self.num_updates
-            snap = self.center
+                self.center = utils.tree_to_numpy(
+                    self.rule.fold(
+                        self.center, payload, self.num_workers, staleness
+                    )
+                )
+                self.num_updates += 1
+                version = self.num_updates
+                snap = self.center
+        if dup:
+            self._count(dup_commits=1, bytes_in=nbytes)
+            return False
         self._count(commits=1, bytes_in=nbytes)
         if self._ema is not None:
             d = self.ema_decay
@@ -359,11 +403,37 @@ class ParameterServer:
                 if version > self._ema_version:
                     self._ema_version = version
                     _tree_map(fma, self._ema, snap, self._ema_scratch)
+        return True
 
     def get_model(self) -> Pytree:
         with self._lock:
             snap = self.center
         return jax_tree_copy(snap)  # snapshot is immutable; copy off-lock
+
+    # -- liveness (leases + heartbeats; resilience/heartbeat.py) -------------
+
+    def heartbeat(self, worker_id: int, retries: int = 0) -> bool:
+        """Renew (auto-registering) ``worker_id``'s lease; ``retries`` is
+        the client's cumulative retry count, surfaced in ``stats()``.
+        Returns False when this heartbeat (re-)registered the worker —
+        i.e. it was unknown or had been evicted."""
+        return self._registry.renew(worker_id, retries=retries)
+
+    def deregister_worker(self, worker_id: int) -> None:
+        """Clean worker exit: drop the lease without counting an eviction,
+        and retire the commit-seqno fence (a future client for this worker
+        id starts a fresh epoch; keeping the fence would only grow the
+        map)."""
+        self._registry.deregister(worker_id)
+        with self._lock:
+            self._last_seq.pop(worker_id, None)
+
+    def _on_evict(self, worker_ids: list[int]) -> None:
+        """Lease expiry → forget the workers' pull versions, so DynSGD
+        treats any zombie commit as maximally stale (τ = num_updates)."""
+        with self._lock:
+            for wid in worker_ids:
+                self._pull_versions.pop(wid, None)
 
     def get_ema(self) -> Pytree:
         """The Polyak-averaged center (None unless ``ema_decay`` was set)."""
@@ -421,13 +491,14 @@ class ParameterServer:
         return total
 
     def _count(self, pulls=0, compressed_pulls=0, commits=0,
-               bytes_in=0, bytes_out=0):
+               bytes_in=0, bytes_out=0, dup_commits=0):
         with self._stats_lock:
             self._n_pulls += pulls
             self._n_compressed_pulls += compressed_pulls
             self._n_commits += commits
             self._bytes_in += bytes_in
             self._bytes_out += bytes_out
+            self._n_dup_commits += dup_commits
 
     def stats(self) -> dict:
         """Contention + throughput counters (cheap, approximate under load).
@@ -446,6 +517,10 @@ class ParameterServer:
           that proves the critical sections stayed O(fold).
         - ``elapsed_s``, ``pulls_per_sec``, ``commits_per_sec``: since
           construction (compressed pulls count toward the pull rate).
+        - resilience counters: ``dup_commits`` (replayed commits the seqno
+          dedup refused to double-fold), ``active_workers`` /
+          ``evicted_workers`` / ``heartbeats`` / ``worker_retries`` (the
+          lease registry — see resilience/heartbeat.py).
         """
         elapsed = time.monotonic() - self._t_start
         with self._stats_lock:
@@ -453,21 +528,30 @@ class ParameterServer:
             cpulls = self._n_compressed_pulls
             commits = self._n_commits
             bytes_in, bytes_out = self._bytes_in, self._bytes_out
+            dups = self._n_dup_commits
+        hb = self._registry.stats()
         return build_ps_stats(
             pulls, cpulls, commits, bytes_in, bytes_out,
             self._lock.acquires, self._lock.wait_ns, self._lock.hold_ns,
-            elapsed,
+            elapsed, dup_commits=dups,
+            active_workers=hb["active_workers"],
+            evicted_workers=hb["evicted_workers"],
+            heartbeats=hb["heartbeats"],
+            worker_retries=hb["worker_retries"],
         )
 
 
 def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
                    bytes_in: int, bytes_out: int, lock_acquires: int,
                    lock_wait_ns: int, lock_hold_ns: int,
-                   elapsed_s: float) -> dict:
+                   elapsed_s: float, dup_commits: int = 0,
+                   active_workers: int = 0, evicted_workers: int = 0,
+                   heartbeats: int = 0, worker_retries: int = 0) -> dict:
     """The ONE stats-dict builder both PS transports share (Python counters
     here, C++ atomics via ``native_ps.NativeSocketParameterServer.stats``):
     key set and derived-value math are pinned by construction, so the
-    transports cannot drift."""
+    transports cannot drift. The resilience counters (dup commits, lease
+    registry) default to zero for transports/tools that predate them."""
     elapsed_s = max(elapsed_s, 1e-9)
     return {
         "pulls": pulls,
@@ -484,6 +568,11 @@ def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
         "elapsed_s": elapsed_s,
         "pulls_per_sec": (pulls + compressed_pulls) / elapsed_s,
         "commits_per_sec": commits / elapsed_s,
+        "dup_commits": dup_commits,
+        "active_workers": active_workers,
+        "evicted_workers": evicted_workers,
+        "heartbeats": heartbeats,
+        "worker_retries": worker_retries,
     }
 
 
@@ -521,8 +610,10 @@ class SocketParameterServer(ParameterServer):
 
     def __init__(self, center: Pytree, rule: MergeRule, num_workers: int,
                  host: str = "127.0.0.1", port: int = 0,
-                 ema_decay: float | None = None):
-        super().__init__(center, rule, num_workers, ema_decay=ema_decay)
+                 ema_decay: float | None = None,
+                 lease_timeout: float | None = None):
+        super().__init__(center, rule, num_workers, ema_decay=ema_decay,
+                         lease_timeout=lease_timeout)
         self.host = host
         self.port = int(port)
         self._server_sock: Any = None
@@ -583,7 +674,19 @@ class SocketParameterServer(ParameterServer):
                     # dropped reply — parity with dkps.cpp PULL_INT8)
                     self._serve_compressed_pull(conn, msg["worker_id"])
                 elif action == "commit":
-                    self.commit(msg["worker_id"], msg["payload"])
+                    applied = self.commit(msg["worker_id"], msg["payload"],
+                                          seq=msg.get("seq"))
+                    networking.send_data(conn, {"ok": True,
+                                                "dup": not applied})
+                elif action == "heartbeat":
+                    # lease renewal (auto-registers); retries is the
+                    # client's cumulative reconnect-and-retry count
+                    known = self.heartbeat(
+                        msg["worker_id"], retries=msg.get("retries", 0)
+                    )
+                    networking.send_data(conn, {"ok": True, "known": known})
+                elif action == "deregister":
+                    self.deregister_worker(msg["worker_id"])
                     networking.send_data(conn, {"ok": True})
                 elif action in ("stop", "bye"):
                     break
@@ -676,18 +779,40 @@ class ParameterServerClient:
         weights = networking.recv_data(self._sock)["weights"]
         return maybe_decode(weights)
 
-    def commit(self, worker_id: int | None, payload: Pytree) -> None:
+    def commit(self, worker_id: int | None, payload: Pytree,
+               seq: int | None = None) -> None:
         # codec blobs are already wire-shaped (and carry non-array fields
         # like the codec name) — only raw trees get the numpy coercion
         if not is_encoded(payload):
             payload = utils.tree_to_numpy(payload)
+        msg = {
+            "action": "commit",
+            "worker_id": self.worker_id,
+            "payload": payload,
+        }
+        if seq is not None:
+            # per-worker commit seqno: the server folds each (worker, seq)
+            # at most once — see ParameterServer.commit / resilience.retry
+            msg["seq"] = int(seq)
+        networking.send_data(self._sock, msg)
+        networking.recv_data(self._sock)  # ack
+
+    def heartbeat(self, retries: int = 0) -> bool:
+        """Renew this worker's lease (auto-registers); ``retries`` is the
+        cumulative client retry count. Returns the server's ``known`` flag
+        (False = this heartbeat re-registered an evicted/new worker)."""
         networking.send_data(
             self._sock,
-            {
-                "action": "commit",
-                "worker_id": self.worker_id,
-                "payload": payload,
-            },
+            {"action": "heartbeat", "worker_id": self.worker_id,
+             "retries": int(retries)},
+        )
+        return bool(networking.recv_data(self._sock).get("known", False))
+
+    def deregister(self) -> None:
+        """Clean exit: drop this worker's lease without an eviction."""
+        networking.send_data(
+            self._sock,
+            {"action": "deregister", "worker_id": self.worker_id},
         )
         networking.recv_data(self._sock)  # ack
 

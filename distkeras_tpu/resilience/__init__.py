@@ -1,0 +1,54 @@
+"""Resilience subsystem for the TPU-native parameter-server stack.
+
+The reference delegated its whole fault story to Spark (RDD lineage +
+task retry); this package is the rebuild's own robustness layer, built on
+PR 3's decontended PS hot path:
+
+- :mod:`~distkeras_tpu.resilience.faults` — seeded deterministic fault
+  injection (:class:`FaultPlan`) for the wire and the worker threads.
+- :mod:`~distkeras_tpu.resilience.heartbeat` — worker leases +
+  heartbeats (:class:`WorkerRegistry`), stale-worker eviction surfaced in
+  ``ps.stats()`` and fed into DynSGD staleness.
+- :mod:`~distkeras_tpu.resilience.retry` — :class:`RetryPolicy`
+  (exponential backoff + deterministic jitter + deadline) and
+  :class:`ResilientPSClient`, a reconnecting client whose commits carry
+  per-worker seqnos deduplicated server-side (exactly-once folds).
+- :mod:`~distkeras_tpu.resilience.recovery` — :class:`WorkerSupervisor`,
+  upgrading ``tolerate_worker_failures`` to restart-with-budget from the
+  latest checkpoint snapshot + a fresh center pull.
+
+Trainer-level knobs: ``retry_policy``, ``heartbeat_interval``,
+``lease_timeout``, ``worker_restart_budget``, ``fault_plan`` (see
+``DistributedTrainer``).
+"""
+
+from distkeras_tpu.resilience.faults import (  # noqa: F401
+    FaultInjectedError,
+    FaultPlan,
+    WorkerKilled,
+)
+from distkeras_tpu.resilience.heartbeat import Lease, WorkerRegistry  # noqa: F401
+from distkeras_tpu.resilience.recovery import (  # noqa: F401
+    RestartBudgetExceeded,
+    WorkerSupervisor,
+)
+from distkeras_tpu.resilience.retry import (  # noqa: F401
+    ResilientPSClient,
+    RetryDeadlineExceeded,
+    RetryPolicy,
+    is_retryable,
+)
+
+__all__ = [
+    "FaultInjectedError",
+    "FaultPlan",
+    "WorkerKilled",
+    "Lease",
+    "WorkerRegistry",
+    "RestartBudgetExceeded",
+    "WorkerSupervisor",
+    "ResilientPSClient",
+    "RetryDeadlineExceeded",
+    "RetryPolicy",
+    "is_retryable",
+]

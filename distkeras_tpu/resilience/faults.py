@@ -1,0 +1,183 @@
+"""Deterministic fault injection for the PS wire and hogwild workers.
+
+The original dist-keras never needed a chaos harness of its own — Spark's
+task retry WAS the fault story, and faults were whatever the cluster did to
+you. The TPU-native PS stack owns its transport, so it owns its chaos too:
+:class:`FaultPlan` is a seeded plan of wire faults (drops, delays,
+op-count partitions) plus kill-at-window worker faults, installed behind
+the ``networking._fault_hook`` seam and the ``AsyncWorker`` window loop.
+Tests and ``bench.py --chaos`` drive the same plan, so the chaos an
+integration test proves survivable is the chaos the benchmark measures.
+
+Determinism: every wire-fault decision comes from one ``Philox``-seeded
+generator consumed under a lock in call order, and worker kills key on
+``(worker_id, window_index)`` — no wall clock anywhere. Two runs with the
+same seed and the same per-thread call sequences draw the same faults;
+kill faults are exactly reproducible regardless of interleaving.
+
+A drop raises :class:`FaultInjectedError` — a ``ConnectionError`` (and
+``ProtocolError``) subclass, so the server's handler paths and the client
+retry layer treat it exactly like a real torn connection. ``max_faults``
+bounds total injected wire faults so a chaotic run always drains to
+completion (the chaos-test convergence gate relies on this).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from distkeras_tpu import networking
+from distkeras_tpu.networking import ProtocolError
+
+
+class FaultInjectedError(ProtocolError):
+    """A fault-plan drop: looks like a torn connection to every consumer
+    (retryable by policy, connection-dropping for server handlers)."""
+
+    def __init__(self, message: str):
+        super().__init__(message, retryable=True)
+
+
+class WorkerKilled(RuntimeError):
+    """A fault-plan worker kill (crash-at-window-N): the supervisor treats
+    it like any other worker death — restart budget permitting."""
+
+
+class FaultPlan:
+    """A seeded, deterministic plan of faults to inject into one run.
+
+    Wire faults (consulted by ``networking.send_data``/``recv_data`` while
+    installed):
+
+    - ``drop_send`` / ``drop_recv``: per-op probability of raising
+      :class:`FaultInjectedError` instead of performing the op. A recv
+      drop is the nasty one — the peer already acted on the request, so a
+      naive client retry would double-apply it (the commit-seqno dedup in
+      the PS exists exactly for this).
+    - ``delay`` / ``delay_s``: per-op probability of sleeping ``delay_s``
+      before the op (slow-link / GC-pause stand-in).
+    - ``partition_after`` / ``partition_ops``: after ``partition_after``
+      wire ops, the next ``partition_ops`` ops all drop — a deterministic
+      network partition window keyed on op count, not wall time.
+
+    Worker faults (consulted by ``AsyncWorker`` at each window):
+
+    - ``kill_at``: ``{worker_id: window_index}`` — the worker raises
+      :class:`WorkerKilled` when it reaches that window (once; a
+      restarted worker passing the same index survives).
+
+    ``max_faults`` caps drops+partition hits (delays excluded) so runs
+    terminate; ``stats()`` reports what was actually injected.
+    """
+
+    def __init__(self, seed: int = 0, drop_send: float = 0.0,
+                 drop_recv: float = 0.0, delay: float = 0.0,
+                 delay_s: float = 0.0, partition_after: int | None = None,
+                 partition_ops: int = 0,
+                 kill_at: dict[int, int] | None = None,
+                 max_faults: int | None = None):
+        for name, p in (("drop_send", drop_send), ("drop_recv", drop_recv),
+                        ("delay", delay)):
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{name} must be a probability, got {p}")
+        self.seed = int(seed)
+        self.drop_send = float(drop_send)
+        self.drop_recv = float(drop_recv)
+        self.delay = float(delay)
+        self.delay_s = float(delay_s)
+        self.partition_after = partition_after
+        self.partition_ops = int(partition_ops)
+        self.kill_at = dict(kill_at or {})
+        self.max_faults = max_faults
+        self._rng = np.random.Generator(np.random.Philox(self.seed))
+        self._lock = threading.Lock()
+        self._ops = 0
+        self._killed: set[int] = set()
+        self._n_drops = 0
+        self._n_delays = 0
+        self._n_partition_drops = 0
+        self._n_kills = 0
+
+    # -- wire hook (installed into networking._fault_hook) -------------------
+
+    def _wire(self, op: str, sock: Any) -> None:
+        """The networking seam: decide this op's fate under the lock (the
+        generator is shared state), sleep OUTSIDE it (a delay must stall
+        one connection, not serialize every other thread's faults)."""
+        sleep_s = 0.0
+        with self._lock:
+            self._ops += 1
+            budget = (self.max_faults is None
+                      or (self._n_drops + self._n_partition_drops)
+                      < self.max_faults)
+            if (budget and self.partition_after is not None
+                    and self.partition_after < self._ops
+                    <= self.partition_after + self.partition_ops):
+                self._n_partition_drops += 1
+                raise FaultInjectedError(
+                    f"injected partition (op {self._ops})"
+                )
+            p_drop = self.drop_send if op == "send" else self.drop_recv
+            if budget and p_drop and self._rng.random() < p_drop:
+                self._n_drops += 1
+                raise FaultInjectedError(
+                    f"injected {op} drop (op {self._ops})"
+                )
+            if self.delay and self._rng.random() < self.delay:
+                self._n_delays += 1
+                sleep_s = self.delay_s
+        if sleep_s > 0.0:
+            time.sleep(sleep_s)
+
+    # -- worker hook ---------------------------------------------------------
+
+    def maybe_kill(self, worker_id: int, window_index: int) -> None:
+        """Raise :class:`WorkerKilled` when ``worker_id`` reaches its
+        configured window — once; restarts replay the window unharmed."""
+        step = self.kill_at.get(worker_id)
+        if step is None or window_index != step:
+            return
+        with self._lock:
+            if worker_id in self._killed:
+                return
+            self._killed.add(worker_id)
+            self._n_kills += 1
+        raise WorkerKilled(
+            f"injected kill: worker {worker_id} at window {window_index}"
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def install(self) -> None:
+        """Install the wire hook; exactly one plan may be active."""
+        if networking._fault_hook is not None:
+            raise RuntimeError("a FaultPlan is already installed")
+        networking._fault_hook = self._wire
+
+    def uninstall(self) -> None:
+        # == not `is`: each `self._wire` access builds a fresh bound method
+        if networking._fault_hook == self._wire:
+            networking._fault_hook = None
+
+    def __enter__(self) -> "FaultPlan":
+        self.install()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    def stats(self) -> dict:
+        """What the plan actually injected (for assertions and chaos-bench
+        records)."""
+        with self._lock:
+            return {
+                "wire_ops": self._ops,
+                "drops": self._n_drops,
+                "partition_drops": self._n_partition_drops,
+                "delays": self._n_delays,
+                "kills": self._n_kills,
+            }

@@ -1,0 +1,135 @@
+"""Automatic worker recovery: restart dead hogwild workers from snapshots.
+
+``tolerate_worker_failures`` (PR era of `workers.py`) was "ignore the
+dead": survivors finish the run at reduced parallelism. This module
+upgrades it to "restart the dead": a :class:`WorkerSupervisor` watches the
+worker threads and, when one dies with a tolerable error, relaunches it —
+up to ``max_restarts`` times per worker — from the best state available:
+
+1. the worker's latest in-memory epoch snapshot (the same per-worker
+   ``{opt, nt[, params]}`` dict the checkpoint barrier persists through
+   ``AsyncCheckpointer``/``save_checkpoint``), resuming at the epoch after
+   the snapshot; else
+2. the newest on-disk checkpoint's entry for that worker; else
+3. fresh per-worker state re-initialized from a **fresh center pull** —
+   the center kept training while the worker was down, so the restart
+   re-bases onto the survivors' progress instead of rewinding it.
+
+Either way the restarted worker re-pulls the center before training
+(non-elastic workers always do; elastic ones restore their own variable),
+renews its heartbeat lease on the first window, and its replayed commits
+start from its client's seqno stream — the server's dedup keeps
+exactly-once folds across the death/restart boundary.
+
+Checkpoint barriers don't survive a death (the dying worker aborts the
+rendezvous and tolerant peers drop to checkpoint-free training — the
+pre-existing semantics); a restarted worker therefore runs barrier-free
+too. ``restart_delay`` inserts a cooldown before each relaunch: it
+backstops crash loops and deliberately exceeds the lease timeout in chaos
+tests so eviction-then-readmission is observable in ``ps.stats()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from typing import Any, Callable
+
+
+class RestartBudgetExceeded(RuntimeError):
+    """A supervised worker died past its ``max_restarts`` budget and the
+    failure was fatal (not tolerated, or no survivors). Raised by
+    ``run_async_training``; carries the worker's last error as
+    ``__cause__``."""
+
+
+class WorkerSupervisor:
+    """Run worker threads to completion, restarting tolerable deaths.
+
+    ``workers`` are ``AsyncWorker``-shaped objects (``error``,
+    ``snapshot``, ``restore``, ``start_epoch``, ``barrier`` attributes and
+    a ``train`` entry point); ``args_of(i)`` returns the positional args
+    for worker ``i``'s ``train``. ``fallback_restore(i)`` supplies a
+    restore dict from outside (the on-disk checkpoint) when the worker
+    died before its first in-memory snapshot.
+    """
+
+    def __init__(self, workers: list, args_of: Callable[[int], tuple],
+                 max_restarts: int = 0, restart_delay: float = 0.0,
+                 fallback_restore: Callable[[int], dict | None] | None = None,
+                 poll_interval: float = 0.05):
+        self.workers = workers
+        self.args_of = args_of
+        self.max_restarts = int(max_restarts)
+        self.restart_delay = float(restart_delay)
+        self.fallback_restore = fallback_restore
+        self.poll_interval = float(poll_interval)
+        self.restarts = [0] * len(workers)
+        self.restart_log: list[dict] = []
+
+    def _spawn(self, i: int) -> threading.Thread:
+        t = threading.Thread(
+            target=self.workers[i].train, args=self.args_of(i), daemon=True,
+            name=f"distkeras-worker-{i}",
+        )
+        t.start()
+        return t
+
+    def _relaunch(self, i: int, err: BaseException) -> threading.Thread:
+        w = self.workers[i]
+        self.restarts[i] += 1
+        # Latest snapshot wins; else the newest on-disk checkpoint's state
+        # for this worker; else None -> the worker re-initializes from a
+        # fresh center pull inside _train.
+        restore = w.snapshot
+        source = "snapshot"
+        if restore is None and self.fallback_restore is not None:
+            restore = self.fallback_restore(i)
+            source = "checkpoint"
+        if restore is None:
+            source = "center-pull"
+        epoch = getattr(w, "_epoch_done", None)
+        w.restore = restore
+        if restore is not None and epoch is not None:
+            w.start_epoch = epoch + 1
+        w.error = None
+        # a death broke the rendezvous for everyone; the restartee (like
+        # its tolerant peers) trains on barrier-free — see module docstring
+        w.barrier = None
+        self.restart_log.append({
+            "worker": i, "attempt": self.restarts[i], "from": source,
+            "error": f"{type(err).__name__}: {err}",
+        })
+        warnings.warn(
+            f"worker {i} died ({type(err).__name__}: {err}); restart "
+            f"{self.restarts[i]}/{self.max_restarts} from {source}",
+            stacklevel=2,
+        )
+        if self.restart_delay > 0:
+            time.sleep(self.restart_delay)
+        return self._spawn(i)
+
+    def run(self) -> list[BaseException | None]:
+        """Start every worker, supervise until all are done (dead workers
+        past budget stay dead). Returns the final per-worker errors."""
+        threads = [self._spawn(i) for i in range(len(self.workers))]
+        pending = set(range(len(self.workers)))
+        while pending:
+            for i in sorted(pending):
+                threads[i].join(timeout=self.poll_interval)
+                if threads[i].is_alive():
+                    continue
+                err = self.workers[i].error
+                if err is not None and not isinstance(err, KeyboardInterrupt) \
+                        and self.restarts[i] < self.max_restarts:
+                    threads[i] = self._relaunch(i, err)
+                    continue
+                pending.discard(i)
+        return [w.error for w in self.workers]
+
+    def stats(self) -> dict:
+        return {
+            "restarts": int(sum(self.restarts)),
+            "restart_log": list(self.restart_log),
+        }

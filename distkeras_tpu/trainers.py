@@ -545,6 +545,12 @@ class DistributedTrainer(Trainer):
                  profile_dir=None,
                  log_metrics: bool = False,
                  tolerate_worker_failures: bool = False,
+                 worker_restart_budget: int = 0,
+                 worker_restart_delay: float = 0.0,
+                 retry_policy=None,
+                 heartbeat_interval: float | None = None,
+                 lease_timeout: float | None = None,
+                 fault_plan=None,
                  prefetch: int = 1, ema_decay: float | None = None,
                  clipnorm=None, clipvalue=None, validation_data=None):
         super().__init__(keras_model, loss, worker_optimizer,
@@ -692,6 +698,55 @@ class DistributedTrainer(Trainer):
         # still fails if every worker dies). The collective backend is one
         # SPMD program, so partial failure doesn't apply there.
         self.tolerate_worker_failures = bool(tolerate_worker_failures)
+        # Resilience subsystem knobs (distkeras_tpu/resilience; PS backend
+        # only — the collective backend is one SPMD program):
+        #
+        # - worker_restart_budget=K: a dead hogwild worker is restarted up
+        #   to K times from its latest checkpoint snapshot + a fresh center
+        #   pull (recovery.WorkerSupervisor) instead of merely tolerated;
+        #   worker_restart_delay is the cooldown before each relaunch.
+        # - retry_policy: a resilience.RetryPolicy — pulls/commits that hit
+        #   transient transport failures reconnect and retry with
+        #   exponential backoff; retried commits carry per-worker seqnos
+        #   the server deduplicates (exactly-once folds).
+        # - heartbeat_interval: workers renew a liveness lease on the PS at
+        #   window boundaries; lease_timeout (default 5× the interval)
+        #   controls stale-worker eviction, surfaced in ps.stats() and fed
+        #   into DynSGD staleness accounting.
+        # - fault_plan: a resilience.FaultPlan injected into the run (tests
+        #   and bench.py --chaos; install()ed by the caller for wire
+        #   faults, kill-at-window faults hook the worker loop here).
+        self.worker_restart_budget = int(worker_restart_budget)
+        if self.worker_restart_budget < 0:
+            raise ValueError(
+                f"worker_restart_budget must be >= 0, got "
+                f"{worker_restart_budget}"
+            )
+        self.worker_restart_delay = float(worker_restart_delay)
+        self.retry_policy = retry_policy
+        if heartbeat_interval is not None and heartbeat_interval <= 0:
+            raise ValueError(
+                f"heartbeat_interval must be positive, got "
+                f"{heartbeat_interval}"
+            )
+        self.heartbeat_interval = heartbeat_interval
+        if lease_timeout is not None and lease_timeout <= 0:
+            raise ValueError(
+                f"lease_timeout must be positive, got {lease_timeout}"
+            )
+        self.lease_timeout = lease_timeout
+        self.fault_plan = fault_plan
+        if backend != "ps" and (
+                worker_restart_budget or retry_policy is not None
+                or heartbeat_interval is not None or lease_timeout is not None
+                or fault_plan is not None):
+            raise ValueError(
+                "the resilience knobs (worker_restart_budget, retry_policy, "
+                "heartbeat_interval, lease_timeout, fault_plan) apply to "
+                "backend='ps' only (the collective backend is one SPMD "
+                "program)"
+            )
+        self.resilience_stats_ = None
 
     # -- seams kept from the reference ------------------------------------
 

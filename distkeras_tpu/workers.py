@@ -70,7 +70,7 @@ class AsyncWorker:
                  barrier: threading.Barrier | None = None,
                  ckpt_pred=None,
                  restore: dict | None = None, start_epoch: int = 0,
-                 tolerant: bool = False, codec=None):
+                 tolerant: bool = False, codec=None, fault_plan=None):
         self.worker_id = worker_id
         self.device = device
         self.window_fn = window_fn
@@ -102,6 +102,12 @@ class AsyncWorker:
         self._resid = None
         self.snapshot: dict | None = None
         self.error: BaseException | None = None
+        # Resilience hooks (distkeras_tpu/resilience): the fault plan's
+        # kill-at-window chaos hook, and piggyback heartbeats — the lease
+        # renewal rides the window loop when the client supports it, so
+        # liveness tracks actual training progress (no extra threads).
+        self.fault_plan = fault_plan
+        self._windows_done = 0
 
     def _compress(self, tree):
         """→ (wire payload, transmitted tree); updates the residual."""
@@ -129,6 +135,11 @@ class AsyncWorker:
         win_rows = self.window * self.batch_size
         n_windows = rows // win_rows
         elastic = isinstance(self.rule, ElasticAverageMerge)
+        # register the liveness lease up front (no-op on plain clients);
+        # a restarted worker's first heartbeat re-admits it after eviction
+        maybe_heartbeat = getattr(self.ps, "maybe_heartbeat", None)
+        if maybe_heartbeat is not None:
+            maybe_heartbeat()
 
         if self.restore is not None:
             # Optimizer state and non-trainables always come from the snapshot.
@@ -155,6 +166,13 @@ class AsyncWorker:
                 else np.arange(rows)
             )
             for w in range(n_windows):
+                if self.fault_plan is not None:
+                    # chaos hook: kill-at-window faults fire here, keyed
+                    # on the worker's GLOBAL window index (deterministic;
+                    # a restarted worker replaying the index survives)
+                    self.fault_plan.maybe_kill(
+                        self.worker_id, self._windows_done
+                    )
                 sl = order[w * win_rows : (w + 1) * win_rows]
                 batches = tuple(
                     c[sl].reshape((self.window, self.batch_size) + c.shape[1:])
@@ -195,6 +213,9 @@ class AsyncWorker:
                         "epoch": epoch,
                         "worker": self.worker_id,
                     })
+                self._windows_done += 1
+                if maybe_heartbeat is not None:
+                    maybe_heartbeat()  # rate-limited lease renewal
             if self.barrier is not None and self.ckpt_pred(epoch):
                 self.snapshot = {
                     "opt": utils.tree_to_numpy(opt),
@@ -254,11 +275,29 @@ def run_async_training(trainer, ds, shuffle: bool):
             start_epoch = int(payload["epoch"]) + 1
 
     from distkeras_tpu.parallel.compression import Int8Codec, resolve_codec
+    from distkeras_tpu.resilience.retry import ResilientPSClient
 
     transport = getattr(trainer, "ps_transport", "inprocess")
     external_host = getattr(trainer, "ps_host", None)
     offset = int(getattr(trainer, "worker_id_offset", 0))
     codec = resolve_codec(getattr(trainer, "compression", None))
+    # Resilience knobs (distkeras_tpu/resilience): a retry policy or a
+    # heartbeat interval turns the plain transport clients into
+    # reconnecting, seqno-deduplicated, lease-renewing wrappers.
+    retry_policy = getattr(trainer, "retry_policy", None)
+    hb_interval = getattr(trainer, "heartbeat_interval", None)
+    resilient = retry_policy is not None or hb_interval is not None
+    lease_timeout = getattr(trainer, "lease_timeout", None)
+    if lease_timeout is None and hb_interval is not None:
+        # a missed-5-heartbeats default: prompt eviction without flapping
+        lease_timeout = 5.0 * float(hb_interval)
+    fault_plan = getattr(trainer, "fault_plan", None)
+    if resilient and transport == "native" and codec is not None:
+        raise ValueError(
+            "ps_transport='native' carries commit seqnos on the raw f32 "
+            "wire only — drop compression or use ps_transport='socket' "
+            "when retry_policy/heartbeat_interval are set"
+        )
     # clients validate the value; direct-runner callers without the
     # trainer-constructor check still fail fast in each constructor
     pull_comp = getattr(trainer, "pull_compression", None)
@@ -295,21 +334,18 @@ def run_async_training(trainer, ds, shuffle: bool):
             from distkeras_tpu.native_ps import FlatSpec, NativePSClient
 
             flat_spec = FlatSpec(params)
-            clients = [
-                NativePSClient(
+
+            def make_client(i):
+                return NativePSClient(
                     external_host, int(getattr(trainer, "ps_port", 0)),
                     offset + i, flat_spec, pull_compression=pull_comp,
                 )
-                for i in range(W)
-            ]
         else:
-            clients = [
-                ParameterServerClient(
+            def make_client(i):
+                return ParameterServerClient(
                     external_host, int(getattr(trainer, "ps_port", 0)),
                     offset + i, pull_compression=pull_comp,
                 )
-                for i in range(W)
-            ]
     elif transport == "native":
         from distkeras_tpu.native_ps import (
             NativePSClient,
@@ -319,34 +355,49 @@ def run_async_training(trainer, ds, shuffle: bool):
         ps = NativeSocketParameterServer(
             params, rule, W, port=getattr(trainer, "ps_port", 0),
             ema_decay=getattr(trainer, "ema_decay", None),
+            lease_timeout=lease_timeout,
         )
         ps.initialize()
         ps.start()
-        clients = [
-            NativePSClient("127.0.0.1", ps.port, i, ps.spec,
-                           pull_compression=pull_comp)
-            for i in range(W)
-        ]
+
+        def make_client(i):
+            return NativePSClient("127.0.0.1", ps.port, i, ps.spec,
+                                  pull_compression=pull_comp)
     elif transport == "socket":
         ps = SocketParameterServer(
             params, rule, W, port=getattr(trainer, "ps_port", 0),
             ema_decay=getattr(trainer, "ema_decay", None),
+            lease_timeout=lease_timeout,
         )
         ps.initialize()
         ps.start()
-        clients = [
-            ParameterServerClient("127.0.0.1", ps.port, i,
-                                  pull_compression=pull_comp)
-            for i in range(W)
-        ]
+
+        def make_client(i):
+            return ParameterServerClient("127.0.0.1", ps.port, i,
+                                         pull_compression=pull_comp)
     elif transport == "inprocess":
         ps = ParameterServer(
-            params, rule, W, ema_decay=getattr(trainer, "ema_decay", None)
+            params, rule, W, ema_decay=getattr(trainer, "ema_decay", None),
+            lease_timeout=lease_timeout,
         )
-        clients = [_BoundPS(ps, i, pull_compression=pull_comp)
-                   for i in range(W)]
+
+        def make_client(i):
+            return _BoundPS(ps, i, pull_compression=pull_comp)
     else:
         raise ValueError(f"unknown ps_transport {transport!r}")
+
+    if resilient:
+        # reconnect-and-retry with per-worker commit seqnos (dedup'd
+        # server-side) and piggyback lease heartbeats — resilience/retry.py
+        clients = [
+            ResilientPSClient(
+                lambda i=i: make_client(i), offset + i,
+                policy=retry_policy, heartbeat_interval=hb_interval,
+            )
+            for i in range(W)
+        ]
+    else:
+        clients = [make_client(i) for i in range(W)]
 
     cols = trainer.features_col + [trainer.label_col]
     shards = ds.worker_shards(
@@ -389,7 +440,7 @@ def run_async_training(trainer, ds, shuffle: bool):
 
                 snap_client = NativePSClient(
                     external_host, int(getattr(trainer, "ps_port", 0)),
-                    SNAP_WID, clients[0].spec,
+                    SNAP_WID, flat_spec,
                 )
             else:
                 snap_client = ParameterServerClient(
@@ -422,28 +473,70 @@ def run_async_training(trainer, ds, shuffle: bool):
             barrier=barrier, ckpt_pred=ckpt_pred,
             restore=restores[i], start_epoch=start_epoch,
             tolerant=getattr(trainer, "tolerate_worker_failures", False),
-            codec=codec,
+            codec=codec, fault_plan=fault_plan,
         )
         for i in range(W)
     ]
-    threads = [
-        threading.Thread(
-            target=w.train,
-            args=(
-                i,
-                tuple(col[i] for col in shards),
-                trainer.num_epoch,
-                shuffle,
-                trainer.seed,
-            ),
-            daemon=True,
+
+    def _args_of(i):
+        return (i, tuple(col[i] for col in shards), trainer.num_epoch,
+                shuffle, trainer.seed)
+
+    restart_budget = int(getattr(trainer, "worker_restart_budget", 0))
+    supervisor = None
+    if restart_budget > 0:
+        # restart-with-budget recovery (resilience/recovery.py): a dead
+        # worker relaunches from its latest snapshot (or the on-disk
+        # checkpoint's entry, or a fresh center pull) up to K times
+        from distkeras_tpu.resilience.recovery import WorkerSupervisor
+
+        def _fallback_restore(i):
+            if not ckpt_dir:
+                return None
+            from distkeras_tpu import checkpoint as ckpt
+
+            if ckpt.latest_step(ckpt_dir) is None:
+                return None
+            payload, _ = ckpt.restore_checkpoint(ckpt_dir)
+            saved = payload.get("workers") or []
+            return saved[i] if i < len(saved) else None
+
+        supervisor = WorkerSupervisor(
+            workers, _args_of, max_restarts=restart_budget,
+            restart_delay=float(getattr(trainer, "worker_restart_delay",
+                                        0.0)),
+            fallback_restore=_fallback_restore,
         )
-        for i, w in enumerate(workers)
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
+        supervisor.run()
+    else:
+        threads = [
+            threading.Thread(target=w.train, args=_args_of(i), daemon=True)
+            for i, w in enumerate(workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    # Resilience observability, stashed next to ps_stats_: the commit-
+    # seqno oracle (logical commits issued vs folds applied — see the
+    # chaos tests), client retry/reconnect totals, supervisor restarts,
+    # and what the fault plan actually injected.
+    trainer.resilience_stats_ = None
+    if resilient or supervisor is not None or fault_plan is not None:
+        trainer.resilience_stats_ = {
+            "logical_commits": sum(
+                int(getattr(c, "seq", 0)) for c in clients
+            ),
+            "retries": sum(
+                int(getattr(c, "retries", 0)) for c in clients
+            ),
+            "reconnects": sum(
+                int(getattr(c, "reconnects", 0)) for c in clients
+            ),
+            "restarts": supervisor.stats()["restarts"] if supervisor else 0,
+            "faults": fault_plan.stats() if fault_plan is not None else None,
+        }
 
     errors = [w.error for w in workers if w.error is not None]
     if errors:
@@ -452,10 +545,24 @@ def run_async_training(trainer, ds, shuffle: bool):
         # external PS must not mask the workers' own errors)
         errors.sort(key=lambda e: isinstance(e, threading.BrokenBarrierError))
         survivors = sum(1 for w in workers if w.error is None)
-        if not getattr(trainer, "tolerate_worker_failures", False):
-            raise errors[0]
-        if survivors == 0:
-            raise errors[0]  # tolerating failures, but nobody survived
+        fatal = (not getattr(trainer, "tolerate_worker_failures", False)
+                 or survivors == 0)  # tolerated, but nobody survived
+        if fatal:
+            first = errors[0]
+            if supervisor is not None and not isinstance(
+                    first, (KeyboardInterrupt, threading.BrokenBarrierError)):
+                # the supervisor only leaves a worker dead once its budget
+                # is spent — name that, with the last death as the cause
+                from distkeras_tpu.resilience.recovery import (
+                    RestartBudgetExceeded,
+                )
+
+                raise RestartBudgetExceeded(
+                    f"worker died past its restart budget "
+                    f"({restart_budget} restarts): "
+                    f"{type(first).__name__}: {first}"
+                ) from first
+            raise first
         import warnings
 
         warnings.warn(
@@ -481,9 +588,8 @@ def run_async_training(trainer, ds, shuffle: bool):
                 f"training finished but the external PS at {external_host} "
                 f"stopped answering the final pull: {e}"
             ) from e
-    if transport in ("socket", "native"):
-        for c in clients:
-            c.close()
+    for c in clients:
+        c.close()  # in-process close is a no-op; resilient close deregisters
     if snap_client is not None:
         snap_client.close()
     if ps is not None:
@@ -537,8 +643,14 @@ class _BoundPS:
                                               compressed=True))
         return self._ps.pull(self.worker_id)
 
-    def commit(self, worker_id: int | None, payload):
-        self._ps.commit(self.worker_id, payload)
+    def commit(self, worker_id: int | None, payload, seq: int | None = None):
+        self._ps.commit(self.worker_id, payload, seq=seq)
+
+    def heartbeat(self, retries: int = 0) -> bool:
+        return self._ps.heartbeat(self.worker_id, retries=retries)
+
+    def deregister(self) -> None:
+        self._ps.deregister_worker(self.worker_id)
 
     def close(self):
         pass

@@ -1,0 +1,90 @@
+"""Checkpoint error paths and the elastic-resume warning (ISSUE 4
+satellites): the recovery layer leans on these — a restart that restores
+from a truncated snapshot must fail loudly and namefully, never resume
+from garbage."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import checkpoint as ckpt
+
+
+def test_warn_elastic_resume_message_and_category():
+    """The shared elastic-resume warning names both worker counts (it is
+    the only signal the user gets that optimizer state restarted)."""
+    with pytest.warns(UserWarning, match=r"elastic resume.*2 workers.*4"):
+        ckpt.warn_elastic_resume(2, 4)
+    # shrinking is elastic too, same path
+    with pytest.warns(UserWarning, match=r"checkpoint has 8 workers"):
+        ckpt.warn_elastic_resume(8, 1)
+
+
+def test_sharded_restore_missing_shard_file_names_it(tmp_path):
+    """A deleted/unsynced shard file fails with FileNotFoundError naming
+    the missing file and the writing process count."""
+    tree = {"w": np.arange(8, dtype=np.float32)}
+    ckpt._save_sharded(tmp_path, tree, step=2)
+    shard = ckpt._shard_file(tmp_path, 2, 0, 1)
+    shard.unlink()
+    with pytest.raises(FileNotFoundError, match=shard.name):
+        ckpt.restore_checkpoint(tmp_path, step=2)
+
+
+def test_sharded_restore_truncated_shard_file(tmp_path):
+    """A torn write (crash mid-copy) surfaces as ValueError naming the
+    shard file — not a bare unpickling error from the wrong layer."""
+    tree = {"w": np.arange(16, dtype=np.float32)}
+    ckpt._save_sharded(tmp_path, tree, step=1)
+    shard = ckpt._shard_file(tmp_path, 1, 0, 1)
+    blob = shard.read_bytes()
+    shard.write_bytes(blob[: len(blob) // 2])  # torn mid-write
+    with pytest.raises(ValueError, match=rf"{shard.name}.*truncated|truncated.*{shard.name}"):
+        ckpt.restore_checkpoint(tmp_path, step=1)
+
+
+def test_sharded_restore_truncated_meta_file(tmp_path):
+    """Same contract for the meta file (the other half of the format)."""
+    ckpt._save_sharded(tmp_path, {"w": np.ones(4, np.float32)}, step=5)
+    meta = ckpt._meta_file(tmp_path, 5)
+    meta.write_bytes(meta.read_bytes()[:10])
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        ckpt.restore_checkpoint(tmp_path, step=5)
+
+
+def test_sharded_restore_corrupt_not_just_short(tmp_path):
+    """Garbage of the right length (bit rot, not truncation) is caught by
+    the same typed error."""
+    tree = {"w": np.arange(8, dtype=np.float32)}
+    ckpt._save_sharded(tmp_path, tree, step=0)
+    shard = ckpt._shard_file(tmp_path, 0, 0, 1)
+    shard.write_bytes(b"\x00" * len(shard.read_bytes()))
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        ckpt.restore_checkpoint(tmp_path, step=0)
+
+
+def test_sharded_restore_survives_intact_roundtrip(tmp_path):
+    """Control: the untampered file restores exactly (guards against the
+    new error wrapping catching healthy loads)."""
+    tree = {"w": np.arange(8, dtype=np.float32), "b": np.ones(3, np.int32)}
+    ckpt._save_sharded(tmp_path, tree, step=7)
+    got, step = ckpt.restore_checkpoint(tmp_path)
+    assert step == 7
+    np.testing.assert_array_equal(got["w"], tree["w"])
+    np.testing.assert_array_equal(got["b"], tree["b"])
+
+
+def test_shard_payload_format_is_pickle_of_shards_dict(tmp_path):
+    """Pin the on-disk shard schema the error paths assume ({'shards':
+    {(leaf, starts): array}}): a format change must update the torn-write
+    detection with it."""
+    ckpt._save_sharded(tmp_path, {"w": np.arange(4, dtype=np.float32)},
+                       step=0)
+    payload = pickle.loads(
+        ckpt._shard_file(tmp_path, 0, 0, 1).read_bytes()
+    )
+    assert set(payload) == {"shards"}
+    (key, data), = payload["shards"].items()
+    assert key == (0, (0,))
+    np.testing.assert_array_equal(data, np.arange(4, dtype=np.float32))
