@@ -1322,6 +1322,147 @@ def run_ps_chaos_bench(n_params=1_000_000, workers=4, seconds=4.0,
         ps.stop()
 
 
+def run_ps_failover_bench(n_params=1_000_000, workers=4, seconds=4.0,
+                          seed=0):
+    """PS survivability benchmark (--chaos-ps): the mixed pull+commit
+    hammer over the socket transport, with the PRIMARY crash-stopped
+    mid-run (SIGKILL semantics: torn connections, no final fsync) and
+    recovered two ways — one leg restarts in place from the write-ahead
+    log, one promotes a hot standby. Each leg reports rounds/s before vs
+    after the failover, the failover latency and WAL-replay time from
+    the supervisor, and asserts the cross-failover exactly-once oracle:
+    lifetime folds (num_updates, which survives recovery) == logical
+    commits issued, no matter what the kill tore mid-ACK."""
+    import shutil
+    import tempfile
+    import warnings
+
+    from distkeras_tpu.parallel.merge_rules import DownpourMerge
+    from distkeras_tpu.parameter_servers import (
+        ParameterServerClient,
+        SocketParameterServer,
+        StandbySocketParameterServer,
+    )
+    from distkeras_tpu.resilience import (
+        PSEndpoint,
+        PSFailoverSupervisor,
+        ResilientPSClient,
+        RetryPolicy,
+    )
+
+    center = _ps_bench_tree(n_params)
+    delta = {
+        "emb": np.full_like(center["emb"], 1e-6),
+        "dense": {"w": np.full_like(center["dense"]["w"], 1e-6),
+                  "b": np.full_like(center["dense"]["b"], 1e-6)},
+    }
+    out = {}
+    for mode in ("restart", "standby"):
+        name = f"ps_failover_{mode}"
+        log(f"[chaos-ps] {name}: {workers} workers, "
+            f"{n_params / 1e6:.1f}M params, kill at t={seconds / 2:.1f}s")
+        wal_dir = tempfile.mkdtemp(prefix="dk-walbench-")
+        ps = SocketParameterServer(center, DownpourMerge(), workers,
+                                   lease_timeout=5.0, wal_dir=wal_dir,
+                                   snapshot_every=50)
+        ps.initialize()
+        ps.start()
+        resolver = PSEndpoint("127.0.0.1", ps.port, epoch=ps.fence_epoch)
+        standby = None
+        if mode == "standby":
+            standby = StandbySocketParameterServer(
+                center, DownpourMerge(), workers, lease_timeout=5.0,
+            )
+            standby.initialize()
+            standby.start()
+            ps.attach_standby("127.0.0.1", standby.port)
+
+        def factory(_wal=wal_dir):
+            new = SocketParameterServer(center, DownpourMerge(), workers,
+                                        lease_timeout=5.0, wal_dir=_wal,
+                                        snapshot_every=50)
+            new.initialize()
+            new.start()
+            return new
+
+        sup = PSFailoverSupervisor(
+            resolver, ps, standby=standby, restart_factory=factory,
+            failover_timeout=0.5,
+        )
+        sup.start()
+
+        def mk(i):
+            host, port, epoch = resolver.resolve()
+            return ParameterServerClient(host, port, i, epoch=epoch,
+                                         connect_timeout=5.0)
+
+        policy = RetryPolicy(max_attempts=200, base_delay=0.01,
+                             max_delay=0.25, deadline=120.0, seed=seed)
+        clients = [
+            ResilientPSClient(lambda i=i: mk(i), i, policy=policy,
+                              heartbeat_interval=0.2, resolver=resolver)
+            for i in range(workers)
+        ]
+
+        def op(c, i):
+            c.pull()
+            c.commit(i, delta)
+            c.maybe_heartbeat()
+
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                before, t_before = _ps_bench_phase(clients, op,
+                                                   seconds / 2)
+                ps._crash()  # SIGKILL semantics mid-service
+                t_kill = time.perf_counter()
+                after, t_after = _ps_bench_phase(clients, op, seconds / 2)
+            while sup.failovers == 0 and time.perf_counter() - t_kill < 30:
+                time.sleep(0.01)  # phase B can outrun the promotion log
+            sup.stop()
+            active = sup.active
+            logical = sum(c.seq for c in clients)
+            s = active.stats()
+            rec = {
+                "config": name,
+                "workers": workers,
+                "params": n_params,
+                "rounds_per_sec_before": round(before / t_before, 2),
+                "rounds_per_sec_after": round(after / t_after, 2),
+                "failovers": sup.failovers,
+                "failover_latency_ms": round(
+                    sup.failover_latency_s * 1e3, 2),
+                "wal_replay_ms": round(sup.wal_replay_s * 1e3, 2),
+                "logical_commits": logical,
+                "applied_commits_lifetime": s["num_updates"],
+                "dedup_exact_once": s["num_updates"] == logical,
+                "retries": sum(c.retries for c in clients),
+                "fenced_commits": s["fenced_commits"],
+            }
+            if not rec["dedup_exact_once"] or sup.failovers != 1:
+                rec["invalid"] = True  # a broken oracle is a bug, not noise
+            log(json.dumps(rec))
+            out[name] = rec
+        finally:
+            for c in clients:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            try:
+                sup.stop()
+            except Exception:
+                pass
+            for server in (sup.active, ps, standby):
+                if server is not None:
+                    try:
+                        server.stop()
+                    except Exception:
+                        pass
+            shutil.rmtree(wal_dir, ignore_errors=True)
+    return out
+
+
 def run_proxy_only():
     """CPU-proxy denominator as a standalone process (spawned by main with
     ``JAX_PLATFORMS=cpu``): the ~550 s XLA:CPU compile+epochs run CONCURRENTLY
@@ -1389,9 +1530,14 @@ def main():
                          "dedup + heartbeats; asserts exactly-once folds)")
     ap.add_argument("--chaos-params", type=int, default=1_000_000,
                     help="chaos benchmark tree size in float32 params")
+    ap.add_argument("--chaos-ps", action="store_true",
+                    help="run ONLY the PS survivability benchmark (primary "
+                         "crash-stopped mid-run; WAL restart-in-place and "
+                         "hot-standby promotion legs with failover latency, "
+                         "WAL replay ms, and rounds/s before vs after)")
     args = ap.parse_args()
 
-    if args.ps_bench or args.chaos:
+    if args.ps_bench or args.chaos or args.chaos_ps:
         # pure host-side numpy/threading — no accelerator, no proxy. Per-leg
         # records stream to stderr; ONE headline JSON blob lands on stdout
         # (same contract as the training headline), so the BENCH_*.json
@@ -1405,6 +1551,11 @@ def main():
             legs.update(run_ps_chaos_bench(n_params=args.chaos_params,
                                            workers=args.ps_bench_workers,
                                            seconds=args.ps_bench_seconds))
+        if args.chaos_ps:
+            legs.update(run_ps_failover_bench(
+                n_params=args.chaos_params,
+                workers=args.ps_bench_workers,
+                seconds=args.ps_bench_seconds))
         print(json.dumps({
             "metric": "ps_bench",
             "unit": "ops/sec",

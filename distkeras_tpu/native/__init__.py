@@ -106,6 +106,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dkps_client_commit_seq.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, f32p,
     ]
+    lib.dkps_client_commit_seq_e.restype = ctypes.c_int
+    lib.dkps_client_commit_seq_e.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, f32p,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.dkps_client_fence.restype = ctypes.c_int64
+    lib.dkps_client_fence.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.dkps_server_fence.restype = ctypes.c_uint64
+    lib.dkps_server_fence.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.dkps_server_fence_epoch.restype = ctypes.c_uint64
+    lib.dkps_server_fence_epoch.argtypes = [ctypes.c_void_p]
     lib.dkps_client_heartbeat.restype = ctypes.c_int
     lib.dkps_client_heartbeat.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     lib.dkps_client_deregister.restype = ctypes.c_int

@@ -51,12 +51,23 @@
 //              ACK died cannot double-fold it; parity with the Python
 //              PS's "seq"-carrying commit),
 //              8=DEREGISTER (clean worker exit: drop the lease without
-//              counting an eviction)
+//              counting an eviction),
+//              9=FENCE (u64 epoch: raise the server's fencing epoch —
+//              monotone; the failover supervisor's last word to a
+//              superseded primary, protocol parity with the Python PS's
+//              "fence" action),
+//              10=COMMIT_SEQ_E (u64 epoch + u64 seqno + n*4 payload:
+//              the failover-safe commit — folded only when the client's
+//              fencing epoch matches the server's, so a zombie
+//              primary's (or a fenced server's) late folds are rejected
+//              instead of absorbed into a superseded history)
 //   reply:     PULL -> u64 center_version + n*4 bytes; COMMIT -> u8 ack;
 //              PULL_INT8 -> u64 version + u32 nblocks + nblocks*f32 scales
 //              + n int8 bytes; HEARTBEAT -> u8 (1 = renewed, 2 =
 //              (re-)registered); COMMIT_SEQ -> u8 (1 = folded, 2 =
-//              duplicate, dropped); DEREGISTER -> u8 ack
+//              duplicate, dropped); DEREGISTER -> u8 ack; FENCE -> u8
+//              ack + u64 epoch-now; COMMIT_SEQ_E -> u8 (1 = folded, 2 =
+//              duplicate, 3 = FENCED — not folded) + u64 server epoch
 //
 // Concurrency model matches the reference: accept loop + one handler thread
 // per connection + one mutex around the center. The difference is what runs
@@ -183,6 +194,13 @@ struct Server {
   std::unordered_map<uint32_t, uint32_t> retries_by_wid;
   std::atomic<uint64_t> st_heartbeats{0}, st_evicted{0}, st_dups{0};
 
+  // Fencing epoch (protocol parity with the Python PS / resilience
+  // failover): COMMIT_SEQ_E folds only when the client's epoch matches;
+  // FENCE raises it monotonically. Under mu (checked inside the fold's
+  // critical section — one integer compare).
+  uint64_t fence_epoch = 0;
+  std::atomic<uint64_t> st_fenced{0};
+
   static uint64_t now_ns() {
     return static_cast<uint64_t>(
         std::chrono::duration_cast<std::chrono::nanoseconds>(
@@ -215,7 +233,13 @@ struct Server {
     }
     if (!dead.empty()) {
       std::lock_guard<std::mutex> g(mu);
-      for (uint32_t wid : dead) pull_versions.erase(wid);
+      for (uint32_t wid : dead) {
+        pull_versions.erase(wid);
+        // retire the commit-dedup entry too (parity with the Python
+        // _on_evict): long elastic runs with many worker generations
+        // must not grow last_seq without bound
+        last_seq.erase(wid);
+      }
     }
   }
 
@@ -508,6 +532,54 @@ struct Server {
         st_bytes_in += n * sizeof(float);
         uint8_t ack = dup ? 2 : 1;
         if (!send_all(fd, &ack, 1)) break;
+      } else if (action == 10) {  // COMMIT_SEQ_E: fenced + seq'd commit
+        uint64_t epoch, seq;
+        if (!recv_all(fd, &epoch, 8)) break;
+        if (!recv_all(fd, &seq, 8)) break;
+        if (!recv_all(fd, buf.data(), n * sizeof(float))) break;
+        bool dup = false, fenced = false;
+        uint64_t server_epoch;
+        {
+          StatGuard g(this);
+          server_epoch = fence_epoch;
+          fenced = epoch != fence_epoch;
+          if (!fenced) {
+            uint64_t& last = last_seq[conn_wid_];
+            dup = seq <= last;
+            if (!dup) {
+              last = seq;
+              const float s = fold_scale_locked();
+              float* c = center.data();
+              const float* d = buf.data();
+              for (uint64_t i = 0; i < n; ++i) c[i] += d[i] * s;
+              ema_fold_locked();
+              num_updates += 1;
+            }
+          }
+        }
+        if (fenced) {
+          st_fenced += 1;
+        } else if (dup) {
+          st_dups += 1;
+        } else {
+          st_commits += 1;
+        }
+        st_bytes_in += n * sizeof(float);
+        uint8_t ack = fenced ? 3 : (dup ? 2 : 1);
+        if (!send_all(fd, &ack, 1)) break;
+        if (!send_all(fd, &server_epoch, 8)) break;
+      } else if (action == 9) {  // FENCE: raise the fencing epoch
+        uint64_t epoch;
+        if (!recv_all(fd, &epoch, 8)) break;
+        uint64_t now_epoch;
+        {
+          StatGuard g(this);
+          if (epoch > fence_epoch) fence_epoch = epoch;
+          now_epoch = fence_epoch;
+        }
+        uint8_t ack = 1;
+        if (!send_all(fd, &ack, 1)) break;
+        if (!send_all(fd, &now_epoch, 8)) break;
       } else if (action == 6) {  // HEARTBEAT: lease renewal
         uint32_t retries;
         if (!recv_all(fd, &retries, 4)) break;
@@ -719,10 +791,10 @@ void dkps_server_record_pull(void* h, uint32_t wid) {
 }
 
 // Contention/throughput counters (parity with the Python PS's stats()).
-// Fills out[13]: pulls, compressed_pulls, commits, bytes_in, bytes_out,
+// Fills out[14]: pulls, compressed_pulls, commits, bytes_in, bytes_out,
 // center_lock_acquires, center_lock_wait_ns, center_lock_hold_ns,
 // dup_commits, active_workers, evicted_workers, heartbeats,
-// worker_retries. Runs a FORCED expiry pass first (a stats read must see
+// worker_retries, fenced_commits. Runs a FORCED expiry pass first (a stats read must see
 // already-lapsed leases as evicted — no rate-limit window); the counter
 // reads stay lock-free atomics and may lag in-flight ops by one —
 // telemetry semantics, same as the Python side.
@@ -747,6 +819,21 @@ void dkps_server_stats(void* h, uint64_t* out) {
     out[11] = s->st_heartbeats.load();
     out[12] = retries;
   }
+  out[13] = s->st_fenced.load();
+}
+
+// fencing-epoch admin (parity with ParameterServer.fence / fence_epoch)
+uint64_t dkps_server_fence(void* h, uint64_t epoch) {
+  auto* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (epoch > s->fence_epoch) s->fence_epoch = epoch;
+  return s->fence_epoch;
+}
+
+uint64_t dkps_server_fence_epoch(void* h) {
+  auto* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->fence_epoch;
 }
 
 // ---------------------------------------------------------------- client --
@@ -854,6 +941,45 @@ int dkps_client_commit_seq(void* h, uint64_t seq, const float* buf) {
       !recv_all(c->fd, &ack, 1) || (ack != 1 && ack != 2))
     return -1;
   return ack == 2 ? 1 : 0;
+}
+
+// fenced + seq'd commit (action 10): the failover-safe commit. Returns
+// 0 = folded, 1 = duplicate (both success to the retry layer), 2 =
+// FENCED (the server's epoch differs — NOT folded; the caller raises a
+// typed fatal/re-resolve error), -1 = transport failure. The server's
+// current epoch lands in *server_epoch when non-null.
+int dkps_client_commit_seq_e(void* h, uint64_t epoch, uint64_t seq,
+                             const float* buf, uint64_t* server_epoch) {
+  auto* c = static_cast<Client*>(h);
+  char header[1 + 8 + 8];
+  header[0] = 10;
+  std::memcpy(header + 1, &epoch, 8);
+  std::memcpy(header + 9, &seq, 8);
+  uint8_t ack = 0;
+  uint64_t sepoch = 0;
+  if (!send_all(c->fd, header, sizeof(header)) ||
+      !send_all(c->fd, buf, c->n * sizeof(float)) ||
+      !recv_all(c->fd, &ack, 1) || !recv_all(c->fd, &sepoch, 8) ||
+      (ack != 1 && ack != 2 && ack != 3))
+    return -1;
+  if (server_epoch) *server_epoch = sepoch;
+  return ack == 3 ? 2 : (ack == 2 ? 1 : 0);
+}
+
+// fence (action 9): raise the server's fencing epoch. Returns the
+// post-fence epoch (>= the requested one) or -1 on transport failure.
+int64_t dkps_client_fence(void* h, uint64_t epoch) {
+  auto* c = static_cast<Client*>(h);
+  char header[1 + 8];
+  header[0] = 9;
+  std::memcpy(header + 1, &epoch, 8);
+  uint8_t ack = 0;
+  uint64_t now_epoch = 0;
+  if (!send_all(c->fd, header, sizeof(header)) ||
+      !recv_all(c->fd, &ack, 1) || ack != 1 ||
+      !recv_all(c->fd, &now_epoch, 8))
+    return -1;
+  return static_cast<int64_t>(now_epoch);
 }
 
 // heartbeat (action 6): renew this worker's lease, reporting the client's

@@ -125,7 +125,24 @@ class NativeSocketParameterServer:
     def __init__(self, center: Pytree, rule: MergeRule, num_workers: int,
                  host: str = "127.0.0.1", port: int = 0,
                  ema_decay: float | None = None,
-                 lease_timeout: float | None = None):
+                 lease_timeout: float | None = None,
+                 wal_dir: str | None = None, snapshot_every: int = 100,
+                 fence_epoch: int = 0):
+        if wal_dir is not None:
+            # graceful degrade (ISSUE 5): the C++ server has no WAL yet —
+            # a run asking for durability on the native transport gets a
+            # loud warning and an undurable (but otherwise identical)
+            # server, instead of a crash or a silent ignore. The fencing
+            # protocol (FENCE / COMMIT_SEQ_E) IS implemented natively.
+            import warnings
+
+            warnings.warn(
+                "ps_transport='native' has no write-ahead log yet: "
+                "ps_wal_dir is ignored and this PS will not survive a "
+                "crash — use ps_transport='socket' for durability",
+                stacklevel=2,
+            )
+        self._requested_fence_epoch = int(fence_epoch)
         self._lib = load_dkps(required=True)
         self.spec = FlatSpec(center)
         self.rule = rule
@@ -167,6 +184,8 @@ class NativeSocketParameterServer:
             )
         self._handle = h
         self.port = int(self._lib.dkps_server_port(h))
+        if self._requested_fence_epoch:
+            self._lib.dkps_server_fence(h, self._requested_fence_epoch)
         self._t_start = time.monotonic()  # stats() rate denominator
 
     def start(self) -> None:
@@ -224,16 +243,30 @@ class NativeSocketParameterServer:
         the time since ``initialize()``."""
         from distkeras_tpu.parameter_servers import build_ps_stats
 
-        raw = (ctypes.c_uint64 * 13)()
+        raw = (ctypes.c_uint64 * 14)()
         self._lib.dkps_server_stats(self._handle, raw)
         (pulls, cpulls, commits, bytes_in, bytes_out, acq, wait, hold,
-         dups, active, evicted, heartbeats, retries) = (int(v) for v in raw)
+         dups, active, evicted, heartbeats, retries, fenced) = (
+            int(v) for v in raw)
         return build_ps_stats(
             pulls, cpulls, commits, bytes_in, bytes_out, acq, wait, hold,
             time.monotonic() - self._t_start, dup_commits=dups,
             active_workers=active, evicted_workers=evicted,
             heartbeats=heartbeats, worker_retries=retries,
+            fenced_commits=fenced, num_updates=self.num_updates,
         )
+
+    # -- fencing (protocol parity with the Python PS) ------------------------
+
+    @property
+    def fence_epoch(self) -> int:
+        if self._handle is None:
+            return self._requested_fence_epoch
+        return int(self._lib.dkps_server_fence_epoch(self._handle))
+
+    def fence(self, epoch: int) -> int:
+        """Raise the fencing epoch (monotone); returns the new value."""
+        return int(self._lib.dkps_server_fence(self._handle, int(epoch)))
 
 
 class NativePSClient:
@@ -242,7 +275,8 @@ class NativePSClient:
 
     def __init__(self, host: str, port: int, worker_id: int, spec: FlatSpec,
                  connect_timeout: float = 30.0,
-                 pull_compression: str | None = None):
+                 pull_compression: str | None = None,
+                 epoch: int | None = None):
         import socket as _socket
 
         from distkeras_tpu.parallel.compression import (
@@ -250,6 +284,9 @@ class NativePSClient:
         )
 
         self.pull_compression = validate_pull_compression(pull_compression)
+        # fencing token: commits with seq AND epoch ride COMMIT_SEQ_E
+        # (action 10); None = legacy COMMIT_SEQ, never fenced
+        self.epoch = None if epoch is None else int(epoch)
         self._lib = load_dkps(required=True)
         self.worker_id = int(worker_id)
         self.spec = spec
@@ -316,6 +353,25 @@ class NativePSClient:
                 )
             return self._commit_int8(payload)
         vec = np.ascontiguousarray(self.spec.flatten(payload))
+        if seq is not None and self.epoch is not None:
+            # COMMIT_SEQ_E (action 10): dedup + fencing — a mismatched
+            # epoch is rejected server-side and surfaces as the typed
+            # fatal-or-re-resolve FencedEpochError, like the socket wire
+            from distkeras_tpu.networking import FencedEpochError
+
+            sepoch = ctypes.c_uint64(0)
+            rc = self._lib.dkps_client_commit_seq_e(
+                self._handle, int(self.epoch), int(seq), _f32p(vec),
+                ctypes.byref(sepoch),
+            )
+            if rc < 0:
+                raise ConnectionError("dkps commit failed (server gone?)")
+            if rc == 2:
+                raise FencedEpochError(
+                    "commit fenced by the native server",
+                    client_epoch=self.epoch, server_epoch=int(sepoch.value),
+                )
+            return
         if seq is not None:
             # COMMIT_SEQ (action 7): server-side (worker, seq) dedup —
             # replay-safe; a duplicate ack (rc 1) is success
@@ -340,6 +396,14 @@ class NativePSClient:
         """Clean exit: drop this worker's lease without an eviction."""
         if self._lib.dkps_client_deregister(self._handle) != 0:
             raise ConnectionError("dkps deregister failed (server gone?)")
+
+    def fence(self, epoch: int) -> int:
+        """Admin (FENCE, action 9): raise the server's fencing epoch;
+        returns the post-fence value."""
+        rc = int(self._lib.dkps_client_fence(self._handle, int(epoch)))
+        if rc < 0:
+            raise ConnectionError("dkps fence failed (server gone?)")
+        return rc
 
     def _commit_int8(self, blob: dict) -> None:
         """Ship an Int8Codec blob on the segmented-int8 wire (action 4):

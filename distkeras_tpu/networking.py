@@ -67,6 +67,27 @@ class ProtocolError(ConnectionError):
         self.retryable = retryable
 
 
+class FencedEpochError(ProtocolError):
+    """A parameter-server rejected an operation carrying a stale fencing
+    epoch: a failover promoted a new primary (or a restart bumped the
+    epoch) and this client's token predates it. NOT retryable against the
+    same server — the epoch mismatch is deterministic, a replay can only
+    be fenced again. The resilient client treats it as retryable ONLY
+    when its endpoint resolver has already moved to a newer epoch (the
+    reconnect adopts the new token); without that, it is the fatal signal
+    that this worker belongs to a superseded history.
+    """
+
+    def __init__(self, message: str, *, client_epoch: int | None = None,
+                 server_epoch: int | None = None, peer: str | None = None):
+        ctx = ""
+        if client_epoch is not None or server_epoch is not None:
+            ctx = f" (client epoch {client_epoch}, server epoch {server_epoch})"
+        super().__init__(message + ctx, peer=peer, retryable=False)
+        self.client_epoch = client_epoch
+        self.server_epoch = server_epoch
+
+
 def _peer_of(sock: socket.socket) -> str | None:
     """Best-effort peer label for error context (never raises)."""
     try:

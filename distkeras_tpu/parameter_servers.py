@@ -119,13 +119,23 @@ class ParameterServer:
 
     def __init__(self, center: Pytree, rule: MergeRule, num_workers: int,
                  ema_decay: float | None = None,
-                 lease_timeout: float | None = None):
+                 lease_timeout: float | None = None,
+                 wal_dir: str | None = None, snapshot_every: int = 100,
+                 fence_epoch: int = 0):
         from distkeras_tpu.resilience.heartbeat import WorkerRegistry
 
         self.center = utils.tree_to_numpy(center)
         self.rule = rule
         self.num_workers = int(num_workers)
         self.num_updates = 0
+        # Fencing epoch (resilience/wal.py, DESIGN.md "PS durability"):
+        # commits carrying an epoch token are folded only when it matches;
+        # a mismatch raises FencedEpochError — the mechanism that rejects
+        # a superseded history's late folds after a failover promoted a
+        # new primary. Epoch-less commits (legacy clients) are never
+        # fenced. Guarded by the center lock.
+        self.fence_epoch = int(fence_epoch)
+        self._n_fenced_commits = 0
         # center lock (timed: stats() reports its wait/hold) — see the
         # module docstring for the full locking discipline
         self._lock = _TimedLock()
@@ -195,6 +205,84 @@ class ParameterServer:
         self._center_nbytes = sum(
             np.asarray(l).nbytes for l in _tree_leaves(self.center)
         )
+        # -- durability (resilience/wal.py): write-ahead commit log + the
+        # hot-standby replication stream. Both sinks receive the SAME
+        # framed records, appended/sent inside the center lock so the
+        # durable order IS the fold order, and always BEFORE the caller
+        # gets its ACK (append-before-ACK is what makes a torn-log commit
+        # safely replayable: no ACK went out, the client retries, the
+        # recovered dedup table folds it once). The O(model) payload
+        # pickle runs BEFORE the lock; only the buffered write rides the
+        # critical section. A standby send failure degrades: the replica
+        # is dropped (counted), never wedging the fold path for good.
+        self._wal = None
+        self.recovered_ = False
+        self.wal_replay_s = 0.0
+        if wal_dir is not None:
+            from distkeras_tpu.resilience.wal import (
+                CommitLog,
+                recover_ps_state,
+            )
+
+            t0 = time.monotonic()
+            state = recover_ps_state(
+                wal_dir, rule, self.num_workers, self.ema_decay,
+                template=self.center,
+            )
+            if state is not None:
+                self._adopt_state(state)
+                self.recovered_ = True
+                self.wal_replay_s = time.monotonic() - t0
+            self._wal = CommitLog(wal_dir, snapshot_every=snapshot_every)
+            self._wal.open_segment(self.num_updates)
+        self._replica_sock = None   # hot-standby stream (attach_standby)
+        self._n_standby_drops = 0
+        self._snap_pending: dict | None = None
+        # chaos seam: called with the post-fold version after every
+        # applied commit, OUTSIDE the center lock. The kill-PS fault
+        # wiring crashes the server from here — deterministic in commit
+        # count (a poll-based kill can miss a fast run entirely), and
+        # mid-service, so in-flight ACKs tear exactly like a real kill.
+        self.post_commit_hook = None
+
+    def _adopt_state(self, state: dict) -> None:
+        """Install a recovered/streamed full state (wal.ps_state_dict
+        shape). Callers hold no locks yet (construction / standby apply
+        loop)."""
+        self.center = state["center"]
+        self.num_updates = int(state["num_updates"])
+        self._pull_versions = dict(state["pull_versions"])
+        self._last_seq = dict(state["last_seq"])
+        self.fence_epoch = max(self.fence_epoch, int(state["fence_epoch"]))
+        if self.ema_decay is not None and state.get("ema") is not None:
+            self._ema = state["ema"]
+            self._ema_version = int(state["ema_version"])
+            self._ema_scratch = _tree_map(np.empty_like, self._ema)
+        self._center_nbytes = sum(
+            np.asarray(l).nbytes for l in _tree_leaves(self.center)
+        )
+
+    def _capture_state_locked(self) -> dict:
+        """Capture the center-side recoverable state — call under the
+        center lock. O(workers) dict copies + O(1) refs (the published
+        center is an immutable copy-on-write snapshot). The EMA is added
+        AFTERWARD by ``_attach_ema_state`` under its own lock (one lock
+        at a time — the discipline holds); its version may run ahead of
+        the captured center version, which replay handles by skipping
+        EMA folds at or below the stored ``ema_version``."""
+        from distkeras_tpu.resilience.wal import ps_state_dict
+
+        return ps_state_dict(
+            self.center, self.num_updates, self._pull_versions,
+            self._last_seq, None, 0, self.fence_epoch,
+        )
+
+    def _attach_ema_state(self, state: dict) -> dict:
+        if self._ema is not None:
+            with self._ema_lock:
+                state["ema"] = jax_tree_copy(self._ema)
+                state["ema_version"] = self._ema_version
+        return state
 
     # -- service lifecycle (no-ops for the in-process PS) --------------------
 
@@ -205,7 +293,21 @@ class ParameterServer:
         pass
 
     def stop(self) -> None:
-        pass
+        self._close_durability()
+
+    def _close_durability(self) -> None:
+        """Flush + close the WAL and the replication stream (clean stop —
+        a CRASH, by definition, skips this and leans on the per-record
+        flushes)."""
+        if self._wal is not None:
+            self._wal.close()
+        sock = self._replica_sock
+        self._replica_sock = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     # -- the wire actions ----------------------------------------------------
 
@@ -249,6 +351,14 @@ class ParameterServer:
         worker's residual state when compressing."""
         with self._lock:
             self._pull_versions[worker_id] = self.num_updates
+            if self._wal is not None or self._replica_sock is not None:
+                # pull versions are recoverable state (DynSGD prices the
+                # NEXT commit off them) — a tiny framed record per pull
+                from distkeras_tpu.resilience import wal as _wal
+
+                self._log_locked(_wal.encode_record(
+                    _wal.REC_PULL, (int(worker_id), int(self.num_updates))
+                ))
             snap = self.center
             st = None
             if compressed:
@@ -343,7 +453,7 @@ class ParameterServer:
                 nbytes)
 
     def commit(self, worker_id: int, payload: Pytree,
-               seq: int | None = None) -> bool:
+               seq: int | None = None, epoch: int | None = None) -> bool:
         """Fold one worker's commit into the center under the center lock.
 
         Commits may arrive codec-compressed (``parallel.compression`` —
@@ -351,30 +461,51 @@ class ParameterServer:
         tree, so merge-rule semantics are codec-independent. Decode runs
         before the lock and the per-commit EMA fold after it (under the
         EMA lock, against the just-published snapshot) — the center lock's
-        critical section is exactly the fold.
+        critical section is exactly the fold (plus, when durability is on,
+        one buffered WAL/replica write of the PRE-pickled record: the
+        O(model) pickle runs before the lock).
 
         ``seq`` (per-worker, monotone, assigned by the resilient client)
         makes the fold exactly-once under retries: a (worker, seq) pair
         already applied is counted as a duplicate and skipped — the
         retried-after-lost-ACK commit never double-folds. ``seq=None``
-        (legacy callers) keeps at-most-once-per-call semantics. Returns
-        True when the commit folded, False when it was a duplicate.
+        (legacy callers) keeps at-most-once-per-call semantics.
+
+        ``epoch`` is the client's fencing token: a mismatch against
+        ``fence_epoch`` raises :class:`~distkeras_tpu.networking.
+        FencedEpochError` WITHOUT folding — the late commit of a zombie
+        primary's worker (or a fenced server's client) is rejected, never
+        silently absorbed into a superseded history. ``epoch=None``
+        (legacy clients) is never fenced.
+
+        Returns True when the commit folded, False when it was a
+        duplicate.
         """
         nbytes = self._payload_nbytes(payload)  # wire size: BEFORE decode
         payload = maybe_decode(payload)
+        rec_payload = None
+        if self._wal is not None or self._replica_sock is not None:
+            # durable sinks replay the EXACT fold input: coerce to numpy
+            # once (workers already send numpy trees; this is a no-op
+            # pass) and pickle OUTSIDE the lock. The fold below uses the
+            # same coerced tree, so replay is bit-identical.
+            payload = utils.tree_to_numpy(payload)
+            rec_payload = pickle.dumps(
+                payload, protocol=pickle.HIGHEST_PROTOCOL
+            )
+        snap_state = None
         with self._lock:
-            if seq is not None:
+            fenced = epoch is not None and epoch != self.fence_epoch
+            server_epoch = self.fence_epoch
+            dup = False
+            if not fenced and seq is not None:
                 if seq <= self._last_seq.get(worker_id, 0):
                     dup = True
                 else:
                     self._last_seq[worker_id] = seq
-                    dup = False
-            else:
-                dup = False
-            if not dup:
-                staleness = (
-                    self.num_updates - self._pull_versions.get(worker_id, 0)
-                )
+            if not fenced and not dup:
+                pull_version = self._pull_versions.get(worker_id, 0)
+                staleness = self.num_updates - pull_version
                 self.center = utils.tree_to_numpy(
                     self.rule.fold(
                         self.center, payload, self.num_workers, staleness
@@ -383,10 +514,52 @@ class ParameterServer:
                 self.num_updates += 1
                 version = self.num_updates
                 snap = self.center
+                if rec_payload is None and (
+                        self._wal is not None
+                        or self._replica_sock is not None):
+                    # an attach_standby raced in between the pre-lock
+                    # sink check and this fold: encode here (O(model)
+                    # under the lock, but only for the one commit that
+                    # straddles the attach) so the stream never misses a
+                    # fold the attach-time base state didn't include
+                    payload = utils.tree_to_numpy(payload)
+                    rec_payload = pickle.dumps(
+                        payload, protocol=pickle.HIGHEST_PROTOCOL
+                    )
+                if rec_payload is not None:
+                    from distkeras_tpu.resilience import wal as _wal
+
+                    rec = _wal.encode_record(
+                        _wal.REC_COMMIT,
+                        (int(worker_id),
+                         None if seq is None else int(seq),
+                         int(pull_version), int(version), rec_payload),
+                    )
+                    self._log_locked(rec, commit=True)
+                if self._wal is not None and self._wal.should_snapshot():
+                    # phase 1 under the lock: rotate the segment at this
+                    # exact version and capture the center-side state;
+                    # the O(model) serialize+fsync publish runs after the
+                    # lock (and after this commit's EMA fold, so the
+                    # snapshot's EMA is never behind its center)
+                    self._wal.rotate(self.num_updates)
+                    snap_state = self._capture_state_locked()
+            if fenced:
+                self._n_fenced_commits += 1
+        if fenced:
+            # the payload still crossed the wire: count its bytes (the
+            # native server does — stats parity), just not a commit
+            self._count(bytes_in=nbytes)
+            raise networking.FencedEpochError(
+                "commit fenced: a newer primary holds this history",
+                client_epoch=epoch, server_epoch=server_epoch,
+            )
         if dup:
             self._count(dup_commits=1, bytes_in=nbytes)
             return False
         self._count(commits=1, bytes_in=nbytes)
+        if self._wal is not None:
+            self._wal.maybe_fsync()  # periodic, off the critical section
         if self._ema is not None:
             d = self.ema_decay
 
@@ -403,7 +576,36 @@ class ParameterServer:
                 if version > self._ema_version:
                     self._ema_version = version
                     _tree_map(fma, self._ema, snap, self._ema_scratch)
+        if snap_state is not None:
+            self._attach_ema_state(snap_state)
+            self._wal.publish_snapshot(snap_state)
+        hook = self.post_commit_hook
+        if hook is not None:
+            hook(version)
         return True
+
+    def _log_locked(self, rec: bytes, commit: bool = False) -> None:
+        """Hand one framed record to every durable sink — call under the
+        center lock (durable order == fold order; append-before-ACK).
+        The WAL write is buffered; the replica send lands in the kernel
+        socket buffer (a primary crash still flushes it — semi-sync
+        replication). A replica send failure degrades to running without
+        the standby instead of wedging the fold path."""
+        if self._wal is not None:
+            self._wal.append(rec)
+            if commit:
+                self._wal.commits_since_snapshot += 1
+        sock = self._replica_sock
+        if sock is not None:
+            try:
+                sock.sendall(rec)
+            except OSError:
+                self._replica_sock = None
+                self._n_standby_drops += 1
+                try:
+                    sock.close()
+                except OSError:
+                    pass
 
     def get_model(self) -> Pytree:
         with self._lock:
@@ -427,13 +629,83 @@ class ParameterServer:
         self._registry.deregister(worker_id)
         with self._lock:
             self._last_seq.pop(worker_id, None)
+            if self._wal is not None or self._replica_sock is not None:
+                from distkeras_tpu.resilience import wal as _wal
+
+                self._log_locked(
+                    _wal.encode_record(_wal.REC_DEREG, (int(worker_id),))
+                )
 
     def _on_evict(self, worker_ids: list[int]) -> None:
         """Lease expiry → forget the workers' pull versions, so DynSGD
-        treats any zombie commit as maximally stale (τ = num_updates)."""
+        treats any zombie commit as maximally stale (τ = num_updates) —
+        and retire their commit-dedup entries too, so elastic runs with
+        many worker generations never grow ``_last_seq`` without bound.
+        (The dedup loss is safe in practice: a replayed commit surviving
+        past a whole lease timeout re-folds priced at maximal τ; the
+        eviction/commit-race test pins that pricing.)"""
         with self._lock:
             for wid in worker_ids:
                 self._pull_versions.pop(wid, None)
+                self._last_seq.pop(wid, None)
+            if self._wal is not None or self._replica_sock is not None:
+                from distkeras_tpu.resilience import wal as _wal
+
+                self._log_locked(_wal.encode_record(
+                    _wal.REC_EVICT, ([int(w) for w in worker_ids],)
+                ))
+
+    def fence(self, epoch: int) -> int:
+        """Raise the fencing epoch (monotone): commits carrying an older
+        token are rejected from here on. Called on a superseded primary by
+        the promoting supervisor (best effort — a dead primary needs no
+        fencing) and on a recovered/promoted server to stamp its new
+        history. Durable before returning when a WAL is attached."""
+        with self._lock:
+            self.fence_epoch = max(self.fence_epoch, int(epoch))
+            out = self.fence_epoch
+            if self._wal is not None or self._replica_sock is not None:
+                from distkeras_tpu.resilience import wal as _wal
+
+                self._log_locked(
+                    _wal.encode_record(_wal.REC_FENCE, (out,))
+                )
+        if self._wal is not None:
+            self._wal.sync()  # the fence ack implies durability
+        return out
+
+    def attach_standby(self, host: str, port: int,
+                       timeout: float = 10.0) -> None:
+        """Connect the hot-standby replication stream: send the replica a
+        full state snapshot, then stream every subsequent record (commit /
+        pull / dereg / evict / fence) before the corresponding ACK goes
+        out. Call BEFORE serving traffic — attaching mid-stream can leave
+        the replica's EMA behind by in-flight post-lock EMA folds (the
+        center itself is always exact)."""
+        state = self._attach_ema_state({})  # EMA first: see docstring
+        sock = networking.connect(host, int(port), timeout=timeout)
+        sock.settimeout(timeout)
+        with self._lock:
+            base = self._capture_state_locked()
+            base["ema"] = state.get("ema")
+            base["ema_version"] = state.get("ema_version", 0)
+            networking.send_data(
+                sock, {"action": "replicate_stream", "state": base}
+            )
+            reply = networking.recv_data(sock)
+            if not reply.get("ok"):
+                sock.close()
+                raise ConnectionError(
+                    f"standby at {host}:{port} refused the replication "
+                    f"stream: {reply}"
+                )
+            self._replica_sock = sock
+        sock.settimeout(5.0)  # per-record send bound: a wedged standby
+        # must cost at most one bounded stall before being dropped
+
+    @property
+    def has_standby(self) -> bool:
+        return self._replica_sock is not None
 
     def get_ema(self) -> Pytree:
         """The Polyak-averaged center (None unless ``ema_decay`` was set)."""
@@ -538,6 +810,8 @@ class ParameterServer:
             evicted_workers=hb["evicted_workers"],
             heartbeats=hb["heartbeats"],
             worker_retries=hb["worker_retries"],
+            fenced_commits=self._n_fenced_commits,
+            num_updates=self.num_updates,
         )
 
 
@@ -546,7 +820,8 @@ def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
                    lock_wait_ns: int, lock_hold_ns: int,
                    elapsed_s: float, dup_commits: int = 0,
                    active_workers: int = 0, evicted_workers: int = 0,
-                   heartbeats: int = 0, worker_retries: int = 0) -> dict:
+                   heartbeats: int = 0, worker_retries: int = 0,
+                   fenced_commits: int = 0, num_updates: int = 0) -> dict:
     """The ONE stats-dict builder both PS transports share (Python counters
     here, C++ atomics via ``native_ps.NativeSocketParameterServer.stats``):
     key set and derived-value math are pinned by construction, so the
@@ -573,6 +848,12 @@ def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
         "evicted_workers": evicted_workers,
         "heartbeats": heartbeats,
         "worker_retries": worker_retries,
+        "fenced_commits": fenced_commits,
+        # lifetime fold count: unlike the op counters (which restart at
+        # zero on a recovered/promoted server), num_updates is part of
+        # the durable state — THE counter for the cross-failover
+        # exactly-once oracle (num_updates == logical commits issued)
+        "num_updates": num_updates,
     }
 
 
@@ -611,15 +892,22 @@ class SocketParameterServer(ParameterServer):
     def __init__(self, center: Pytree, rule: MergeRule, num_workers: int,
                  host: str = "127.0.0.1", port: int = 0,
                  ema_decay: float | None = None,
-                 lease_timeout: float | None = None):
+                 lease_timeout: float | None = None,
+                 wal_dir: str | None = None, snapshot_every: int = 100,
+                 fence_epoch: int = 0):
         super().__init__(center, rule, num_workers, ema_decay=ema_decay,
-                         lease_timeout=lease_timeout)
+                         lease_timeout=lease_timeout, wal_dir=wal_dir,
+                         snapshot_every=snapshot_every,
+                         fence_epoch=fence_epoch)
         self.host = host
         self.port = int(port)
         self._server_sock: Any = None
         self._service_thread: threading.Thread | None = None
         self._handlers: list[threading.Thread] = []
+        self._conns: list = []          # live handler sockets (crash seam)
+        self._conns_lock = threading.Lock()
         self._running = False
+        self.crashed_ = False
 
     def initialize(self) -> None:
         import socket as _socket
@@ -651,6 +939,8 @@ class SocketParameterServer(ParameterServer):
                 __import__("socket").IPPROTO_TCP,
                 __import__("socket").TCP_NODELAY, 1,
             )
+            with self._conns_lock:
+                self._conns.append(conn)
             t = threading.Thread(target=self._handle, args=(conn,), daemon=True)
             t.start()
             self._handlers.append(t)
@@ -674,10 +964,37 @@ class SocketParameterServer(ParameterServer):
                     # dropped reply — parity with dkps.cpp PULL_INT8)
                     self._serve_compressed_pull(conn, msg["worker_id"])
                 elif action == "commit":
-                    applied = self.commit(msg["worker_id"], msg["payload"],
-                                          seq=msg.get("seq"))
+                    try:
+                        applied = self.commit(
+                            msg["worker_id"], msg["payload"],
+                            seq=msg.get("seq"), epoch=msg.get("epoch"),
+                        )
+                    except networking.FencedEpochError as fe:
+                        # fencing is a protocol-level verdict, not a dead
+                        # connection: answer with the server's epoch so
+                        # the client can raise a typed, fatal error
+                        networking.send_data(conn, {
+                            "error": "fenced",
+                            "epoch": fe.server_epoch,
+                        })
+                        continue
                     networking.send_data(conn, {"ok": True,
                                                 "dup": not applied})
+                elif action == "ping":
+                    # liveness probe for the trainer-side failover
+                    # supervisor (and the client's epoch discovery)
+                    networking.send_data(conn, {
+                        "ok": True, "epoch": self.fence_epoch,
+                        "num_updates": self.num_updates,
+                        "standby": bool(getattr(self, "is_standby", False)),
+                    })
+                elif action == "fence":
+                    # admin: raise the fencing epoch (the promoting
+                    # supervisor fences a superseded primary with this)
+                    networking.send_data(
+                        conn, {"ok": True,
+                               "epoch": self.fence(int(msg["epoch"]))}
+                    )
                 elif action == "heartbeat":
                     # lease renewal (auto-registers); retries is the
                     # client's cumulative reconnect-and-retry count
@@ -688,6 +1005,11 @@ class SocketParameterServer(ParameterServer):
                 elif action == "deregister":
                     self.deregister_worker(msg["worker_id"])
                     networking.send_data(conn, {"ok": True})
+                elif action == "replicate_stream":
+                    # hot-standby replication (StandbySocketParameterServer
+                    # overrides; a primary politely refuses)
+                    if self._serve_replication(conn, msg):
+                        break
                 elif action in ("stop", "bye"):
                     break
                 else:
@@ -699,7 +1021,16 @@ class SocketParameterServer(ParameterServer):
             # drop the connection quietly, don't kill the handler loudly
             pass
         finally:
+            with self._conns_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
             conn.close()
+
+    def _serve_replication(self, conn, msg) -> bool:
+        """Only a standby accepts a replication stream; True = the
+        connection was consumed to completion (close it)."""
+        networking.send_data(conn, {"ok": False, "error": "not a standby"})
+        return False
 
     def _serve_pull(self, conn, worker_id: int) -> None:
         """Wire variant of the exact ``pull``: serializes the immutable
@@ -740,6 +1071,7 @@ class SocketParameterServer(ParameterServer):
         """Shut down, unblocking ``accept`` via the reference's self-connect
         trick (``cancel_accept``), with a socket close as backstop."""
         if not self._running:
+            self._close_durability()
             return
         self._running = False
         try:
@@ -751,6 +1083,219 @@ class SocketParameterServer(ParameterServer):
             self._server_sock.close()  # unblocks accept even if connect failed
         if self._service_thread is not None:
             self._service_thread.join(timeout=5)
+        self._close_durability()
+
+    def _crash(self) -> None:
+        """Chaos seam: die like a SIGKILL'd process, not a clean stop.
+
+        Rips the listener and every live connection out mid-flight (peers
+        see resets/EOF) and abandons the WAL WITHOUT the close-time fsync
+        — exactly the state a killed process leaves: whatever each
+        append's flush already handed the OS is durable, nothing else.
+        Recovery and failover are tested against THIS, not against
+        ``stop()``'s tidy shutdown."""
+        import socket as _socket
+
+        self.crashed_ = True
+        self._running = False
+        if self._server_sock is not None:
+            try:
+                self._server_sock.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        # drop the WAL handle without fsync: a real kill never syncs (the
+        # per-append flushes already handed every record to the OS)
+        if self._wal is not None and self._wal._fh is not None:
+            fh, self._wal._fh = self._wal._fh, None
+            try:
+                fh.close()
+            except OSError:
+                pass
+        sock = self._replica_sock
+        self._replica_sock = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class StandbySocketParameterServer(SocketParameterServer):
+    """Warm replica: applies the primary's replication stream, serves
+    nothing until promoted.
+
+    Lifecycle: construct + ``initialize()`` + ``start()`` like any socket
+    PS (its address is known up front, so failover never waits on a
+    bind), then the primary's ``attach_standby`` opens the replication
+    connection: one full-state snapshot frame, then raw WAL-framed
+    records (``resilience/wal.py``) applied sequentially through the SAME
+    ``replay_record`` path crash recovery uses — stream-apply and
+    disk-replay cannot diverge. Worker actions are refused with a
+    ``standby`` error (retryable weather to a confused client) until
+    ``promote(epoch)`` installs the replicated state under the center
+    lock, stamps the new fencing epoch, and flips it into an ordinary
+    serving PS. The replication connection is closed at promotion — a
+    zombie primary's next streamed record fails its send and the zombie
+    drops into standalone (and soon fenced) mode.
+    """
+
+    def __init__(self, center: Pytree, rule: MergeRule, num_workers: int,
+                 host: str = "127.0.0.1", port: int = 0,
+                 ema_decay: float | None = None,
+                 lease_timeout: float | None = None,
+                 wal_dir: str | None = None, snapshot_every: int = 100):
+        super().__init__(center, rule, num_workers, host=host, port=port,
+                         ema_decay=ema_decay, lease_timeout=lease_timeout,
+                         wal_dir=wal_dir, snapshot_every=snapshot_every)
+        self.is_standby = True
+        self._repl_lock = threading.Lock()
+        self._repl_state: dict | None = None
+        self._repl_records = 0
+        self._repl_streaming = False
+        self.promoted_ = False
+
+    def _handle(self, conn) -> None:
+        if not self.is_standby:
+            return super()._handle(conn)
+        # pre-promotion: only the replication stream and pings are served;
+        # worker ops get a retryable "standby" refusal (a client that
+        # found us too early just backs off until promotion)
+        try:
+            while True:
+                msg = networking.recv_data(conn)
+                action = msg.get("action")
+                if action == "replicate_stream":
+                    if self._serve_replication(conn, msg):
+                        break
+                elif action == "ping":
+                    # read the state ref once: promote() nulls it from
+                    # the supervisor thread, and a torn read here would
+                    # kill the handler with a TypeError outside its
+                    # caught exception set
+                    state = self._repl_state
+                    networking.send_data(conn, {
+                        "ok": True, "epoch": self.fence_epoch,
+                        "num_updates": (
+                            state["num_updates"] if state is not None
+                            else self.num_updates
+                        ),
+                        "standby": True,
+                    })
+                elif action in ("stop", "bye"):
+                    break
+                elif not self.is_standby:
+                    # promoted mid-connection: hand the rest of this
+                    # client's session to the full handler loop... which
+                    # reads its own frames; simplest is to drop the conn
+                    # and let the client reconnect to the promoted server
+                    break
+                else:
+                    networking.send_data(
+                        conn, {"error": "standby", "standby": True}
+                    )
+        except (ConnectionError, EOFError, OSError):
+            pass
+        except pickle.UnpicklingError:
+            pass
+        finally:
+            with self._conns_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+            conn.close()
+
+    def _serve_replication(self, conn, msg) -> bool:
+        from distkeras_tpu.resilience import wal as _wal
+
+        with self._repl_lock:
+            self._repl_state = dict(msg["state"])
+            self._repl_streaming = True
+        networking.send_data(conn, {"ok": True})
+        # raw record stream from here on: header + body frames straight
+        # off the socket (no pickle-frame wrapper per record)
+        hdr = _wal._HDR
+        try:
+            while True:
+                head = networking._recv_exact(conn, hdr.size)
+                rec_type, crc, ln = hdr.unpack(head)
+                body = networking._recv_exact(conn, ln, expected=ln)
+                recs = list(_wal.iter_records(head + body))
+                if not recs:
+                    raise networking.ProtocolError(
+                        "corrupt replication record", retryable=False
+                    )
+                with self._repl_lock:
+                    if not self.is_standby:
+                        return True  # promoted: this stream is history
+                    self._repl_records += 1
+                    _wal.replay_record(
+                        self._repl_state, recs[0][0], recs[0][1],
+                        self.rule, self.num_workers, self.ema_decay,
+                    )
+        finally:
+            # promote()'s drain loop watches this flag: stream-end (the
+            # dead primary's kernel flushed its buffer and FIN'd) means
+            # every ACKed record has been applied
+            with self._repl_lock:
+                self._repl_streaming = False
+
+    def promote(self, epoch: int, drain_timeout: float = 5.0) -> None:
+        """Become the primary: drain the replication stream, install the
+        replicated state, stamp the new fencing epoch, start answering
+        worker ops. Safe without a stream too (a standby promoted before
+        any attach serves its constructor state — a cold-start primary).
+
+        The drain matters for exactly-once: the primary ACKs a commit
+        after ``sendall``-ing its record, so at the moment of death
+        ACKed records may still sit in this side's socket buffer or
+        behind the apply loop. Promoting without draining would discard
+        folds whose clients will never retry them. A dead primary's
+        kernel flushes the buffer and FINs, so the stream reaches EOF in
+        bounded time; waiting for EOF — or, against a still-alive zombie
+        that keeps streaming, for ``drain_timeout`` of quiescence-free
+        grace — closes the gap. (A zombie's post-promotion folds belong
+        to the superseded history anyway; fencing rejects their clients'
+        next commits.)"""
+        deadline = time.monotonic() + float(drain_timeout)
+        last = -1
+        while time.monotonic() < deadline:
+            with self._repl_lock:
+                streaming = self._repl_streaming
+                applied = self._repl_records
+            if not streaming:
+                break  # EOF: every record the primary sent is applied
+            if applied == last:
+                # stream still open but idle for one poll: the primary
+                # is alive-but-presumed-dead; take what has arrived
+                break
+            last = applied
+            time.sleep(0.05)
+        with self._repl_lock:
+            state = self._repl_state
+            self._repl_state = None
+            with self._lock:
+                if state is not None:
+                    self._adopt_state(state)
+                self.fence_epoch = max(self.fence_epoch, int(epoch))
+                if self._wal is not None:
+                    # the promoted history gets its own durable log
+                    self._wal.rotate(self.num_updates)
+                    snap = self._capture_state_locked()
+            self.is_standby = False
+            self.promoted_ = True
+        if self._wal is not None:
+            self._attach_ema_state(snap)
+            self._wal.publish_snapshot(snap)
 
 
 class ParameterServerClient:
@@ -758,14 +1303,20 @@ class ParameterServerClient:
     the in-process PS, so workers are transport-agnostic)."""
 
     def __init__(self, host: str, port: int, worker_id: int,
-                 pull_compression: str | None = None):
+                 pull_compression: str | None = None,
+                 epoch: int | None = None,
+                 connect_timeout: float | None = 30.0):
         from distkeras_tpu.parallel.compression import (
             validate_pull_compression,
         )
 
         self.pull_compression = validate_pull_compression(pull_compression)
         self.worker_id = worker_id
-        self._sock = networking.connect(host, port)
+        # fencing token carried on every commit (None = legacy, never
+        # fenced); a resilient client's endpoint resolver hands each
+        # reconnect the CURRENT epoch, so failing over adopts the new one
+        self.epoch = None if epoch is None else int(epoch)
+        self._sock = networking.connect(host, port, timeout=connect_timeout)
         # Blocking ops: a pull may legitimately wait behind many commits
         # (GIL-contended host, slow DCN link) — don't time out mid-training.
         self._sock.settimeout(None)
@@ -776,8 +1327,34 @@ class ParameterServerClient:
             self._sock,
             {"action": action, "worker_id": self.worker_id},
         )
-        weights = networking.recv_data(self._sock)["weights"]
-        return maybe_decode(weights)
+        reply = networking.recv_data(self._sock)
+        if "weights" not in reply:
+            # an unpromoted standby (or other typed refusal): retryable —
+            # the failover completes or the resolver moves us
+            raise networking.ProtocolError(
+                f"pull refused: {reply.get('error', reply)}", retryable=True
+            )
+        return maybe_decode(reply["weights"])
+
+    def ping(self, timeout: float | None = None) -> dict:
+        """Liveness probe: ``{"ok", "epoch", "num_updates", "standby"}``.
+        ``timeout`` bounds just this round-trip (restored after)."""
+        old = self._sock.gettimeout()
+        if timeout is not None:
+            self._sock.settimeout(timeout)
+        try:
+            networking.send_data(self._sock, {"action": "ping"})
+            return networking.recv_data(self._sock)
+        finally:
+            self._sock.settimeout(old)
+
+    def fence(self, epoch: int) -> int:
+        """Admin: raise the server's fencing epoch (the promoting
+        supervisor's last word to a superseded primary)."""
+        networking.send_data(
+            self._sock, {"action": "fence", "epoch": int(epoch)}
+        )
+        return int(networking.recv_data(self._sock).get("epoch", epoch))
 
     def commit(self, worker_id: int | None, payload: Pytree,
                seq: int | None = None) -> None:
@@ -794,8 +1371,22 @@ class ParameterServerClient:
             # per-worker commit seqno: the server folds each (worker, seq)
             # at most once — see ParameterServer.commit / resilience.retry
             msg["seq"] = int(seq)
+        if self.epoch is not None:
+            msg["epoch"] = self.epoch
         networking.send_data(self._sock, msg)
-        networking.recv_data(self._sock)  # ack
+        ack = networking.recv_data(self._sock)
+        err = ack.get("error") if isinstance(ack, dict) else None
+        if err == "fenced":
+            raise networking.FencedEpochError(
+                "commit fenced by the server",
+                client_epoch=self.epoch, server_epoch=ack.get("epoch"),
+            )
+        if err == "standby":
+            # found a not-yet-promoted replica: weather, not a bug — back
+            # off and retry (the promotion or a re-resolve fixes it)
+            raise networking.ProtocolError(
+                "server is an unpromoted standby", retryable=True
+            )
 
     def heartbeat(self, retries: int = 0) -> bool:
         """Renew this worker's lease (auto-registers); ``retries`` is the

@@ -15,10 +15,18 @@ PR 3's decontended PS hot path:
   per-worker seqnos deduplicated server-side (exactly-once folds).
 - :mod:`~distkeras_tpu.resilience.recovery` — :class:`WorkerSupervisor`,
   upgrading ``tolerate_worker_failures`` to restart-with-budget from the
-  latest checkpoint snapshot + a fresh center pull.
+  latest checkpoint snapshot + a fresh center pull; and
+  :class:`PSFailoverSupervisor`, the trainer-side lease on the PRIMARY
+  PS that promotes the hot standby (or restarts in place from the WAL)
+  and repoints every worker's :class:`PSEndpoint` resolver.
+- :mod:`~distkeras_tpu.resilience.wal` — PS durability:
+  :class:`CommitLog` write-ahead log + fsync'd snapshots, crash-restart
+  replay (``recover_ps_state``), and the record stream the hot standby
+  applies.
 
 Trainer-level knobs: ``retry_policy``, ``heartbeat_interval``,
-``lease_timeout``, ``worker_restart_budget``, ``fault_plan`` (see
+``lease_timeout``, ``worker_restart_budget``, ``fault_plan``,
+``ps_wal_dir``, ``ps_snapshot_every``, ``ps_standby`` (see
 ``DistributedTrainer``).
 """
 
@@ -29,14 +37,20 @@ from distkeras_tpu.resilience.faults import (  # noqa: F401
 )
 from distkeras_tpu.resilience.heartbeat import Lease, WorkerRegistry  # noqa: F401
 from distkeras_tpu.resilience.recovery import (  # noqa: F401
+    PSFailoverSupervisor,
     RestartBudgetExceeded,
     WorkerSupervisor,
 )
 from distkeras_tpu.resilience.retry import (  # noqa: F401
+    PSEndpoint,
     ResilientPSClient,
     RetryDeadlineExceeded,
     RetryPolicy,
     is_retryable,
+)
+from distkeras_tpu.resilience.wal import (  # noqa: F401
+    CommitLog,
+    recover_ps_state,
 )
 
 __all__ = [
@@ -45,10 +59,14 @@ __all__ = [
     "WorkerKilled",
     "Lease",
     "WorkerRegistry",
+    "PSFailoverSupervisor",
     "RestartBudgetExceeded",
     "WorkerSupervisor",
+    "PSEndpoint",
     "ResilientPSClient",
     "RetryDeadlineExceeded",
     "RetryPolicy",
     "is_retryable",
+    "CommitLog",
+    "recover_ps_state",
 ]

@@ -70,6 +70,17 @@ class FaultPlan:
       :class:`WorkerKilled` when it reaches that window (once; a
       restarted worker passing the same index survives).
 
+    Parameter-server faults (consulted by the trainer-side
+    ``PSFailoverSupervisor`` — resilience/recovery.py):
+
+    - ``kill_ps_after_commits``: crash-stop the PRIMARY parameter server
+      (``_crash()``: connections torn, no final fsync) once its applied
+      commit count crosses this threshold — deterministic in commit
+      count, not wall time. Fires once per run; the supervisor then
+      proves the failover (hot-standby promotion or WAL
+      restart-in-place). Requires the supervisor to be active
+      (``ps_standby=True`` or ``ps_wal_dir`` on the trainer).
+
     ``max_faults`` caps drops+partition hits (delays excluded) so runs
     terminate; ``stats()`` reports what was actually injected.
     """
@@ -79,7 +90,8 @@ class FaultPlan:
                  delay_s: float = 0.0, partition_after: int | None = None,
                  partition_ops: int = 0,
                  kill_at: dict[int, int] | None = None,
-                 max_faults: int | None = None):
+                 max_faults: int | None = None,
+                 kill_ps_after_commits: int | None = None):
         for name, p in (("drop_send", drop_send), ("drop_recv", drop_recv),
                         ("delay", delay)):
             if not 0.0 <= p <= 1.0:
@@ -93,14 +105,20 @@ class FaultPlan:
         self.partition_ops = int(partition_ops)
         self.kill_at = dict(kill_at or {})
         self.max_faults = max_faults
+        self.kill_ps_after_commits = (
+            None if kill_ps_after_commits is None
+            else int(kill_ps_after_commits)
+        )
         self._rng = np.random.Generator(np.random.Philox(self.seed))
         self._lock = threading.Lock()
         self._ops = 0
         self._killed: set[int] = set()
+        self._ps_killed = False
         self._n_drops = 0
         self._n_delays = 0
         self._n_partition_drops = 0
         self._n_kills = 0
+        self._n_ps_kills = 0
 
     # -- wire hook (installed into networking._fault_hook) -------------------
 
@@ -150,6 +168,22 @@ class FaultPlan:
             f"injected kill: worker {worker_id} at window {window_index}"
         )
 
+    # -- parameter-server hook (PSFailoverSupervisor) ------------------------
+
+    def should_kill_ps(self, num_updates: int) -> bool:
+        """True exactly until the kill is taken: the primary PS should be
+        crash-stopped now (its commit count crossed the threshold)."""
+        if self.kill_ps_after_commits is None:
+            return False
+        with self._lock:
+            return (not self._ps_killed
+                    and num_updates >= self.kill_ps_after_commits)
+
+    def note_ps_kill(self) -> None:
+        with self._lock:
+            self._ps_killed = True
+            self._n_ps_kills += 1
+
     # -- lifecycle -----------------------------------------------------------
 
     def install(self) -> None:
@@ -180,4 +214,5 @@ class FaultPlan:
                 "partition_drops": self._n_partition_drops,
                 "delays": self._n_delays,
                 "kills": self._n_kills,
+                "ps_kills": self._n_ps_kills,
             }

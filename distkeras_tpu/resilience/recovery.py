@@ -44,6 +44,213 @@ class RestartBudgetExceeded(RuntimeError):
     ``__cause__``."""
 
 
+class PSFailoverSupervisor:
+    """Trainer-side lease on the PRIMARY parameter server: ping it, and
+    when its lease lapses, promote the replacement and repoint every
+    worker's endpoint resolver.
+
+    The PS watches its workers (heartbeats/leases, PR 4); this is the
+    reverse direction — someone must watch the PS. A daemon thread pings
+    the primary over TCP every ``ping_interval``; ``failover_timeout``
+    seconds without a successful ping declares it dead and runs the
+    failover, in this order:
+
+    1. **fence** the superseded primary (best effort — usually it is
+       simply dead and the connect is refused): commits carrying its
+       epoch are rejected from here on, so a zombie that wakes up cannot
+       ACK folds into a history nobody serves anymore;
+    2. **promote**: the hot standby (``standby.promote(epoch+1)``) if
+       one was attached, else ``restart_factory()`` — a fresh
+       ``SocketParameterServer`` recovering (snapshot, wal) in place;
+    3. **repoint**: ``resolver.update(host, port, epoch+1)`` — every
+       worker's next reconnect re-resolves and adopts the new epoch.
+
+    Restart-in-place shares the WAL directory with the old primary and
+    therefore assumes the old process is really gone (the lease lapse is
+    the evidence); a suspected-but-alive primary is what the standby +
+    fencing path is for.
+
+    Doubles as the chaos actor: when the installed ``fault_plan`` carries
+    ``kill_ps_after_commits``, the supervisor crash-stops the primary
+    (``_crash()`` — SIGKILL semantics, no final fsync) once its commit
+    count crosses the threshold, then recovers from its own kill.
+    """
+
+    def __init__(self, resolver, primary, standby=None,
+                 restart_factory: Callable[[], Any] | None = None,
+                 failover_timeout: float = 2.0,
+                 ping_interval: float | None = None,
+                 fault_plan=None, max_failovers: int = 4):
+        self.resolver = resolver
+        self.active = primary
+        self.standby = standby
+        self.restart_factory = restart_factory
+        self.failover_timeout = float(failover_timeout)
+        self.ping_interval = (
+            float(ping_interval) if ping_interval is not None
+            else max(self.failover_timeout / 4.0, 0.02)
+        )
+        self.fault_plan = fault_plan
+        self.max_failovers = int(max_failovers)
+        self.failovers = 0
+        self.failover_log: list[dict] = []
+        self.failover_latency_s = 0.0
+        self.wal_replay_s = 0.0
+        self.error: BaseException | None = None
+        # fences that could not be CONFIRMED at failover time (the old
+        # primary was unreachable — usually dead, but possibly only
+        # stalled): retried every watch tick until they land, so an
+        # alive-but-slow zombie gets fenced the moment it wakes instead
+        # of silently absorbing its still-connected workers' commits
+        # into a superseded history forever
+        self._pending_fences: list[tuple[str, int, int, dict]] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._watch, daemon=True, name="distkeras-ps-supervisor"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    # -- the watch loop ------------------------------------------------------
+
+    def _ping(self) -> dict | None:
+        from distkeras_tpu.parameter_servers import ParameterServerClient
+
+        host, port, _ = self.resolver.resolve()
+        timeout = max(min(self.failover_timeout / 2.0, 1.0), 0.05)
+        try:
+            c = ParameterServerClient(host, port, -1,
+                                      connect_timeout=timeout)
+            try:
+                return c.ping(timeout=timeout)
+            finally:
+                c._sock.close()
+        except (OSError, EOFError):
+            return None
+
+    def _watch(self) -> None:
+        try:
+            deadline = time.monotonic() + self.failover_timeout
+            while not self._stop.is_set():
+                info = self._ping()
+                now = time.monotonic()
+                if info is not None and info.get("ok"):
+                    deadline = now + self.failover_timeout
+                    plan = self.fault_plan
+                    if plan is not None and plan.should_kill_ps(
+                            int(info.get("num_updates", 0))):
+                        # chaos: crash-stop the primary in-process; the
+                        # next ping round discovers the corpse
+                        crash = getattr(self.active, "_crash", None)
+                        if crash is not None:
+                            crash()
+                            plan.note_ps_kill()
+                elif now >= deadline:
+                    if self.failovers >= self.max_failovers:
+                        raise RuntimeError(
+                            f"parameter server unreachable after "
+                            f"{self.failovers} failovers"
+                        )
+                    self._failover()
+                    deadline = time.monotonic() + self.failover_timeout
+                if self._pending_fences:
+                    self._retry_pending_fences()
+                self._stop.wait(self.ping_interval)
+        except BaseException as e:  # surfaced by run_async_training
+            self.error = e
+
+    def _try_fence(self, host: str, port: int, epoch: int) -> bool:
+        from distkeras_tpu.parameter_servers import ParameterServerClient
+
+        try:
+            c = ParameterServerClient(host, port, -1, connect_timeout=0.5)
+            c._sock.settimeout(1.0)
+            try:
+                c.fence(epoch)
+                return True
+            finally:
+                c._sock.close()
+        except (OSError, EOFError):
+            return False
+
+    def _retry_pending_fences(self) -> None:
+        """Each watch tick: land any fence that could not be confirmed at
+        failover time. A stalled-not-dead zombie primary gets fenced the
+        moment it answers again; its workers' next commits then raise
+        FencedEpochError and re-resolve to the real primary instead of
+        feeding a dead history."""
+        still = []
+        for host, port, epoch, entry in self._pending_fences:
+            if self._try_fence(host, port, epoch):
+                entry["fence_confirmed"] = True
+            else:
+                still.append((host, port, epoch, entry))
+        self._pending_fences = still
+
+    def _failover(self) -> None:
+        t0 = time.monotonic()
+        old_host, old_port, old_epoch = self.resolver.resolve()
+        epoch = old_epoch + 1
+        # 1. fence the superseded history (best effort NOW: it is
+        # usually a corpse and the connect is refused instantly; an
+        # unconfirmed fence goes on the retry list — see _pending_fences)
+        fence_confirmed = self._try_fence(old_host, old_port, epoch)
+        # 2. promote
+        if self.standby is not None and not self.standby.promoted_:
+            self.standby.promote(epoch)
+            new = self.standby
+            via = "standby"
+        elif self.restart_factory is not None:
+            new = self.restart_factory()
+            new.fence(epoch)
+            self.wal_replay_s += float(getattr(new, "wal_replay_s", 0.0))
+            via = "restart"
+        else:
+            raise RuntimeError(
+                "primary parameter server died with no standby and no "
+                "restart factory (set ps_standby=True or ps_wal_dir)"
+            )
+        # 3. repoint the workers
+        self.resolver.update(new.host, new.port, epoch)
+        self.active = new
+        latency = time.monotonic() - t0
+        self.failovers += 1
+        self.failover_latency_s += latency
+        entry = {
+            "via": via, "epoch": epoch, "latency_s": round(latency, 4),
+            "wal_replay_s": round(
+                float(getattr(new, "wal_replay_s", 0.0)), 4
+            ),
+            "fence_confirmed": fence_confirmed,
+        }
+        self.failover_log.append(entry)
+        if not fence_confirmed:
+            self._pending_fences.append((old_host, old_port, epoch, entry))
+        warnings.warn(
+            f"parameter server failed over via {via} to "
+            f"{new.host}:{new.port} (epoch {epoch}, "
+            f"{latency * 1e3:.0f} ms)",
+            stacklevel=2,
+        )
+
+    def stats(self) -> dict:
+        return {
+            "failovers": self.failovers,
+            "failover_latency_s": round(self.failover_latency_s, 4),
+            "wal_replay_s": round(self.wal_replay_s, 4),
+            "failover_log": list(self.failover_log),
+        }
+
+
 class WorkerSupervisor:
     """Run worker threads to completion, restarting tolerable deaths.
 

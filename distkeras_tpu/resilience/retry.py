@@ -27,12 +27,13 @@ actually matters — the worker making progress.
 from __future__ import annotations
 
 import socket
+import threading
 import time
 from typing import Any, Callable
 
 import numpy as np
 
-from distkeras_tpu.networking import ProtocolError
+from distkeras_tpu.networking import FencedEpochError, ProtocolError
 
 Pytree = Any
 
@@ -43,13 +44,61 @@ class RetryDeadlineExceeded(ConnectionError):
 
 
 def is_retryable(exc: BaseException) -> bool:
-    """Transient transport weather vs a real bug. ProtocolError carries
-    its own verdict (an oversized frame will be oversized on every
-    retry); other connection/socket-level failures are retryable."""
+    """Transient transport weather vs a real bug.
+
+    The failover triage, explicitly:
+
+    - ``ConnectionRefusedError`` (ECONNREFUSED) and mid-handshake EOF ARE
+      retryable: they are exactly what a client sees in the window
+      between a primary dying and its replacement answering — backing
+      off and re-resolving is the correct move, not dying.
+    - ``ProtocolError`` carries its own verdict (an oversized frame will
+      be oversized on every retry; a mid-frame close is weather).
+    - ``FencedEpochError`` is a ProtocolError with ``retryable=False``:
+      an epoch mismatch is deterministic against the same server. (The
+      resilient client makes ONE exception — when its endpoint resolver
+      has already moved to a newer epoch, the reconnect adopts it and
+      the retry is legitimate; see ``ResilientPSClient._classify``.)
+    - other connection/socket-level failures are retryable; everything
+      else (shape errors, assertions) is a bug and propagates.
+    """
     if isinstance(exc, ProtocolError):
         return exc.retryable
     return isinstance(exc, (ConnectionError, socket.timeout, BrokenPipeError,
                             EOFError, OSError))
+
+
+class PSEndpoint:
+    """Thread-safe record of where the CURRENT primary lives — host,
+    port, and fencing epoch — shared by every worker's client factory
+    and updated exactly once per failover by the trainer-side
+    :class:`~distkeras_tpu.resilience.recovery.PSFailoverSupervisor`.
+    Reconnecting clients read it at connect time, so a reconnect after a
+    promotion lands on the new primary carrying the new epoch with no
+    per-worker coordination."""
+
+    def __init__(self, host: str, port: int, epoch: int = 0):
+        self._lock = threading.Lock()
+        self._host = host
+        self._port = int(port)
+        self._epoch = int(epoch)
+        self.updates = 0
+
+    def resolve(self) -> tuple[str, int, int]:
+        with self._lock:
+            return self._host, self._port, self._epoch
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def update(self, host: str, port: int, epoch: int) -> None:
+        with self._lock:
+            self._host = host
+            self._port = int(port)
+            self._epoch = int(epoch)
+            self.updates += 1
 
 
 class RetryPolicy:
@@ -86,10 +135,14 @@ class RetryPolicy:
         return _DelaySequence(self, salt)
 
     def run(self, fn: Callable[[], Any], on_retry=None,
-            clock=time.monotonic, sleep=time.sleep, salt: int = 0) -> Any:
+            clock=time.monotonic, sleep=time.sleep, salt: int = 0,
+            classify: Callable[[BaseException], bool] | None = None) -> Any:
         """Call ``fn`` under this policy. ``on_retry(attempt, exc)`` fires
         before each re-attempt (the client uses it to reconnect and
-        count). Non-retryable failures propagate untouched."""
+        count). Non-retryable failures propagate untouched. ``classify``
+        overrides the default :func:`is_retryable` triage (the resilient
+        client widens it across failovers)."""
+        triage = is_retryable if classify is None else classify
         t0 = clock()
         seq = self.delays(salt)
         attempt = 0
@@ -98,7 +151,7 @@ class RetryPolicy:
                 return fn()
             except BaseException as e:
                 attempt += 1
-                if not is_retryable(e):
+                if not triage(e):
                     raise
                 if attempt >= self.max_attempts:
                     raise RetryDeadlineExceeded(
@@ -147,11 +200,20 @@ class ResilientPSClient:
 
     def __init__(self, make_client: Callable[[], Any], worker_id: int,
                  policy: RetryPolicy | None = None,
-                 heartbeat_interval: float | None = None):
+                 heartbeat_interval: float | None = None,
+                 resolver: PSEndpoint | None = None):
         self._make_client = make_client
         self.worker_id = int(worker_id)
         self.policy = policy if policy is not None else RetryPolicy()
         self.heartbeat_interval = heartbeat_interval
+        # Failover awareness: `resolver` names the current primary; the
+        # factory is expected to read it, so every reconnect re-resolves
+        # the endpoint and adopts the current fencing epoch. With a
+        # resolver, a FencedEpochError is retried IFF the resolver has
+        # moved past the epoch this client was using (the fence names a
+        # failover we haven't caught up with); without one, fenced is
+        # fatal — there is no newer endpoint to move to.
+        self.resolver = resolver
         self._client = make_client()
         self.seq = 0           # logical commits CONFIRMED by this client
         self._wire_seq = 0     # seqnos issued (incl. abandoned commits)
@@ -195,10 +257,25 @@ class ResilientPSClient:
             # op fails fast and lands back here after one more backoff
             pass
 
+    def _classify(self, exc: BaseException) -> bool:
+        if isinstance(exc, FencedEpochError) and self.resolver is not None:
+            # A fence names a failover; with a resolver every reconnect
+            # re-resolves and adopts the CURRENT epoch, so retrying is
+            # how this client catches up. Deliberately retryable even
+            # when the resolver hasn't advanced yet — promotion updates
+            # it moments after the fence lands, and racing that window
+            # with a fatal would kill workers the failover was built to
+            # save. A resolver that never advances ends the loop at the
+            # retry deadline instead. Without a resolver there is no
+            # newer endpoint to move to: fenced stays fatal.
+            return True
+        return is_retryable(exc)
+
     def _run(self, fn: Callable[[], Any]) -> Any:
         self._calls += 1
         salt = (self.worker_id << 32) ^ self._calls
-        return self.policy.run(fn, on_retry=self._reconnect, salt=salt)
+        return self.policy.run(fn, on_retry=self._reconnect, salt=salt,
+                               classify=self._classify)
 
     # -- the worker-facing surface -------------------------------------------
 

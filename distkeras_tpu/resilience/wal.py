@@ -1,0 +1,448 @@
+"""Durable PS state: write-ahead commit log, snapshots, and replay.
+
+The reference never needed PS durability — the center lived in the Spark
+driver and a driver death was a rerun. PR 4 made the *workers* restartable;
+this module makes the CENTER restartable: every state-changing event on the
+parameter server (deduplicated commit folds, pull-version records, clean
+deregisters, evictions, fencing-epoch bumps) is appended to a write-ahead
+log BEFORE the client sees an ACK, and the full state (center, EMA,
+``num_updates``, per-worker pull versions, the commit-dedup table, the
+fencing epoch) is periodically written as an fsync'd snapshot that
+truncates the log. A restarted PS loads ``(snapshot, wal)`` and replays —
+reconstructing exactly the state a never-crashed server would hold after
+the same prefix of events (the bit-identical oracle the durability tests
+pin).
+
+Why full payloads and not just digests: a digest can *verify* a fold but
+cannot *reproduce* it — replay must re-run ``rule.fold`` on the decoded
+commit tree to land on the same bits. Each record therefore carries the
+payload plus a CRC32 over the framed body; the CRC is the torn-write
+detector (a crash mid-append leaves a tail record that fails its CRC and
+replay stops cleanly at the last durable prefix).
+
+Crash-consistency contract:
+
+- Appends happen in fold order (the PS appends under its center lock) and
+  ``flush()`` per record — an in-process crash (or a SIGKILL'd process)
+  loses nothing already handed to the OS. ``fsync`` runs periodically
+  (``fsync_every`` records) and always under a snapshot, bounding what a
+  *machine* crash can lose; the commit path never waits on fsync.
+- A commit folded in memory but torn in the log is a commit whose ACK
+  never went out (append-before-ACK): the client replays it with the same
+  seqno against the recovered server, whose replayed dedup table does not
+  contain it — it folds exactly once. The exactly-once oracle
+  (``commits == logical``) survives the crash.
+- Snapshots are written to a temp name, fsync'd, atomically renamed, and
+  only then do older segments/snapshots get deleted — there is never a
+  moment without a recoverable (snapshot, wal) pair.
+
+The same record stream doubles as the hot-standby replication wire: the
+primary sends each appended record (prefixed by the same framing) to the
+replica before ACKing, and the standby applies records through the same
+``replay_record`` path recovery uses — one definition of "apply an event",
+whether from disk or from the stream.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import struct
+import zlib
+from typing import Any, Iterator
+
+import numpy as np
+
+Pytree = Any
+
+# record types
+REC_COMMIT = 1    # (worker_id, seq|None, pull_version, version, payload)
+REC_PULL = 2      # (worker_id, version)
+REC_DEREG = 3     # (worker_id,)          clean exit: clear dedup entry
+REC_EVICT = 4     # (worker_ids,)         lease lapse: clear pulls + dedup
+REC_FENCE = 5     # (epoch,)              fencing-epoch bump
+
+_HDR = struct.Struct(">BII")  # type, crc32(body), len(body)
+
+_SNAP_PREFIX = "snap-"
+_SNAP_SUFFIX = ".dkw"
+_SEG_PREFIX = "wal-"
+_SEG_SUFFIX = ".log"
+
+
+def _restricted_loads(data: bytes):
+    """Decode a record/snapshot body with the same primitives+numpy-only
+    unpickler the wire uses (networking._RestrictedUnpickler): WAL files
+    live on shared filesystems, so they get the same defense the frames
+    do — a tampered log can corrupt training state, not execute code."""
+    from distkeras_tpu.networking import _RestrictedUnpickler
+
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+def encode_record(rec_type: int, body_obj: Any) -> bytes:
+    """Frame one record: header(type, crc32, len) + pickled body."""
+    body = pickle.dumps(body_obj, protocol=pickle.HIGHEST_PROTOCOL)
+    return _HDR.pack(rec_type, zlib.crc32(body), len(body)) + body
+
+
+def durable_prefix_len(data: bytes) -> int:
+    """Byte length of the valid record prefix (where a torn/corrupt tail
+    starts, if any)."""
+    off = 0
+    n = len(data)
+    while off + _HDR.size <= n:
+        _, crc, ln = _HDR.unpack_from(data, off)
+        body_off = off + _HDR.size
+        if body_off + ln > n or zlib.crc32(data[body_off:body_off + ln]) != crc:
+            return off
+        off = body_off + ln
+    return off
+
+
+def iter_records(data: bytes) -> Iterator[tuple[int, Any]]:
+    """Yield (type, body) records from a segment's bytes, stopping at the
+    first torn or corrupt frame (the durable prefix ends there)."""
+    off = 0
+    n = len(data)
+    while off + _HDR.size <= n:
+        rec_type, crc, ln = _HDR.unpack_from(data, off)
+        body_off = off + _HDR.size
+        if body_off + ln > n:
+            return  # torn tail: the append died mid-write
+        body = data[body_off:body_off + ln]
+        if zlib.crc32(body) != crc:
+            return  # corrupt tail (or bit rot): stop at the durable prefix
+        try:
+            yield rec_type, _restricted_loads(body)
+        except Exception:
+            return  # undecodable body: same treatment as a bad CRC
+        off = body_off + ln
+
+
+class CommitLog:
+    """Append-only WAL + snapshot manager for one parameter server.
+
+    Files in ``directory``:
+
+    - ``wal-<version>.log`` — records appended since the state was at
+      ``version`` (the segment's base). Exactly one live segment.
+    - ``snap-<version>.dkw`` — fsync'd full-state snapshot at ``version``.
+
+    Appends are NOT thread-safe by themselves — the PS calls them under
+    its center lock, which is also what guarantees the log order equals
+    the fold order (replay depends on it).
+    """
+
+    def __init__(self, directory: str, snapshot_every: int = 100,
+                 fsync_every: int = 64):
+        self.dir = str(directory)
+        os.makedirs(self.dir, exist_ok=True)
+        self.snapshot_every = int(snapshot_every)
+        self.fsync_every = max(1, int(fsync_every))
+        self._fh = None
+        self._since_fsync = 0
+        self.commits_since_snapshot = 0
+        self._segment_base = 0
+
+    # -- append side ---------------------------------------------------------
+
+    def open_segment(self, base_version: int) -> None:
+        """Open (appending) the live segment based at ``base_version``.
+        An existing file (restart-in-place) is first truncated to its
+        durable prefix — appending after a torn tail record would bury
+        every new record behind an unreadable frame."""
+        self.close()
+        self._segment_base = int(base_version)
+        path = os.path.join(
+            self.dir, f"{_SEG_PREFIX}{base_version:012d}{_SEG_SUFFIX}"
+        )
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                data = f.read()
+            good = durable_prefix_len(data)
+            if good != len(data):
+                with open(path, "r+b") as f:
+                    f.truncate(good)
+        self._fh = open(path, "ab")
+
+    def append(self, record: bytes) -> None:
+        """Append one pre-framed record; flush to the OS (crash-of-process
+        safe). Never fsyncs — the PS appends under its center lock, and a
+        disk sync must not ride the fold's critical section; callers run
+        ``maybe_fsync()`` after releasing it."""
+        self._fh.write(record)
+        self._fh.flush()
+        self._since_fsync += 1
+
+    def maybe_fsync(self) -> None:
+        """Periodic machine-crash durability — call OFF the center lock
+        (every ``fsync_every`` records trips a real fsync)."""
+        if self._since_fsync >= self.fsync_every:
+            self.sync()
+
+    def append_commit(self, worker_id: int, seq: int | None,
+                      pull_version: int, version: int,
+                      payload_bytes: bytes) -> None:
+        """``payload_bytes`` is the pre-pickled decoded commit tree
+        (pickled OUTSIDE the center lock by the PS — the O(model) encode
+        must not ride the fold's critical section)."""
+        self.append(encode_record(
+            REC_COMMIT,
+            (int(worker_id), None if seq is None else int(seq),
+             int(pull_version), int(version), payload_bytes),
+        ))
+        self.commits_since_snapshot += 1
+
+    def append_pull(self, worker_id: int, version: int) -> None:
+        self.append(encode_record(REC_PULL, (int(worker_id), int(version))))
+
+    def append_dereg(self, worker_id: int) -> None:
+        self.append(encode_record(REC_DEREG, (int(worker_id),)))
+
+    def append_evict(self, worker_ids: list[int]) -> None:
+        self.append(encode_record(REC_EVICT, ([int(w) for w in worker_ids],)))
+
+    def append_fence(self, epoch: int) -> None:
+        # the PS fsyncs right after releasing its lock: a fence must be
+        # durable by the time the fencing caller gets its ack
+        self.append(encode_record(REC_FENCE, (int(epoch),)))
+
+    def sync(self) -> None:
+        fh = self._fh
+        if fh is None:
+            return
+        try:
+            fh.flush()
+            os.fsync(fh.fileno())
+        except (OSError, ValueError):
+            # racing a rotation's close (maybe_fsync runs OFF the center
+            # lock by design): the rotation's own open/append path keeps
+            # the new segment consistent; skipping one periodic fsync
+            # only widens the machine-crash window by < fsync_every
+            # records, never corrupts the log
+            return
+        self._since_fsync = 0
+
+    def should_snapshot(self) -> bool:
+        return (self.snapshot_every > 0
+                and self.commits_since_snapshot >= self.snapshot_every)
+
+    def rotate(self, version: int) -> None:
+        """Phase 1 of a snapshot — MUST run under the PS center lock, at
+        the moment the state is captured at ``version``: open a fresh
+        segment so every later record lands post-snapshot. Cheap (one
+        ``open``); the old segment stays on disk until the snapshot is
+        durable — a crash between rotate and publish recovers from the
+        previous snapshot plus BOTH segments, losing nothing. Without
+        this split, commits folded while the snapshot file was being
+        written would sit in a segment the truncation then deletes —
+        ACKed work silently lost."""
+        self.open_segment(int(version))
+        self.commits_since_snapshot = 0
+
+    def publish_snapshot(self, state: dict) -> None:
+        """Phase 2 — runs OUTSIDE the center lock (O(model) serialize +
+        fsync must not stall the fold path): durably write ``state`` at
+        its ``num_updates`` version (tmp + fsync + atomic rename), then
+        delete snapshots and segments strictly below it. Only after the
+        rename is the old history unreferenced."""
+        version = int(state["num_updates"])
+        path = os.path.join(
+            self.dir, f"{_SNAP_PREFIX}{version:012d}{_SNAP_SUFFIX}"
+        )
+        tmp = path + f".tmp.{os.getpid()}"
+        blob = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+        with open(tmp, "wb") as f:
+            f.write(struct.pack(">I", zlib.crc32(blob)))
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        for name in os.listdir(self.dir):
+            base = None
+            if name.startswith(_SNAP_PREFIX) and name.endswith(_SNAP_SUFFIX):
+                base = name[len(_SNAP_PREFIX):-len(_SNAP_SUFFIX)]
+            elif name.startswith(_SEG_PREFIX) and name.endswith(_SEG_SUFFIX):
+                base = name[len(_SEG_PREFIX):-len(_SEG_SUFFIX)]
+            if base is None or not base.isdigit() or int(base) >= version:
+                continue
+            try:
+                os.remove(os.path.join(self.dir, name))
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self.sync()
+            except (OSError, ValueError):
+                pass
+            self._fh.close()
+            self._fh = None
+
+
+# -- state <-> snapshot ------------------------------------------------------
+
+
+def ps_state_dict(center: Pytree, num_updates: int,
+                  pull_versions: dict, last_seq: dict,
+                  ema: Pytree | None, ema_version: int,
+                  fence_epoch: int) -> dict:
+    """The full recoverable PS state (plain containers + numpy only, so
+    the restricted unpickler can load it back)."""
+    return {
+        "center": center,
+        "num_updates": int(num_updates),
+        "pull_versions": dict(pull_versions),
+        "last_seq": dict(last_seq),
+        "ema": ema,
+        "ema_version": int(ema_version),
+        "fence_epoch": int(fence_epoch),
+    }
+
+
+def _load_snapshot(path: str) -> dict | None:
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+        (crc,) = struct.unpack_from(">I", data, 0)
+        blob = data[4:]
+        if zlib.crc32(blob) != crc:
+            return None
+        return _restricted_loads(blob)
+    except Exception:
+        return None
+
+
+def replay_record(state: dict, rec_type: int, body: Any, rule,
+                  num_workers: int, ema_decay: float | None) -> None:
+    """Apply ONE record to ``state`` (the dict ``ps_state_dict`` shapes).
+
+    This is the single definition of "apply an event": crash recovery
+    replays disk records through it and the hot standby applies streamed
+    records through it — the two consumers cannot diverge. The fold and
+    EMA arithmetic are the PS's own (same ``rule.fold`` → ``tree_to_numpy``
+    → fma sequence), so a replayed state is bit-identical to the
+    sequential no-crash server's.
+    """
+    from distkeras_tpu import utils
+
+    if rec_type == REC_COMMIT:
+        worker_id, seq, pull_version, version, payload_bytes = body
+        if version != state["num_updates"] + 1:
+            raise ValueError(
+                f"WAL sequence gap: record folds to version {version} but "
+                f"state is at {state['num_updates']} (segments replayed out "
+                f"of order, or mixed logs in one directory)"
+            )
+        # no dup-skip needed here: only DEDUPLICATED folds are ever logged
+        # or streamed, so every COMMIT record is a real, distinct fold
+        payload = _restricted_loads(payload_bytes)
+        staleness = state["num_updates"] - pull_version
+        state["center"] = utils.tree_to_numpy(
+            rule.fold(state["center"], payload, num_workers, staleness)
+        )
+        state["num_updates"] += 1
+        if seq is not None:
+            state["last_seq"][worker_id] = seq
+        if ema_decay is not None and state.get("ema") is not None \
+                and state["num_updates"] > state["ema_version"]:
+            # the snapshot's EMA may run AHEAD of its center version (the
+            # EMA folds on its own lock after the commit's critical
+            # section); folds at or below ema_version are already in it
+            _ema_fma_inplace(state["ema"], state["center"], ema_decay)
+            state["ema_version"] = state["num_updates"]
+    elif rec_type == REC_PULL:
+        worker_id, version = body
+        state["pull_versions"][worker_id] = version
+    elif rec_type == REC_DEREG:
+        (worker_id,) = body
+        state["last_seq"].pop(worker_id, None)
+    elif rec_type == REC_EVICT:
+        (worker_ids,) = body
+        for wid in worker_ids:
+            state["pull_versions"].pop(wid, None)
+            state["last_seq"].pop(wid, None)
+    elif rec_type == REC_FENCE:
+        (epoch,) = body
+        state["fence_epoch"] = max(state["fence_epoch"], epoch)
+    # unknown types: forward-compat skip
+
+
+def _ema_fma_inplace(ema: Pytree, center: Pytree, d: float) -> None:
+    """e = d·e + (1−d)·c with the PS's exact operation order (multiply
+    into scratch, scale e, add) so replay matches the live fold bitwise."""
+    import jax
+
+    def fma(e, c):
+        s = np.multiply(np.asarray(c, dtype=e.dtype), 1.0 - d)
+        e *= d
+        e += s
+
+    jax.tree.map(fma, ema, center)
+
+
+def recover_ps_state(directory: str, rule, num_workers: int,
+                     ema_decay: float | None,
+                     template: Pytree | None = None) -> dict | None:
+    """Reconstruct the PS state from ``(newest valid snapshot, wal)``.
+
+    Returns the state dict (plus ``state["replayed"]`` = records applied
+    after the snapshot) or None when the directory holds no durable state
+    (fresh start). A snapshot that fails its CRC falls back to the next
+    older one; WAL segments BELOW the chosen snapshot version are ignored
+    (already folded into it), the segment AT it is replayed to its
+    durable prefix.
+    """
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    snaps = sorted(
+        (n for n in names
+         if n.startswith(_SNAP_PREFIX) and n.endswith(_SNAP_SUFFIX)),
+        reverse=True,
+    )
+    segs = sorted(
+        n for n in names
+        if n.startswith(_SEG_PREFIX) and n.endswith(_SEG_SUFFIX)
+    )
+    state = None
+    snap_version = 0
+    for name in snaps:
+        state = _load_snapshot(os.path.join(directory, name))
+        if state is not None:
+            snap_version = int(state["num_updates"])
+            break
+    if state is None:
+        if not segs:
+            return None
+        if template is None:
+            raise ValueError(
+                f"WAL at {directory} has segments but no snapshot and no "
+                f"template center to replay onto"
+            )
+        from distkeras_tpu import utils
+
+        state = ps_state_dict(
+            utils.tree_to_numpy(template), 0, {}, {},
+            None, 0, 0,
+        )
+        if ema_decay is not None:
+            import jax
+
+            state["ema"] = jax.tree.map(np.copy, state["center"])
+    replayed = 0
+    for name in segs:
+        base = int(name[len(_SEG_PREFIX):-len(_SEG_SUFFIX)])
+        if base < snap_version:
+            continue  # pre-snapshot history, already folded in
+        with open(os.path.join(directory, name), "rb") as f:
+            data = f.read()
+        for rec_type, body in iter_records(data):
+            replay_record(state, rec_type, body, rule, num_workers, ema_decay)
+            replayed += 1
+    state["replayed"] = replayed
+    return state

@@ -551,6 +551,9 @@ class DistributedTrainer(Trainer):
                  heartbeat_interval: float | None = None,
                  lease_timeout: float | None = None,
                  fault_plan=None,
+                 ps_wal_dir=None, ps_snapshot_every: int = 100,
+                 ps_standby: bool = False,
+                 ps_failover_timeout: float | None = None,
                  prefetch: int = 1, ema_decay: float | None = None,
                  clipnorm=None, clipvalue=None, validation_data=None):
         super().__init__(keras_model, loss, worker_optimizer,
@@ -736,15 +739,81 @@ class DistributedTrainer(Trainer):
             )
         self.lease_timeout = lease_timeout
         self.fault_plan = fault_plan
+        # PS durability + failover (resilience/wal.py; PS backend only):
+        #
+        # - ps_wal_dir: write-ahead commit log + periodic fsync'd center
+        #   snapshots — a crashed PS restarts in place from (snapshot,
+        #   wal) with center/EMA/staleness/dedup state reconstructed
+        #   bit-identically. On ps_transport='native' the WAL degrades
+        #   gracefully (warns, runs without durability).
+        # - ps_snapshot_every: commits between snapshots (log truncation
+        #   cadence).
+        # - ps_standby (socket transport): a warm replica streams every
+        #   applied commit from the primary; the trainer-side
+        #   PSFailoverSupervisor promotes it (with a fencing-epoch bump,
+        #   so a zombie primary's late folds are rejected) when the
+        #   primary's lease lapses.
+        # - ps_failover_timeout: seconds without a successful primary
+        #   ping before failover (defaults to lease_timeout, else 2 s).
+        self.ps_wal_dir = ps_wal_dir
+        self.ps_snapshot_every = int(ps_snapshot_every)
+        if self.ps_snapshot_every <= 0:
+            raise ValueError(
+                f"ps_snapshot_every must be positive, got {ps_snapshot_every}"
+            )
+        self.ps_standby = bool(ps_standby)
+        if ps_failover_timeout is not None and ps_failover_timeout <= 0:
+            raise ValueError(
+                f"ps_failover_timeout must be positive, got "
+                f"{ps_failover_timeout}"
+            )
+        self.ps_failover_timeout = ps_failover_timeout
+        if self.ps_standby and ps_transport != "socket":
+            raise ValueError(
+                "ps_standby requires ps_transport='socket' (the replica "
+                "is a second socket server; the in-process PS shares the "
+                "trainer's fate and the native PS has no replication "
+                "stream yet)"
+            )
+        if self.ps_standby and ps_host is not None:
+            raise ValueError(
+                "ps_standby applies to the PS this trainer hosts; an "
+                "external ps_host owner runs its own standby"
+            )
+        if fault_plan is not None and getattr(
+                fault_plan, "kill_ps_after_commits", None) is not None:
+            # fail fast: a PS kill with no recovery path would crash the
+            # run mid-training after every worker exhausts its retry
+            # deadline, and on non-socket transports the kill hook is
+            # never wired (the chaos would silently test nothing)
+            if ps_transport != "socket":
+                raise ValueError(
+                    "fault_plan.kill_ps_after_commits requires "
+                    "ps_transport='socket' (the in-process PS shares the "
+                    "trainer's fate; the native PS has no kill/failover "
+                    "wiring)"
+                )
+            if ps_host is not None:
+                raise ValueError(
+                    "fault_plan.kill_ps_after_commits applies to the PS "
+                    "this trainer hosts, not an external ps_host"
+                )
+            if ps_wal_dir is None and not self.ps_standby:
+                raise ValueError(
+                    "fault_plan.kill_ps_after_commits needs a recovery "
+                    "path: set ps_wal_dir (restart-in-place) and/or "
+                    "ps_standby=True (hot failover)"
+                )
         if backend != "ps" and (
                 worker_restart_budget or retry_policy is not None
                 or heartbeat_interval is not None or lease_timeout is not None
-                or fault_plan is not None):
+                or fault_plan is not None or ps_wal_dir is not None
+                or ps_standby):
             raise ValueError(
                 "the resilience knobs (worker_restart_budget, retry_policy, "
-                "heartbeat_interval, lease_timeout, fault_plan) apply to "
-                "backend='ps' only (the collective backend is one SPMD "
-                "program)"
+                "heartbeat_interval, lease_timeout, fault_plan, ps_wal_dir, "
+                "ps_standby) apply to backend='ps' only (the collective "
+                "backend is one SPMD program)"
             )
         self.resilience_stats_ = None
 

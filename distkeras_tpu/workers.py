@@ -35,6 +35,7 @@ from distkeras_tpu.parameter_servers import (
     ParameterServer,
     ParameterServerClient,
     SocketParameterServer,
+    StandbySocketParameterServer,
 )
 
 Pytree = Any
@@ -275,7 +276,7 @@ def run_async_training(trainer, ds, shuffle: bool):
             start_epoch = int(payload["epoch"]) + 1
 
     from distkeras_tpu.parallel.compression import Int8Codec, resolve_codec
-    from distkeras_tpu.resilience.retry import ResilientPSClient
+    from distkeras_tpu.resilience.retry import ResilientPSClient, RetryPolicy
 
     transport = getattr(trainer, "ps_transport", "inprocess")
     external_host = getattr(trainer, "ps_host", None)
@@ -292,6 +293,36 @@ def run_async_training(trainer, ds, shuffle: bool):
         # a missed-5-heartbeats default: prompt eviction without flapping
         lease_timeout = 5.0 * float(hb_interval)
     fault_plan = getattr(trainer, "fault_plan", None)
+    # PS durability + failover knobs (resilience/wal.py, DESIGN.md):
+    # ps_wal_dir turns on the write-ahead commit log (crash-restart
+    # recovery); ps_standby adds a warm replica streaming applied commits;
+    # either one (or a kill-PS fault plan) activates the trainer-side
+    # PSFailoverSupervisor, which pings the primary and promotes/restarts
+    # on a lapsed lease, repointing the workers' endpoint resolver.
+    ps_wal_dir = getattr(trainer, "ps_wal_dir", None)
+    ps_snapshot_every = int(getattr(trainer, "ps_snapshot_every", 100))
+    ps_standby = bool(getattr(trainer, "ps_standby", False))
+    ps_failover_timeout = getattr(trainer, "ps_failover_timeout", None)
+    if ps_failover_timeout is None:
+        ps_failover_timeout = (
+            lease_timeout if lease_timeout is not None else 2.0
+        )
+    kill_ps_chaos = (fault_plan is not None and getattr(
+        fault_plan, "kill_ps_after_commits", None) is not None)
+    if transport == "socket" and (ps_standby or kill_ps_chaos) \
+            and not resilient:
+        # failover is only survivable through reconnecting clients: a
+        # plain client dies with the primary's TCP connection. The
+        # default policy's 6 attempts span ~1.5 s — tighter than the
+        # detect-and-promote window — so the auto policy budgets for
+        # (failover_timeout + promotion) with room to spare. A caller-
+        # supplied retry_policy is trusted to do the same.
+        resilient = True
+        retry_policy = RetryPolicy(
+            max_attempts=100, base_delay=0.05, max_delay=0.5,
+            deadline=max(60.0, 20.0 * float(ps_failover_timeout)),
+        )
+    ps_resolver = None
     if resilient and transport == "native" and codec is not None:
         raise ValueError(
             "ps_transport='native' carries commit seqnos on the raw f32 "
@@ -356,6 +387,7 @@ def run_async_training(trainer, ds, shuffle: bool):
             params, rule, W, port=getattr(trainer, "ps_port", 0),
             ema_decay=getattr(trainer, "ema_decay", None),
             lease_timeout=lease_timeout,
+            wal_dir=ps_wal_dir,  # graceful degrade: warns, runs undurable
         )
         ps.initialize()
         ps.start()
@@ -368,23 +400,103 @@ def run_async_training(trainer, ds, shuffle: bool):
             params, rule, W, port=getattr(trainer, "ps_port", 0),
             ema_decay=getattr(trainer, "ema_decay", None),
             lease_timeout=lease_timeout,
+            wal_dir=ps_wal_dir, snapshot_every=ps_snapshot_every,
         )
         ps.initialize()
         ps.start()
 
-        def make_client(i):
-            return ParameterServerClient("127.0.0.1", ps.port, i,
-                                         pull_compression=pull_comp)
+        if ps_standby or kill_ps_chaos:
+            # failover-capable wiring: clients resolve the CURRENT
+            # primary (host, port, fencing epoch) per connect, so a
+            # promotion repoints every reconnect with no per-worker
+            # plumbing — resilience/retry.py PSEndpoint
+            from distkeras_tpu.resilience.retry import PSEndpoint
+
+            ps_resolver = PSEndpoint("127.0.0.1", ps.port,
+                                     epoch=ps.fence_epoch)
+
+            def make_client(i):
+                host, port, epoch = ps_resolver.resolve()
+                return ParameterServerClient(
+                    host, port, i, pull_compression=pull_comp, epoch=epoch,
+                )
+        else:
+            def make_client(i):
+                return ParameterServerClient("127.0.0.1", ps.port, i,
+                                             pull_compression=pull_comp)
     elif transport == "inprocess":
         ps = ParameterServer(
             params, rule, W, ema_decay=getattr(trainer, "ema_decay", None),
             lease_timeout=lease_timeout,
+            wal_dir=ps_wal_dir, snapshot_every=ps_snapshot_every,
         )
 
         def make_client(i):
             return _BoundPS(ps, i, pull_compression=pull_comp)
     else:
         raise ValueError(f"unknown ps_transport {transport!r}")
+
+    # hot standby + trainer-side PS failover supervision (socket only:
+    # the in-process PS shares this process's fate, and the native PS
+    # degrades to no-WAL — see NativeSocketParameterServer)
+    ps_standby_server = None
+    ps_supervisor = None
+    if transport == "socket" and ps is not None \
+            and (ps_standby or kill_ps_chaos):
+        from distkeras_tpu.resilience.recovery import PSFailoverSupervisor
+
+        if ps_standby:
+            ps_standby_server = StandbySocketParameterServer(
+                params, rule, W,
+                ema_decay=getattr(trainer, "ema_decay", None),
+                lease_timeout=lease_timeout,
+                wal_dir=(None if ps_wal_dir is None
+                         else f"{ps_wal_dir}/standby"),
+                snapshot_every=ps_snapshot_every,
+            )
+            ps_standby_server.initialize()
+            ps_standby_server.start()
+            for attempt in range(3):
+                # a FaultPlan active during setup can drop the attach
+                # handshake — the stream is worth a couple of retries
+                try:
+                    ps.attach_standby("127.0.0.1", ps_standby_server.port)
+                    break
+                except (ConnectionError, OSError):
+                    if attempt == 2:
+                        raise
+
+        restart_factory = None
+        if ps_wal_dir is not None:
+            def restart_factory():
+                new = SocketParameterServer(
+                    params, rule, W, port=0,
+                    ema_decay=getattr(trainer, "ema_decay", None),
+                    lease_timeout=lease_timeout,
+                    wal_dir=ps_wal_dir, snapshot_every=ps_snapshot_every,
+                )
+                new.initialize()
+                new.start()
+                return new
+
+        if kill_ps_chaos:
+            # the kill fires IN the commit path (deterministic in commit
+            # count — a fast run cannot slip between supervisor polls),
+            # tearing in-flight ACKs exactly like a real kill; the
+            # supervisor's ping loop then discovers the corpse
+            def _kill_hook(version, _ps=ps, _plan=fault_plan):
+                if _plan.should_kill_ps(version):
+                    _plan.note_ps_kill()
+                    _ps._crash()
+
+            ps.post_commit_hook = _kill_hook
+
+        ps_supervisor = PSFailoverSupervisor(
+            ps_resolver, ps, standby=ps_standby_server,
+            restart_factory=restart_factory,
+            failover_timeout=float(ps_failover_timeout),
+        )
+        ps_supervisor.start()
 
     if resilient:
         # reconnect-and-retry with per-worker commit seqnos (dedup'd
@@ -393,6 +505,7 @@ def run_async_training(trainer, ds, shuffle: bool):
             ResilientPSClient(
                 lambda i=i: make_client(i), offset + i,
                 policy=retry_policy, heartbeat_interval=hb_interval,
+                resolver=ps_resolver,
             )
             for i in range(W)
         ]
@@ -405,7 +518,10 @@ def run_async_training(trainer, ds, shuffle: bool):
         seed=trainer.seed if shuffle else None, cover_all=shuffle,
     )  # tuple of [W, rows_pw, …]
 
-    if restored_updates and ps is not None:
+    if restored_updates and ps is not None \
+            and not getattr(ps, "recovered_", False):
+        # WAL recovery is the finer-grained truth; only a checkpoint-
+        # resume WITHOUT a recovered WAL seeds the update count
         ps.num_updates = restored_updates
 
     window_fn = _build_local_window(trainer._loss_step(), optimizer)
@@ -452,15 +568,19 @@ def run_async_training(trainer, ds, shuffle: bool):
             # runs in one worker thread while all others wait at the barrier;
             # only cadence-selected epochs reach the barrier at all. The
             # update count stays with the server when it is external.
+            # Under PS failover the CURRENT primary (supervisor.active)
+            # owns the center — the crashed one would serve a stale copy.
+            live = (ps_supervisor.active
+                    if ps_supervisor is not None else ps)
             epoch = workers[0]._epoch_done
             payload = {
-                "center": (ps.get_model() if ps is not None
+                "center": (live.get_model() if live is not None
                            else snap_client.pull()),
                 "workers": [w.snapshot for w in workers],
                 "epoch": epoch,
             }
-            if ps is not None:
-                payload["num_updates"] = ps.num_updates
+            if live is not None:
+                payload["num_updates"] = live.num_updates
             ckpt.save_checkpoint(ckpt_dir, payload, step=epoch)
 
         barrier = threading.Barrier(W, action=_checkpoint_action)
@@ -518,6 +638,21 @@ def run_async_training(trainer, ds, shuffle: bool):
         for t in threads:
             t.join()
 
+    # Training is over: retire the PS failover supervisor FIRST (it must
+    # not declare the primary dead because we stopped it), then resolve
+    # which server actually holds the final center — the original
+    # primary, the promoted standby, or the restarted-in-place server.
+    active_ps = ps
+    if ps_supervisor is not None:
+        ps_supervisor.stop()
+        active_ps = ps_supervisor.active
+        if ps_supervisor.error is not None and not any(
+                w.error is not None for w in workers):
+            raise RuntimeError(
+                "the PS failover supervisor died while the workers "
+                "survived"
+            ) from ps_supervisor.error
+
     # Resilience observability, stashed next to ps_stats_: the commit-
     # seqno oracle (logical commits issued vs folds applied — see the
     # chaos tests), client retry/reconnect totals, supervisor restarts,
@@ -536,6 +671,8 @@ def run_async_training(trainer, ds, shuffle: bool):
             ),
             "restarts": supervisor.stats()["restarts"] if supervisor else 0,
             "faults": fault_plan.stats() if fault_plan is not None else None,
+            "ps_failover": (ps_supervisor.stats()
+                            if ps_supervisor is not None else None),
         }
 
     errors = [w.error for w in workers if w.error is not None]
@@ -592,13 +729,18 @@ def run_async_training(trainer, ds, shuffle: bool):
         c.close()  # in-process close is a no-op; resilient close deregisters
     if snap_client is not None:
         snap_client.close()
-    if ps is not None:
+    if active_ps is not None:
         # PS hot-path observability: stash the contention/throughput
         # counters (see ParameterServer.stats) on the trainer and stream
         # one JSON line alongside the other metrics when logging is on.
         # Kept OUT of the history: history records are per-worker loss rows
-        # and downstream consumers key on their schema.
-        trainer.ps_stats_ = ps.stats() if hasattr(ps, "stats") else None
+        # and downstream consumers key on their schema. After a failover
+        # these are the ACTIVE server's counters (its num_updates spans
+        # the whole run — the cross-failover exactly-once oracle; its op
+        # counters start at the takeover).
+        trainer.ps_stats_ = (
+            active_ps.stats() if hasattr(active_ps, "stats") else None
+        )
         if trainer.ps_stats_ is not None \
                 and getattr(trainer, "log_metrics", False):
             import json
@@ -606,15 +748,20 @@ def run_async_training(trainer, ds, shuffle: bool):
 
             print(json.dumps({"ps_stats": trainer.ps_stats_}),
                   file=sys.stderr, flush=True)
-        ps.stop()
+        if ps is not None and ps is not active_ps:
+            ps.stop()  # the crashed primary: releases any leftovers
+        if ps_standby_server is not None \
+                and ps_standby_server is not active_ps:
+            ps_standby_server.stop()  # warm replica that never took over
+        active_ps.stop()
         if getattr(trainer, "ema_decay", None) is not None:
-            trainer.ema_params_ = ps.get_ema()
+            trainer.ema_params_ = active_ps.get_ema()
 
     final_nt = next(
         (w.final_nt for w in workers if hasattr(w, "final_nt")), nt
     )
-    return (ps.get_model() if ps is not None else final_center,
-            final_nt, history)
+    return (active_ps.get_model() if active_ps is not None
+            else final_center, final_nt, history)
 
 
 class _BoundPS:
@@ -626,7 +773,8 @@ class _BoundPS:
     quantization, same server-side error feedback)."""
 
     def __init__(self, ps: ParameterServer, worker_id: int,
-                 pull_compression: str | None = None):
+                 pull_compression: str | None = None,
+                 epoch: int | None = None):
         from distkeras_tpu.parallel.compression import (
             validate_pull_compression,
         )
@@ -634,6 +782,8 @@ class _BoundPS:
         self._ps = ps
         self.worker_id = worker_id
         self.pull_compression = validate_pull_compression(pull_compression)
+        # fencing token (parity with ParameterServerClient): None = legacy
+        self.epoch = None if epoch is None else int(epoch)
 
     def pull(self, worker_id: int | None = None):
         from distkeras_tpu.parallel.compression import maybe_decode
@@ -643,8 +793,10 @@ class _BoundPS:
                                               compressed=True))
         return self._ps.pull(self.worker_id)
 
-    def commit(self, worker_id: int | None, payload, seq: int | None = None):
-        self._ps.commit(self.worker_id, payload, seq=seq)
+    def commit(self, worker_id: int | None, payload, seq: int | None = None,
+               epoch: int | None = None):
+        self._ps.commit(self.worker_id, payload, seq=seq,
+                        epoch=self.epoch if epoch is None else epoch)
 
     def heartbeat(self, retries: int = 0) -> bool:
         return self._ps.heartbeat(self.worker_id, retries=retries)

@@ -1,0 +1,627 @@
+"""PS durability + failover (ISSUE 5): WAL, crash-restart, hot standby.
+
+The oracles threaded through this file:
+
+- **bit-identical recovery**: a PS restarted from (snapshot, wal) holds
+  exactly the state a never-crashed server would after the same event
+  prefix — center, EMA, ``num_updates``, per-worker pull versions
+  (DynSGD staleness), and the commit-dedup table.
+- **exactly-once across failover**: lifetime folds (``num_updates``,
+  which survives recovery) == logical commits issued, no matter what the
+  crash tore mid-ACK — the retried commit never double-folds into the
+  recovered (or promoted) history.
+- **fencing**: a superseded server rejects late folds; clients with an
+  endpoint resolver re-resolve and catch up, clients without one die a
+  typed, fatal death.
+"""
+
+import os
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import networking
+from distkeras_tpu.networking import FencedEpochError, ProtocolError
+from distkeras_tpu.parallel.merge_rules import DownpourMerge, DynSGDMerge
+from distkeras_tpu.parameter_servers import (
+    ParameterServer,
+    ParameterServerClient,
+    SocketParameterServer,
+    StandbySocketParameterServer,
+)
+from distkeras_tpu.resilience import (
+    FaultPlan,
+    PSEndpoint,
+    ResilientPSClient,
+    RetryPolicy,
+    is_retryable,
+)
+from distkeras_tpu.resilience import wal as walmod
+from tests.test_trainers import blobs_dataset, final_loss, model_spec
+
+
+def center4(n=4):
+    return {"w": np.zeros(n, np.float32),
+            "b": {"x": np.zeros(2, np.float32)}}
+
+
+def delta4(v, n=4):
+    return {"w": np.full(n, v, np.float32),
+            "b": {"x": np.full(2, v, np.float32)}}
+
+
+def assert_trees_equal(a, b):
+    import jax
+
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                   np.asarray(y)),
+        a, b,
+    )
+
+
+# ---------------------------------------------------------------------------
+# WAL unit: framing, torn tails, truncation
+# ---------------------------------------------------------------------------
+
+
+def test_wal_record_framing_and_torn_tail():
+    r1 = walmod.encode_record(walmod.REC_PULL, (1, 5))
+    r2 = walmod.encode_record(walmod.REC_DEREG, (2,))
+    data = r1 + r2
+    recs = list(walmod.iter_records(data))
+    assert recs == [(walmod.REC_PULL, (1, 5)), (walmod.REC_DEREG, (2,))]
+    assert walmod.durable_prefix_len(data) == len(data)
+    # torn tail: half a record appended — the durable prefix excludes it
+    torn = data + r1[: len(r1) // 2]
+    assert list(walmod.iter_records(torn)) == recs
+    assert walmod.durable_prefix_len(torn) == len(data)
+    # corrupt body (bit rot): CRC refuses it and everything after
+    corrupt = bytearray(data)
+    corrupt[-1] ^= 0xFF
+    assert list(walmod.iter_records(bytes(corrupt))) == recs[:1]
+
+
+def test_wal_reopen_truncates_torn_tail(tmp_path):
+    log = walmod.CommitLog(str(tmp_path))
+    log.open_segment(0)
+    log.append(walmod.encode_record(walmod.REC_PULL, (0, 0)))
+    log.close()
+    seg = tmp_path / "wal-000000000000.log"
+    with open(seg, "ab") as f:
+        f.write(b"\x01garbage-torn-tail")
+    log2 = walmod.CommitLog(str(tmp_path))
+    log2.open_segment(0)  # must truncate before appending
+    log2.append(walmod.encode_record(walmod.REC_PULL, (1, 1)))
+    log2.close()
+    recs = list(walmod.iter_records(seg.read_bytes()))
+    assert recs == [(walmod.REC_PULL, (0, 0)), (walmod.REC_PULL, (1, 1))]
+
+
+def test_wal_snapshot_truncates_history(tmp_path):
+    ps = ParameterServer(center4(), DownpourMerge(), 2,
+                         wal_dir=str(tmp_path), snapshot_every=4)
+    for k in range(11):
+        ps.pull(0)
+        ps.commit(0, delta4(1.0), seq=k + 1)
+    names = sorted(os.listdir(tmp_path))
+    snaps = [n for n in names if n.startswith("snap-")]
+    segs = [n for n in names if n.startswith("wal-")]
+    # old segments/snapshots below the newest snapshot are gone
+    assert len(snaps) == 1 and snaps[0] == "snap-000000000008.dkw"
+    assert segs == ["wal-000000000008.log"]
+    ps2 = ParameterServer(center4(), DownpourMerge(), 2,
+                          wal_dir=str(tmp_path))
+    assert ps2.recovered_ and ps2.num_updates == 11
+    assert_trees_equal(ps2.get_model(), ps.get_model())
+
+
+# ---------------------------------------------------------------------------
+# Crash-restart recovery: the bit-identical oracle
+# ---------------------------------------------------------------------------
+
+
+def test_recovery_bit_identical_to_no_crash_oracle(tmp_path):
+    """DynSGD + EMA + interleaved pulls, then a crash: the recovered
+    server must match a never-crashed server folding the same events —
+    bitwise, across center, EMA, staleness table, and dedup table."""
+    rng = np.random.default_rng(0)
+
+    def events():
+        # (worker, pull?, payload, seq) — irregular pulls so staleness
+        # actually varies, non-trivial float payloads so bit-identity
+        # means something
+        out = []
+        for k in range(23):
+            w = k % 3
+            out.append((w, k % 4 != 2,
+                        delta4(float(rng.standard_normal())), k + 1))
+        return out
+
+    evs = events()
+    oracle = ParameterServer(center4(), DynSGDMerge(), 3, ema_decay=0.97)
+    walled = ParameterServer(center4(), DynSGDMerge(), 3, ema_decay=0.97,
+                             wal_dir=str(tmp_path), snapshot_every=7)
+    for w, do_pull, payload, seq in evs:
+        for ps in (oracle, walled):
+            if do_pull:
+                ps.pull(w)
+            ps.commit(w, payload, seq=seq)
+    oracle.deregister_worker(1)
+    walled.deregister_worker(1)
+
+    # crash: abandon the object (per-append flushes are all that's left)
+    walled._wal._fh.close()
+    recovered = ParameterServer(center4(), DynSGDMerge(), 3, ema_decay=0.97,
+                                wal_dir=str(tmp_path), snapshot_every=7)
+    assert recovered.recovered_
+    assert recovered.num_updates == oracle.num_updates == 23
+    assert_trees_equal(recovered.get_model(), oracle.get_model())
+    assert_trees_equal(recovered.get_ema(), oracle.get_ema())
+    assert recovered._pull_versions == oracle._pull_versions
+    assert recovered._last_seq == oracle._last_seq
+
+    # and the NEXT fold prices staleness identically on both
+    payload = delta4(0.25)
+    oracle.commit(2, payload, seq=100)
+    recovered.commit(2, payload, seq=100)
+    assert_trees_equal(recovered.get_model(), oracle.get_model())
+
+
+def test_recovery_dedups_replay_of_pre_crash_commit(tmp_path):
+    """The append-before-ACK contract, from the client's side: a commit
+    folded AND logged pre-crash must be refused as a duplicate when the
+    lost-ACK retry replays it against the recovered server."""
+    ps = ParameterServer(center4(), DownpourMerge(), 1,
+                         wal_dir=str(tmp_path))
+    ps.commit(0, delta4(1.0), seq=7)
+    ps._wal._fh.close()  # crash after fold+append, "before" the ACK
+    ps2 = ParameterServer(center4(), DownpourMerge(), 1,
+                          wal_dir=str(tmp_path))
+    assert ps2.commit(0, delta4(1.0), seq=7) is False   # replay refused
+    assert ps2.commit(0, delta4(1.0), seq=8) is True
+    assert ps2.num_updates == 2
+    np.testing.assert_allclose(ps2.get_model()["w"], 2.0)
+
+
+def test_recovery_survives_torn_last_record(tmp_path):
+    """A crash mid-append loses exactly the unACKed tail, nothing else."""
+    ps = ParameterServer(center4(), DownpourMerge(), 1,
+                         wal_dir=str(tmp_path))
+    for k in range(3):
+        ps.commit(0, delta4(1.0), seq=k + 1)
+    ps._wal._fh.close()
+    seg = next(p for p in os.listdir(tmp_path) if p.startswith("wal-"))
+    path = os.path.join(str(tmp_path), seg)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 11)  # tear the last record mid-body
+    ps2 = ParameterServer(center4(), DownpourMerge(), 1,
+                          wal_dir=str(tmp_path))
+    assert ps2.recovered_ and ps2.num_updates == 2
+    np.testing.assert_allclose(ps2.get_model()["w"], 2.0)
+    # the torn commit was never ACKed: its replay folds exactly once
+    assert ps2.commit(0, delta4(1.0), seq=3) is True
+    assert ps2.num_updates == 3
+
+
+def test_socket_ps_restart_in_place(tmp_path):
+    """SocketParameterServer: commits over the wire, a _crash(), then a
+    fresh server on the same WAL — state identical, wire answers again."""
+    ps = SocketParameterServer(center4(), DownpourMerge(), 1,
+                               wal_dir=str(tmp_path), snapshot_every=3)
+    ps.initialize()
+    ps.start()
+    c = ParameterServerClient("127.0.0.1", ps.port, 0)
+    for k in range(5):
+        c.pull()
+        c.commit(0, delta4(1.0), seq=k + 1)
+    before = ps.get_model()
+    ps._crash()
+    assert ps.crashed_
+    with pytest.raises((ConnectionError, OSError)):
+        c.commit(0, delta4(1.0), seq=6)
+        c.commit(0, delta4(1.0), seq=7)  # first may land in a dead buffer
+    ps2 = SocketParameterServer(center4(), DownpourMerge(), 1,
+                                wal_dir=str(tmp_path), snapshot_every=3)
+    assert ps2.recovered_ and ps2.wal_replay_s >= 0.0
+    assert_trees_equal(ps2.get_model(), before)
+    ps2.initialize()
+    ps2.start()
+    try:
+        c2 = ParameterServerClient("127.0.0.1", ps2.port, 0)
+        c2.commit(0, delta4(1.0), seq=5)   # pre-crash seq: refused
+        c2.commit(0, delta4(1.0), seq=6)
+        assert ps2.num_updates == 6
+        c2.close()
+    finally:
+        ps2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fencing: epoch tokens, triage, resolver re-resolve
+# ---------------------------------------------------------------------------
+
+
+def test_fencing_inprocess_mismatch_is_fatal():
+    ps = ParameterServer(center4(), DownpourMerge(), 1, fence_epoch=2)
+    ps.commit(0, delta4(1.0), seq=1, epoch=2)        # matching: folds
+    bytes_before = ps.stats()["bytes_in"]
+    with pytest.raises(FencedEpochError) as ei:
+        ps.commit(0, delta4(1.0), seq=2, epoch=1)    # stale token
+    assert ei.value.server_epoch == 2 and ei.value.client_epoch == 1
+    assert is_retryable(ei.value) is False           # satellite: fatal
+    assert isinstance(ei.value, ConnectionError)     # old handlers catch
+    assert ps.num_updates == 1
+    assert ps.stats()["fenced_commits"] == 1
+    # the fenced payload still crossed the wire: bytes counted (native
+    # parity), commit not
+    assert ps.stats()["bytes_in"] > bytes_before
+    # epoch-less legacy commits are never fenced
+    assert ps.commit(0, delta4(1.0), seq=3) is True
+
+
+def test_fencing_over_socket_wire_and_fence_action():
+    ps = SocketParameterServer(center4(), DownpourMerge(), 1)
+    ps.initialize()
+    ps.start()
+    try:
+        c = ParameterServerClient("127.0.0.1", ps.port, 0, epoch=0)
+        c.commit(0, delta4(1.0), seq=1)
+        assert c.ping()["epoch"] == 0
+        assert c.fence(4) == 4                       # admin fence
+        with pytest.raises(FencedEpochError):
+            c.commit(0, delta4(1.0), seq=2)
+        c.epoch = 4
+        c.commit(0, delta4(1.0), seq=2)
+        assert ps.num_updates == 2
+        assert ps.stats()["fenced_commits"] == 1
+        c.close()
+    finally:
+        ps.stop()
+
+
+def test_failover_triage_refused_and_midhandshake_eof():
+    """Satellite: ECONNREFUSED and mid-handshake EOF — the two faces of
+    'the primary is being replaced right now' — are retryable."""
+    import socket as _socket
+
+    # connection refused: bind a port, close it, connect
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    with pytest.raises(ConnectionError) as ei:
+        networking.connect("127.0.0.1", port, timeout=2)
+    assert is_retryable(ei.value)
+
+    # mid-handshake EOF: server accepts then dies before replying
+    lst = _socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    port = lst.getsockname()[1]
+
+    def die_after_accept():
+        conn, _ = lst.accept()
+        conn.close()
+
+    t = threading.Thread(target=die_after_accept, daemon=True)
+    t.start()
+    conn = networking.connect("127.0.0.1", port, timeout=2)
+    networking.send_data(conn, {"action": "ping"})
+    # a clean FIN mid-frame surfaces as a retryable ProtocolError; a
+    # close with unread data surfaces as ECONNRESET — both are the
+    # "primary is being replaced" weather and both must be retryable
+    with pytest.raises((ProtocolError, ConnectionResetError)) as ei:
+        networking.recv_data(conn)
+    assert is_retryable(ei.value)
+    if isinstance(ei.value, ProtocolError):
+        assert ei.value.retryable
+    conn.close()
+    lst.close()
+    t.join(timeout=5)
+
+
+def test_resilient_client_rides_fence_through_resolver():
+    """A fenced client WITH a resolver reconnects, adopts the new epoch,
+    and lands the commit exactly once; WITHOUT one, fenced is fatal."""
+    ps = SocketParameterServer(center4(), DownpourMerge(), 1)
+    ps.initialize()
+    ps.start()
+    try:
+        resolver = PSEndpoint("127.0.0.1", ps.port, epoch=0)
+
+        def mk():
+            host, port, epoch = resolver.resolve()
+            return ParameterServerClient(host, port, 0, epoch=epoch)
+
+        rc = ResilientPSClient(
+            mk, 0, policy=RetryPolicy(base_delay=0.001, max_delay=0.01,
+                                      deadline=10), resolver=resolver)
+        rc.commit(0, delta4(1.0))
+        # failover happened elsewhere: server fenced to 1, resolver moved
+        ps.fence(1)
+        resolver.update("127.0.0.1", ps.port, 1)
+        rc.commit(0, delta4(1.0))     # fenced once, re-resolved, folded
+        assert ps.num_updates == 2
+        assert ps.stats()["fenced_commits"] == 1
+        assert rc.retries >= 1
+        rc.close()
+
+        # no resolver: the same fence is the end of the line
+        rc2 = ResilientPSClient(
+            mk, 0, policy=RetryPolicy(base_delay=0.001, deadline=10))
+        ps.fence(2)
+        with pytest.raises(FencedEpochError):
+            rc2.commit(0, delta4(1.0))
+        rc2.close()
+    finally:
+        ps.stop()
+
+
+# ---------------------------------------------------------------------------
+# Hot standby: streaming, promotion, zombie fencing
+# ---------------------------------------------------------------------------
+
+
+def test_standby_streams_and_promotes_bit_identical():
+    ps = SocketParameterServer(center4(), DynSGDMerge(), 2, ema_decay=0.9)
+    ps.initialize()
+    ps.start()
+    sb = StandbySocketParameterServer(center4(), DynSGDMerge(), 2,
+                                      ema_decay=0.9)
+    sb.initialize()
+    sb.start()
+    try:
+        ps.attach_standby("127.0.0.1", sb.port)
+        assert ps.has_standby
+        c = ParameterServerClient("127.0.0.1", ps.port, 0, epoch=0)
+        # a standby refuses worker ops pre-promotion (retryable)
+        c_sb = ParameterServerClient("127.0.0.1", sb.port, 1, epoch=0)
+        with pytest.raises(ProtocolError) as ei:
+            c_sb.pull()
+        assert ei.value.retryable
+        c_sb.close()
+        for k in range(6):
+            c.pull()
+            c.commit(0, delta4(0.5), seq=k + 1)
+        # NO settling sleep: promote() must drain the in-flight stream
+        # itself (records are sent before the ACKs, applied on the
+        # standby's own thread) — ACKed folds may not be dropped
+        primary_state = ps.get_model()
+        primary_ema = ps.get_ema()
+        sb.promote(epoch=1)
+        assert sb.promoted_ and not sb.is_standby and sb.fence_epoch == 1
+        assert sb.num_updates == 6
+        assert_trees_equal(sb.get_model(), primary_state)
+        assert_trees_equal(sb.get_ema(), primary_ema)
+        assert sb._last_seq == ps._last_seq
+        assert sb._pull_versions == ps._pull_versions
+        # the promoted server serves; a zombie-primary client's stale
+        # token is fenced at the new server
+        c2 = ParameterServerClient("127.0.0.1", sb.port, 0, epoch=1)
+        c2.commit(0, delta4(0.5), seq=7)
+        assert sb.num_updates == 7
+        c_stale = ParameterServerClient("127.0.0.1", sb.port, 0, epoch=0)
+        with pytest.raises(FencedEpochError):
+            c_stale.commit(0, delta4(0.5), seq=8)
+        c_stale.close()
+        # and fencing the zombie primary rejects ITS late folds too
+        ps.fence(1)
+        with pytest.raises(FencedEpochError):
+            c.commit(0, delta4(0.5), seq=8)
+        c.close()
+        c2.close()
+    finally:
+        sb.stop()
+        ps.stop()
+
+
+# ---------------------------------------------------------------------------
+# Dedup-table bounds + the eviction/commit race (satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_dedup_table_bounded_across_worker_generations():
+    """Elastic churn: register/commit/deregister cycles (and eviction
+    cycles) must not grow the seqno table without bound."""
+    ps = ParameterServer(center4(), DownpourMerge(), 4, lease_timeout=0.05)
+    for gen in range(50):
+        wid = gen % 7
+        ps.heartbeat(wid)
+        ps.commit(wid, delta4(0.0), seq=gen + 1)
+        ps.deregister_worker(wid)
+    assert ps._last_seq == {}          # clean exits retire every entry
+    # eviction path: silent workers' entries go with their leases
+    for wid in range(7, 12):
+        ps.heartbeat(wid)
+        ps.commit(wid, delta4(0.0), seq=1)
+    assert len(ps._last_seq) == 5
+    time.sleep(0.12)
+    ps.stats()                          # forced expiry pass
+    assert ps.stats()["evicted_workers"] >= 5
+    assert ps._last_seq == {}
+    assert ps.num_updates == 55
+
+
+def test_eviction_commit_race_pins_dynsgd_pricing():
+    """Satellite: a worker evicted while its commit is in flight. The
+    eviction cleared its pull version AND dedup entry; the late commit
+    must fold priced at maximal staleness (τ = num_updates), not at the
+    stale pull's τ."""
+    ps = ParameterServer(center4(), DynSGDMerge(), 2, lease_timeout=0.05)
+    ps.heartbeat(0)
+    ps.pull(0)                          # worker 0 bases at version 0
+    for k in range(4):                  # survivor advances the center
+        ps.pull(1)
+        ps.commit(1, delta4(1.0), seq=k + 1)
+    time.sleep(0.12)
+    ps.stats()                          # eviction fires: 0's state reset
+    assert 0 not in ps._pull_versions and 0 not in ps._last_seq
+    before = ps.get_model()["w"].copy()
+    # the in-flight commit lands: τ = num_updates = 4 → scale 1/5
+    assert ps.commit(0, delta4(5.0), seq=1) is True
+    np.testing.assert_allclose(ps.get_model()["w"], before + 5.0 / 5.0)
+
+
+def test_kill_ps_chaos_requires_a_recovery_path():
+    """A PS-kill fault with no WAL and no standby (or on a transport
+    with no failover wiring) is a guaranteed mid-run crash / silent
+    no-op — rejected at construction, not discovered after the retry
+    deadline."""
+    from distkeras_tpu import DOWNPOUR
+
+    kw = dict(backend="ps",
+              fault_plan=FaultPlan(kill_ps_after_commits=5))
+    with pytest.raises(ValueError, match="recovery path"):
+        DOWNPOUR(model_spec(), ps_transport="socket", **kw)
+    with pytest.raises(ValueError, match="ps_transport='socket'"):
+        DOWNPOUR(model_spec(), ps_transport="inprocess", **kw)
+    # with a recovery path it constructs fine
+    DOWNPOUR(model_spec(), ps_transport="socket", ps_standby=True, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Native transport parity: fencing protocol + WAL graceful degrade
+# ---------------------------------------------------------------------------
+
+
+def test_native_fencing_protocol_parity():
+    """dkps.cpp speaks the same fencing protocol: FENCE raises the epoch,
+    COMMIT_SEQ_E folds/dedups/fences like the Python PS, the fenced
+    count lands in the shared stats key set, and eviction retires the
+    dedup entry (the bounded-table satellite, natively)."""
+    from distkeras_tpu.native import load_dkps
+
+    if load_dkps() is None:
+        pytest.skip("no C++ toolchain to build libdkps")
+    from distkeras_tpu.native_ps import (
+        NativePSClient,
+        NativeSocketParameterServer,
+    )
+
+    center = {"w": np.zeros(5, np.float32)}
+    ps = NativeSocketParameterServer(center, DownpourMerge(), 2,
+                                     lease_timeout=0.1)
+    ps.initialize()
+    ps.start()
+    try:
+        c = NativePSClient("127.0.0.1", ps.port, 0, ps.spec, epoch=0)
+        d = {"w": np.ones(5, np.float32)}
+        c.commit(0, d, seq=1)
+        c.commit(0, d, seq=1)                       # dup
+        assert ps.fence(2) == 2 and ps.fence_epoch == 2
+        with pytest.raises(FencedEpochError):       # stale token: fenced
+            c.commit(0, d, seq=2)
+        c.epoch = 2
+        c.commit(0, d, seq=2)
+        assert c.fence(3) == 3                      # client-side admin
+        s = ps.stats()
+        assert s["commits"] == 2 and s["dup_commits"] == 1
+        assert s["fenced_commits"] == 1 and s["num_updates"] == 2
+        # key-set parity with the Python PS holds with the new keys
+        py = ParameterServer(center, DownpourMerge(), 2)
+        assert set(s) == set(py.stats())
+        # eviction retires the dedup entry natively too: the replayed
+        # old seq folds again, down-weighted only by the merge rule
+        c.epoch = None
+        c.heartbeat()
+        time.sleep(0.25)
+        assert ps.stats()["evicted_workers"] == 1
+        c.commit(0, d, seq=1)                       # fence entry is gone
+        assert ps.num_updates == 3
+        c.close()
+    finally:
+        ps.stop()
+
+
+def test_native_wal_degrades_gracefully():
+    from distkeras_tpu.native import load_dkps
+
+    if load_dkps() is None:
+        pytest.skip("no C++ toolchain to build libdkps")
+    from distkeras_tpu.native_ps import NativeSocketParameterServer
+
+    with pytest.warns(UserWarning, match="no write-ahead log"):
+        ps = NativeSocketParameterServer(
+            {"w": np.zeros(3, np.float32)}, DownpourMerge(), 1,
+            wal_dir="/tmp/ignored",
+        )
+    ps.initialize()
+    ps.start()
+    ps.stop()
+
+
+# ---------------------------------------------------------------------------
+# The chaos integration test (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cls_name,standby", [
+    ("ADAG", False),       # WAL restart-in-place
+    ("DOWNPOUR", True),    # hot-standby promotion
+])
+def test_ps_killed_mid_run_completes_and_converges(cls_name, standby,
+                                                   tmp_path):
+    """The acceptance oracle: the PS is crash-stopped mid-run (with and
+    without a standby) under wire drops+delays; the run completes,
+    converges below the no-fault first-epoch loss, the recovered center
+    is bit-identical to an independent WAL replay, and no retried commit
+    double-folded across the failover (lifetime folds == logical)."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.resilience.wal import recover_ps_state
+
+    cls = getattr(dk, cls_name)
+    ds = blobs_dataset(n=1024)
+    kw = dict(loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+              learning_rate=0.05, num_workers=4, batch_size=16,
+              communication_window=2, num_epoch=2, backend="ps")
+
+    base = cls(model_spec(), **kw)
+    base.train(ds, shuffle=True)
+    first_epoch = float(np.mean(
+        [r["loss"] for r in base.get_history()
+         if "loss" in r and r.get("epoch") == 0]
+    ))
+
+    wal_dir = str(tmp_path / "wal")
+    plan = FaultPlan(seed=13, drop_recv=0.02, delay=0.03, delay_s=0.002,
+                     kill_ps_after_commits=8, max_faults=40)
+    t = cls(model_spec(), **kw, ps_transport="socket",
+            ps_wal_dir=wal_dir, ps_snapshot_every=5, ps_standby=standby,
+            ps_failover_timeout=0.4,
+            retry_policy=RetryPolicy(max_attempts=100, base_delay=0.005,
+                                     max_delay=0.2, deadline=120),
+            heartbeat_interval=0.05, fault_plan=plan)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # failover warning expected
+        with plan:
+            t.train(ds, shuffle=True)
+
+    # (a) the kill really happened and was really survived
+    assert plan.stats()["ps_kills"] == 1
+    fo = t.resilience_stats_["ps_failover"]
+    assert fo["failovers"] == 1
+    assert fo["failover_log"][0]["via"] == (
+        "standby" if standby else "restart"
+    )
+    # (b) converged below the clean run's first-epoch loss
+    assert final_loss(t) < first_epoch, (final_loss(t), first_epoch)
+    # (c) exactly-once across the failover: lifetime folds == logical
+    s = t.ps_stats_
+    assert s["num_updates"] == t.resilience_stats_["logical_commits"]
+    # (d) the active server's durable log replays to the exact final
+    # center — the WAL-replay oracle (the restart leg recovers the
+    # primary's log; the standby leg snapshots into its own at promotion)
+    rule = t.allocate_merge_rule()
+    oracle_dir = os.path.join(wal_dir, "standby") if standby else wal_dir
+    state = recover_ps_state(oracle_dir, rule, 4, None)
+    assert state is not None
+    assert state["num_updates"] == s["num_updates"]
+    assert_trees_equal(state["center"], t.trained_params_)
+    # (e) every worker contributed after the chaos
+    workers_seen = {r.get("worker") for r in t.get_history() if "loss" in r}
+    assert workers_seen == {0, 1, 2, 3}
