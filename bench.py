@@ -1463,6 +1463,271 @@ def run_ps_failover_bench(n_params=1_000_000, workers=4, seconds=4.0,
     return out
 
 
+# ---------------------------------------------------------------------------
+# Serving-tier benchmark (--serve): Poisson open-loop load against the
+# continuous-batching generation server (block-paged KV cache) vs the
+# sequential one-request-at-a-time GeneratorPredictor baseline. The number
+# that matters: completed requests/sec at each offered rate, with p50/p99
+# end-to-end latency — continuous batching should hold >=3x the sequential
+# throughput at saturation (ISSUE 6 acceptance).
+# ---------------------------------------------------------------------------
+
+
+def _serve_lm(vocab, maxlen, dim, heads, depth, dtype_name):
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models import transformer_lm
+
+    dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32}[dtype_name]
+    spec = transformer_lm(vocab=vocab, maxlen=maxlen, dim=dim, heads=heads,
+                          depth=depth, dtype=dtype)
+    params, _ = spec.init_np(0)
+    return spec, params
+
+
+def _serve_open_loop(port, prompts, max_new, rate, seconds, seed):
+    """Poisson open-loop load: seeded exponential interarrivals at `rate`
+    req/s for `seconds`, one client thread per request (arrivals never
+    wait for completions — the open-loop discipline that exposes queueing
+    delay). Busy backpressure is ridden out by the reconnecting client,
+    so it lands in latency, not in silent drops. Returns (latencies_s,
+    wall_s, errors)."""
+    import threading
+
+    from distkeras_tpu.resilience import RetryPolicy
+    from distkeras_tpu.serving import (
+        GenerationClient,
+        ResilientGenerationClient,
+    )
+
+    rng = np.random.default_rng(seed)
+    # cap outstanding work: past saturation the queue does the measuring,
+    # thousands of client threads would only measure the host's scheduler
+    n = max(1, min(int(rate * seconds), 400))
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n))
+    lats, errors = [], []
+    lock = threading.Lock()
+
+    def one(i):
+        try:
+            client = ResilientGenerationClient(
+                lambda: GenerationClient("127.0.0.1", port),
+                policy=RetryPolicy(max_attempts=200, base_delay=0.02,
+                                   max_delay=0.5, deadline=120.0,
+                                   seed=seed + i),
+            )
+            t0 = time.perf_counter()
+            client.generate(prompts[i % len(prompts)],
+                            max_new_tokens=max_new, seed=i)
+            dt = time.perf_counter() - t0
+            client.close()
+            with lock:
+                lats.append(dt)
+        except Exception as e:  # surfaced in the record
+            with lock:
+                errors.append(repr(e))
+
+    threads = []
+    t_start = time.perf_counter()
+    for i in range(n):
+        delay = t_start + arrivals[i] - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t = threading.Thread(target=one, args=(i,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=300)
+    wall = time.perf_counter() - t_start
+    return lats, wall, errors
+
+
+def run_serving_bench(vocab=1024, maxlen=160, dim=512, heads=8, depth=4,
+                      dtype_name="f32", prompt_len=16, max_new=48,
+                      max_batch=16, block_size=16, n_baseline=6,
+                      rates=(1.0, 2.0, 4.0, 6.0), seconds=6.0,
+                      legs=("paged", "int8", "spec"), seed=0):
+    """Serving-tier benchmark: sequential GeneratorPredictor baseline, then
+    the continuous-batching server under Poisson open-loop load at offered
+    rates of `rates` x the sequential throughput. One record per leg:
+    throughput_rps (completed/sec over the whole open-loop window), p50/p99
+    end-to-end latency, speedup_vs_sequential (best sustained rate over the
+    sequential baseline), plus the engine's occupancy/block stats. Legs:
+    'paged' (the headline), 'int8' (weight-only quantized engine — same
+    server, same cache), 'spec' (self-draft speculative serving: the
+    acceptance=1.0 upper bound of draft-based serving — a real deployment
+    substitutes a trained draft).
+
+    The default model/dtype is sized so a BATCH-1 decode step is WEIGHT-
+    STREAMING bound (dim 512 x 4 layers f32: ~50 MB of kernels stream per
+    step, far over cache; f32 because this host's vectorized f32 matmul
+    is fast enough to be bandwidth-bound at B=1 where its bf16 path is
+    compute-bound at any batch) — the regime real serving lives in, where
+    a batched step costs less per row than a batch-1 step. A toy model
+    instead measures fused-scan dispatch overhead, where the sequential
+    baseline's zero-Python decode loop is unbeatable and the comparison
+    says nothing about serving (measured: dim=128 flips the ratio to
+    0.3x).
+
+    The record also carries the HOST CEILING: ``static_batch_rps`` times
+    a dense ``generate`` scan at B=``max_batch`` — the throughput of a
+    perfect drain-the-batch static batcher with zero scheduling overhead
+    — and ``host_ceiling_x`` (that bound over the sequential baseline).
+    On a single-core CPU the ceiling is set by the core's compute/
+    bandwidth balance (measured ~2.3x here) and the >=3x acceptance line
+    is a TPU-regime claim: ``bound_fraction`` (achieved throughput over
+    the static bound) is the number that transfers across hosts —
+    continuous batching at ~1.0 means the scheduler adds nothing on top
+    of an ideal batcher while ALSO admitting/retiring per iteration."""
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.models import quantize_lm
+    from distkeras_tpu.predictors import GeneratorPredictor
+    from distkeras_tpu.serving import GenerationEngine, GenerationServer
+
+    spec, params = _serve_lm(vocab, maxlen, dim, heads, depth, dtype_name)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, vocab, (prompt_len,)).astype(np.int32)
+               for _ in range(32)]
+
+    # sequential baseline: one request at a time through the predictor
+    # (the pre-serving-tier deployment story), timed after a warmup pass
+    base_ds = Dataset({"features": np.stack(prompts[:n_baseline])})
+    pred = GeneratorPredictor(spec, params, max_new_tokens=max_new,
+                              batch_size=1)
+    pred.predict(Dataset({"features": np.stack(prompts[:1])}))  # warm/compile
+    t0 = time.perf_counter()
+    pred.predict(base_ds)
+    seq_rps = n_baseline / (time.perf_counter() - t0)
+    log(f"[serve] sequential GeneratorPredictor baseline: "
+        f"{seq_rps:.2f} req/s ({dim}d x {depth}L {dtype_name}, "
+        f"{prompt_len}+{max_new} tokens)")
+
+    # host ceiling: a dense generate() scan over max_batch rows at once —
+    # the perfect static batcher (no scheduling, no admission, every
+    # request identical). Continuous batching is measured against BOTH:
+    # speedup_vs_sequential is the deployment claim, bound_fraction says
+    # how much of the host's batching headroom the scheduler captures.
+    from distkeras_tpu.models.lm import generate as _generate
+
+    bprompt = np.stack([prompts[i % len(prompts)]
+                        for i in range(max_batch)])
+    _generate(spec, params, bprompt, max_new)         # compile
+    t0 = time.perf_counter()
+    _generate(spec, params, bprompt, max_new)
+    static_rps = max_batch / (time.perf_counter() - t0)
+    log(f"[serve] dense static-batch bound (B={max_batch}): "
+        f"{static_rps:.2f} req/s = {static_rps / seq_rps:.2f}x sequential")
+
+    def build_engine(leg):
+        if leg == "int8":
+            qspec, qparams = quantize_lm(spec, params)
+            return GenerationEngine(qspec, qparams, max_batch=max_batch,
+                                    block_size=block_size, max_queue=256)
+        if leg == "spec":
+            return GenerationEngine(spec, params, max_batch=max_batch,
+                                    block_size=block_size, max_queue=256,
+                                    draft=spec, draft_params=params,
+                                    spec_tokens=4)
+        if leg != "paged":
+            raise ValueError(f"unknown serving leg {leg!r} "
+                             f"(choose from paged, int8, spec)")
+        return GenerationEngine(spec, params, max_batch=max_batch,
+                                block_size=block_size, max_queue=256)
+
+    out = {}
+    for leg in legs:
+        engine = build_engine(leg)
+        server = GenerationServer(engine)
+        server.start()
+        try:
+            # warm the compile caches through the real wire path: a
+            # concurrent burst exercises the batched-prefill row buckets
+            # and the decode width buckets, not just the single-row path
+            import threading as _threading
+
+            from distkeras_tpu.serving import GenerationClient
+
+            def _warm(i):
+                c = GenerationClient("127.0.0.1", server.port)
+                c.generate(prompts[i % len(prompts)],
+                           max_new_tokens=max_new)
+                c.close()
+
+            ws = [_threading.Thread(target=_warm, args=(i,))
+                  for i in range(max_batch)]
+            for w in ws:
+                w.start()
+            for w in ws:
+                w.join(timeout=300)
+
+            per_rate = []
+            best = None
+            for mult in rates:
+                rate = max(0.25, mult * seq_rps)
+                lats, wall, errors = _serve_open_loop(
+                    server.port, prompts, max_new, rate, seconds, seed)
+                if not lats:
+                    per_rate.append({"offered_rps": round(rate, 2),
+                                     "errors": errors[:3]})
+                    continue
+                lats_ms = np.sort(np.asarray(lats)) * 1e3
+                rec = {
+                    "offered_rps": round(rate, 2),
+                    "completed": len(lats),
+                    "errors": len(errors),
+                    "throughput_rps": round(len(lats) / wall, 2),
+                    "p50_ms": round(float(np.percentile(lats_ms, 50)), 1),
+                    "p99_ms": round(float(np.percentile(lats_ms, 99)), 1),
+                }
+                per_rate.append(rec)
+                if best is None or rec["throughput_rps"] > \
+                        best["throughput_rps"]:
+                    best = rec
+                log(f"[serve] {leg} offered {rate:.2f} req/s -> "
+                    f"{rec['throughput_rps']} req/s, p50 {rec['p50_ms']} ms"
+                    f", p99 {rec['p99_ms']} ms")
+            stats = engine.stats()
+            rec = {
+                "config": f"serve_{leg}",
+                "model": {"vocab": vocab, "maxlen": maxlen, "dim": dim,
+                          "heads": heads, "depth": depth,
+                          "dtype": dtype_name},
+                "prompt_len": prompt_len, "max_new_tokens": max_new,
+                "max_batch": max_batch, "block_size": block_size,
+                "sequential_rps": round(seq_rps, 2),
+                "static_batch_rps": round(static_rps, 2),
+                "host_ceiling_x": round(static_rps / seq_rps, 2),
+                "rates": per_rate,
+                "throughput_rps": best["throughput_rps"] if best else 0.0,
+                "p50_ms": best["p50_ms"] if best else None,
+                "p99_ms": best["p99_ms"] if best else None,
+                "speedup_vs_sequential": (
+                    round(best["throughput_rps"] / seq_rps, 2)
+                    if best and seq_rps else 0.0
+                ),
+                "bound_fraction": (
+                    round(best["throughput_rps"] / static_rps, 2)
+                    if best and static_rps else 0.0
+                ),
+                "mean_batch_occupancy": stats["mean_batch_occupancy"],
+                "blocks_high_water": stats["blocks_high_water"],
+                "completed": stats["completed"],
+                "rejected": stats["rejected"],
+            }
+            if leg == "spec":
+                rec["spec_acceptance"] = stats.get("spec_acceptance")
+            # the >=3x acceptance line for the headline leg (self-draft
+            # spec pays 2x model cost, int8 trades dtype for bandwidth —
+            # they carry their own context, the paged leg is the claim)
+            if leg == "paged":
+                rec["target_3x_met"] = rec["speedup_vs_sequential"] >= 3.0
+            log(json.dumps(rec))
+            out[f"serve_{leg}"] = rec
+        finally:
+            server.stop(drain=False, timeout=10)
+    return out
+
+
 def run_proxy_only():
     """CPU-proxy denominator as a standalone process (spawned by main with
     ``JAX_PLATFORMS=cpu``): the ~550 s XLA:CPU compile+epochs run CONCURRENTLY
@@ -1535,13 +1800,27 @@ def main():
                          "crash-stopped mid-run; WAL restart-in-place and "
                          "hot-standby promotion legs with failover latency, "
                          "WAL replay ms, and rounds/s before vs after)")
+    ap.add_argument("--serve", action="store_true",
+                    help="run ONLY the serving-tier benchmark (continuous-"
+                         "batching generation server with a block-paged KV "
+                         "cache under Poisson open-loop load vs the "
+                         "sequential GeneratorPredictor baseline)")
+    ap.add_argument("--serve-seconds", type=float, default=6.0,
+                    help="serving benchmark seconds per offered rate")
+    ap.add_argument("--serve-max-batch", type=int, default=16,
+                    help="serving benchmark engine batch slots")
+    ap.add_argument("--serve-legs", default="paged,int8,spec",
+                    help="comma-separated serving legs to run "
+                         "(paged,int8,spec)")
     args = ap.parse_args()
 
-    if args.ps_bench or args.chaos or args.chaos_ps:
-        # pure host-side numpy/threading — no accelerator, no proxy. Per-leg
+    if args.ps_bench or args.chaos or args.chaos_ps or args.serve:
+        # PS legs are pure host-side numpy/threading; the serve leg runs the
+        # tiny LM on whatever accelerator JAX finds. No proxy. Per-leg
         # records stream to stderr; ONE headline JSON blob lands on stdout
         # (same contract as the training headline), so the BENCH_*.json
-        # trajectory files capture PS perf history instead of staying empty.
+        # trajectory files capture PS/serving perf history instead of
+        # staying empty.
         legs = {}
         if args.ps_bench:
             legs.update(run_ps_microbench(n_params=args.ps_bench_params,
@@ -1556,9 +1835,16 @@ def main():
                 n_params=args.chaos_params,
                 workers=args.ps_bench_workers,
                 seconds=args.ps_bench_seconds))
+        if args.serve:
+            legs.update(run_serving_bench(
+                max_batch=args.serve_max_batch,
+                seconds=args.serve_seconds,
+                legs=tuple(x for x in args.serve_legs.split(",") if x)))
+        serve_only = args.serve and not (args.ps_bench or args.chaos
+                                         or args.chaos_ps)
         print(json.dumps({
-            "metric": "ps_bench",
-            "unit": "ops/sec",
+            "metric": "serve_bench" if serve_only else "ps_bench",
+            "unit": "requests/sec" if serve_only else "ops/sec",
             "workers": args.ps_bench_workers,
             "legs": legs,
         }))
@@ -1728,6 +2014,9 @@ def _LEGS_IN_PRIORITY_ORDER(accel, results):
          lambda: results.update(run_lm_train_config(accel)), 150),
         ("[config 10] composed serving: 400M MQA + int8 + speculative",
          lambda: results.update(run_composed_decode_config(accel)), 360),
+        ("[config 11] serving tier: continuous batching + paged KV cache "
+         "vs sequential GeneratorPredictor",
+         lambda: results.update(run_serving_bench()), 240),
         ("[config 7b] int8 weight-only serving @400M params",
          lambda: results.update(run_lm_decode_int8(accel)), 120),
         ("[config 8] speculative decoding (greedy-exact + sampled)",
@@ -1743,7 +2032,8 @@ def _run_single_leg(accel, name):
     measurement workflow; the full run stays the driver's entry point)."""
     results = {}
     key = {"6": "[config 6]", "7": "[config 7]", "7b": "[config 7b]",
-           "8": "[config 8]", "9": "[config 9]", "10": "[config 10]"}
+           "8": "[config 8]", "9": "[config 9]", "10": "[config 10]",
+           "11": "[config 11]"}
     tag = key.get(str(name))
     if tag is None:
         raise SystemExit(f"unknown --leg {name!r}; choose from {list(key)}")

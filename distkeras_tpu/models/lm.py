@@ -47,12 +47,14 @@ def rope_angles(maxlen: int, head_dim: int, base: float = 10000.0):
 def apply_rope(x, angles):
     """Rotate feature pairs of ``x`` [..., L, H, Dh] by per-position
     ``angles`` [L, Dh//2] (pairing (x[2i], x[2i+1]), rotation in f32, cast
-    back to x.dtype)."""
+    back to x.dtype). ``angles`` may also be ``[B, L, Dh//2]`` — the paged
+    decode path, where every row sits at its own absolute position."""
     f32 = x.astype(jnp.float32)
     x1, x2 = f32[..., 0::2], f32[..., 1::2]
     # angles broadcast over batch and heads: [L, Dh/2] → [L, 1, Dh/2]
-    cos = jnp.cos(angles)[:, None, :]
-    sin = jnp.sin(angles)[:, None, :]
+    # (or [B, L, Dh/2] → [B, L, 1, Dh/2] for per-row positions)
+    cos = jnp.cos(angles)[..., None, :]
+    sin = jnp.sin(angles)[..., None, :]
     r1 = x1 * cos - x2 * sin
     r2 = x1 * sin + x2 * cos
     out = jnp.stack([r1, r2], axis=-1).reshape(f32.shape)
@@ -300,6 +302,73 @@ class DecoderBlock(nn.Module):
         x = x + self.attn_out(att.astype(self.dtype)).astype(jnp.float32)
         return self._mlp(x), k_cache, v_cache
 
+    def paged_extend(self, x, k_pool, v_pool, tables, write_slots,
+                     positions, block_size: int):
+        """``T`` decode positions per row against a BLOCK-PAGED cache — the
+        serving tier's generalization of :meth:`extend`'s addressing:
+        instead of one ``[B, cache_len]`` buffer per sequence, all
+        sequences share a flat slot pool ``[S, Hkv, Dh]`` (``S =
+        num_blocks · block_size``) and a per-row **block table**
+        ``tables`` [B, nb] maps logical block ``t // block_size`` of row
+        ``b`` to pool block ``tables[b, t // bs]`` (generalizing the ring
+        cache's ``slot = pos % cache_len`` to table indexing). Every row
+        sits at its OWN absolute position: row ``b``'s ``T`` tokens occupy
+        ``positions[b] .. positions[b]+T-1`` and are written to flat pool
+        slots ``write_slots[b]`` ([B, T], precomputed by the caller —
+        shared across layers, so it is computed once per step, not per
+        block). ``block_size`` must be a static Python int.
+
+        Math is the :meth:`extend` body unchanged (q·k in model dtype,
+        softmax f32, p·v in model dtype; GQA head-axis factoring): the
+        gather reconstructs each row's logical ``[nb·bs, Hkv, Dh]`` cache
+        exactly — at BLOCK granularity (``B·nb`` contiguous
+        ``block_size``-row chunks, not ``B·L`` scalar rows: gather cost on
+        CPU/TPU tracks the index count, and this is the difference between
+        the paged step tracking the dense step's cost or trailing it) —
+        and unwritten slots are masked by the per-row causal validity
+        ``kp <= positions[b]+t``, so paged decode is bit-identical to
+        dense-cache decode: the parity oracle in tests/test_serving.py.
+        Sliding windows keep their band mask."""
+        B, T, _ = x.shape
+        bs = int(block_size)
+        nb = tables.shape[1]
+        L = nb * bs
+        q, k, v = self._project_qkv(x)
+        if self.rope:
+            dh = self.dim // self.heads
+            table = jnp.asarray(rope_angles(self.maxlen, dh))
+            # per-row angle rows [B, T, Dh/2] — same table rows the dense
+            # step slices at its (shared) scalar position
+            angles = table[positions[:, None] + jnp.arange(T)[None, :]]
+            q = apply_rope(q, angles)
+            k = apply_rope(k, angles)
+        k_pool = k_pool.at[write_slots].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[write_slots].set(v.astype(v_pool.dtype))
+        hkv_, dh_ = k_pool.shape[1], k_pool.shape[2]
+        kb = k_pool.reshape(-1, bs, hkv_, dh_)[tables]   # [B, nb, bs, ...]
+        vb = v_pool.reshape(-1, bs, hkv_, dh_)[tables]
+        k_seq = kb.reshape(B, L, hkv_, dh_)              # [B, L, Hkv, Dh]
+        v_seq = vb.reshape(B, L, hkv_, dh_)
+        dh = self.dim // self.heads
+        hkv = self._hkv
+        group = self.heads // hkv
+        qg = q.reshape(B, T, hkv, group, dh)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_seq) \
+            .astype(jnp.float32) * (dh ** -0.5)
+        kp = jnp.arange(L)[None, None, :]
+        qp = (positions[:, None] + jnp.arange(T)[None, :])[:, :, None]
+        valid = kp <= qp                  # per-row causal; unwritten slots
+        if self.attn_window is not None:  # (kp > qp) are masked here too
+            valid &= qp - kp < self.attn_window
+        s = jnp.where(valid[:, None, None, :, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        att = jnp.einsum(
+            "bhgqk,bkhd->bqhgd", p.astype(v_seq.dtype), v_seq
+        )
+        att = att.reshape(B, T, self.dim)
+        x = x + self.attn_out(att.astype(self.dtype)).astype(jnp.float32)
+        return self._mlp(x), k_pool, v_pool
+
 
 class TransformerLM(nn.Module):
     """Token sequence → next-token logits ``[B, L, vocab]`` (training), with
@@ -469,6 +538,64 @@ class TransformerLM(nn.Module):
             new_caches.append((kc, vc))
         return self._logits(x), tuple(new_caches)
 
+    # -- block-paged decode (the serving tier's entry points) ---------------
+
+    def _embed_rows(self, tokens, positions):
+        """Embed ``tokens`` [B, T] where row ``b`` occupies absolute
+        positions ``positions[b] .. positions[b]+T-1`` (per-row positions —
+        the paged decode batch mixes sequences of different lengths)."""
+        x = self.embed(tokens).astype(jnp.float32)
+        if self.pos_embedding == "rope":
+            return x
+        table = jnp.asarray(sincos_positions(self.maxlen, self.dim))
+        T = tokens.shape[1]
+        pos = table[positions[:, None] + jnp.arange(T)[None, :]]
+        return x + pos
+
+    def prefill_raw(self, tokens):
+        """Full forward over the prompt returning ``(logits, kvs)`` with
+        per-block UNPADDED K/V ``[B, L, Hkv, Dh]`` (keys pre-rotated under
+        RoPE, cast to the cache dtype) — the serving tier scatters these
+        into its block pool instead of a dense ``[B, maxlen]`` buffer."""
+        x = self._embed_at(tokens)
+        kvs = []
+        for blk in self.blocks:
+            x, k, v = blk.prefill(x, None)
+            kvs.append((k.astype(self.dtype), v.astype(self.dtype)))
+        return self._logits(x), tuple(kvs)
+
+    def paged_extend_rows(self, tokens, k_pools, v_pools, tables,
+                          write_slots, positions, block_size: int):
+        """Multi-token decode against the block-paged cache: ``tokens``
+        [B, T], row ``b`` occupying positions ``positions[b] ..
+        positions[b]+T-1``; ``k_pools``/``v_pools`` are per-layer flat slot
+        pools (tuple of ``[S, Hkv, Dh]``), ``tables`` [B, nb] the per-row
+        block tables and ``write_slots`` [B, T] this call's flat write
+        targets. Returns ``(logits [B, T, vocab], k_pools, v_pools)``;
+        ``logits[:, t]`` predicts row position ``positions[b]+t+1``. T=1
+        is the serving decode step; T=K+1 is the speculative verify
+        forward — same body, same parity guarantees as the dense
+        :meth:`extend`."""
+        x = self._embed_rows(tokens, positions)
+        new_k, new_v = [], []
+        for blk, kp, vp in zip(self.blocks, k_pools, v_pools):
+            x, kp, vp = blk.paged_extend(x, kp, vp, tables, write_slots,
+                                         positions, block_size)
+            new_k.append(kp)
+            new_v.append(vp)
+        return self._logits(x), tuple(new_k), tuple(new_v)
+
+    def paged_decode_step(self, tok, k_pools, v_pools, tables, write_slot,
+                          positions, block_size: int):
+        """One paged decode step: ``tok`` [B] int32, each row at its own
+        ``positions[b]`` writing flat pool slot ``write_slot[b]`` →
+        ``(next-token logits [B, vocab], updated pools)``."""
+        logits, k_pools, v_pools = self.paged_extend_rows(
+            tok[:, None], k_pools, v_pools, tables, write_slot[:, None],
+            positions, block_size,
+        )
+        return logits[:, 0], k_pools, v_pools
+
 
 def _check_decode_args(fn_name: str, model, prompt, max_new_tokens: int):
     """Shared validation for generate()/beam_search(): returns
@@ -552,11 +679,18 @@ def _sample_fn(temperature: float, top_k: int | None,
 @functools.lru_cache(maxsize=64)
 def _generate_program(module: TransformerLM, max_new_tokens: int,
                       temperature: float, top_k: int | None,
-                      top_p: float | None = None):
+                      top_p: float | None = None,
+                      eos_id: int | None = None):
     """One jitted prefill+scan program per (module, decode config) — flax
     modules are frozen dataclasses, so the lru_cache key is by value and
     repeated generate()/GeneratorPredictor chunks reuse the compilation
-    (jit itself still specializes per prompt shape)."""
+    (jit itself still specializes per prompt shape).
+
+    With ``eos_id`` the scan becomes a ``lax.while_loop`` carrying a
+    per-row ``done`` flag: a finished row keeps its static shape but emits
+    ``eos_id`` pads, and the loop exits early once EVERY row is done (the
+    only early stop a static-shape program gets for free). The eos-free
+    path is byte-identical to before — eos costs nothing when unused."""
     sample = _sample_fn(temperature, top_k, top_p)
 
     def run(params, prompt, key):
@@ -567,29 +701,61 @@ def _generate_program(module: TransformerLM, max_new_tokens: int,
         key, k0 = jax.random.split(key)
         tok = sample(logits[:, -1], k0)
 
-        def body(carry, key_i):
-            tok, caches, pos = carry
+        if eos_id is None:
+            def body(carry, key_i):
+                tok, caches, pos = carry
+                logits, caches = module.apply(
+                    {"params": params}, tok, caches, pos,
+                    method=TransformerLM.decode_step,
+                )
+                nxt = sample(logits, key_i)
+                return (nxt, caches, pos + 1), tok
+
+            keys = jax.random.split(key, max_new_tokens)[1:]
+            (last, _, _), toks = jax.lax.scan(
+                body, (tok, caches, jnp.asarray(lp, jnp.int32)), keys
+            )
+            # toks: [max_new-1, B] emitted per step, plus the final carry
+            out = jnp.concatenate([toks, last[None]], axis=0)
+            return jnp.concatenate(
+                [prompt, out.T.astype(jnp.int32)], axis=1
+            )
+
+        # eos path: mask-and-carry a per-row done flag into a preallocated
+        # eos-padded output buffer; while_loop exits when all rows finish
+        B = prompt.shape[0]
+        done = tok == eos_id
+        out = jnp.full((B, max_new_tokens), eos_id, jnp.int32)
+        out = out.at[:, 0].set(tok)
+
+        def cond(carry):
+            n = carry[0]
+            return (n < max_new_tokens) & ~jnp.all(carry[4])
+
+        def body(carry):
+            n, tok, caches, out, done = carry
             logits, caches = module.apply(
-                {"params": params}, tok, caches, pos,
+                {"params": params}, tok, caches, lp + n - 1,
                 method=TransformerLM.decode_step,
             )
-            nxt = sample(logits, key_i)
-            return (nxt, caches, pos + 1), tok
+            nxt = sample(logits, jax.random.fold_in(key, n))
+            nxt = jnp.where(done, eos_id, nxt)   # pad after EOS
+            out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, n))
+            return (n + 1, nxt, caches, out, done | (nxt == eos_id))
 
-        keys = jax.random.split(key, max_new_tokens)[1:]
-        (last, _, _), toks = jax.lax.scan(
-            body, (tok, caches, jnp.asarray(lp, jnp.int32)), keys
+        _, _, _, out, _ = jax.lax.while_loop(
+            cond, body,
+            (jnp.asarray(1, jnp.int32), tok, caches, out, done),
         )
-        # toks: [max_new-1, B] emitted per step, plus the final carry token
-        out = jnp.concatenate([toks, last[None]], axis=0)
-        return jnp.concatenate([prompt, out.T.astype(jnp.int32)], axis=1)
+        return jnp.concatenate([prompt, out], axis=1)
 
     return jax.jit(run)
 
 
 def generate(model, params, prompt, max_new_tokens: int, *,
              temperature: float = 0.0, top_k: int | None = None,
-             top_p: float | None = None, seed: int = 0):
+             top_p: float | None = None, seed: int = 0,
+             eos_id: int | None = None):
     """Autoregressive decoding: ``prompt`` [B, Lp] int32 → [B, Lp+new] int32.
 
     One jitted program: prefill writes the KV caches for the whole prompt in
@@ -600,6 +766,17 @@ def generate(model, params, prompt, max_new_tokens: int, *,
     highest-probability tokens and/or the smallest nucleus of tokens whose
     probability mass reaches ``top_p`` (applied after ``top_k``).
     Deterministic for a fixed ``seed``.
+
+    ``eos_id`` stops a row at its first end-of-sequence token: the row pads
+    with ``eos_id`` from there on (static output shape — shapes never
+    depend on data), and the decode loop exits early once every row has
+    finished. Rows that never emit ``eos_id`` run the full budget. Count
+    real tokens with :func:`distkeras_tpu.serving.per_row_new_token_counts`
+    — the same retire rule the serving tier applies per step. NOTE: the
+    eos path draws its sampling keys from a different (per-step
+    ``fold_in``) schedule than the eos-free scan, so sampled streams with
+    and without ``eos_id`` are not token-for-token comparable; greedy
+    streams are identical up to the first eos.
     """
     module, prompt = _check_decode_args(
         "generate", model, prompt, max_new_tokens
@@ -610,9 +787,12 @@ def generate(model, params, prompt, max_new_tokens: int, *,
         )
     if top_p is not None and not 0.0 < float(top_p) <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if eos_id is not None and not 0 <= int(eos_id) < module.vocab:
+        raise ValueError(f"eos_id {eos_id} outside vocab {module.vocab}")
     run = _generate_program(
         module, int(max_new_tokens), float(temperature), top_k,
         None if top_p is None else float(top_p),
+        None if eos_id is None else int(eos_id),
     )
     return np.asarray(run(params, prompt, jax.random.PRNGKey(seed)))
 

@@ -88,6 +88,20 @@ class FencedEpochError(ProtocolError):
         self.server_epoch = server_epoch
 
 
+class ServerBusyError(ProtocolError):
+    """The serving tier's bounded admission queue is full — backpressure,
+    not failure. Retryable by design: the reconnecting client backs off
+    (jittered, via ``resilience.retry``) and resubmits; an open-loop load
+    source that ignores it is choosing to drop the request. ``retry_after``
+    is the server's hint (seconds) when it has one."""
+
+    def __init__(self, message: str = "server busy: admission queue full",
+                 *, retry_after: float | None = None,
+                 peer: str | None = None):
+        super().__init__(message, peer=peer, retryable=True)
+        self.retry_after = retry_after
+
+
 def _peer_of(sock: socket.socket) -> str | None:
     """Best-effort peer label for error context (never raises)."""
     try:

@@ -115,6 +115,14 @@ class GeneratorPredictor:
     :func:`models.beam_search` instead of sampling and keeps each row's
     best beam (``temperature``/``top_k``/``top_p`` must stay at their
     greedy defaults — beam search is deterministic).
+
+    ``eos_id`` stops rows at end-of-sequence on BOTH paths (sampling rows
+    pad with ``eos_id`` after the first hit — the static output shape
+    never changes); ``per_row_new_tokens=True`` adds a companion
+    ``{output_col}_new_tokens`` int32 column counting each row's real
+    tokens up to and including its eos, computed by the serving tier's
+    retire rule (:func:`distkeras_tpu.serving.per_row_new_token_counts`)
+    rather than a second local eos-scan that could drift from it.
     """
 
     def __init__(self, model, params, *, features_col: str = "features",
@@ -122,7 +130,8 @@ class GeneratorPredictor:
                  temperature: float = 0.0, top_k: int | None = None,
                  top_p: float | None = None,
                  seed: int = 0, batch_size: int = 64, beams: int = 1,
-                 length_penalty: float = 0.0, eos_id: int | None = None):
+                 length_penalty: float = 0.0, eos_id: int | None = None,
+                 per_row_new_tokens: bool = False):
         from distkeras_tpu.models.lm import TransformerLM
 
         module = model.module if isinstance(model, ModelSpec) else model
@@ -144,6 +153,7 @@ class GeneratorPredictor:
         self.beams = int(beams)
         self.length_penalty = float(length_penalty)
         self.eos_id = eos_id
+        self.per_row_new_tokens = bool(per_row_new_tokens)
         if self.beams < 1:
             raise ValueError(f"beams must be >= 1, got {beams}")
         if self.beams > 1 and (
@@ -153,14 +163,15 @@ class GeneratorPredictor:
                 "beam search is deterministic: temperature/top_k/top_p "
                 "cannot be combined with beams > 1"
             )
-        if self.beams == 1 and (eos_id is not None or self.length_penalty):
+        if self.beams == 1 and self.length_penalty:
             raise ValueError(
-                "eos_id/length_penalty are beam-search options: sampling "
-                "decode (beams=1) would silently ignore them — set beams > 1"
+                "length_penalty is a beam-search option: sampling decode "
+                "(beams=1) would silently ignore it — set beams > 1"
             )
 
     def predict(self, ds: Dataset) -> Dataset:
         from distkeras_tpu.models.lm import beam_search, generate
+        from distkeras_tpu.serving import per_row_new_token_counts
 
         outs = []
         for i, ((chunk,), real) in enumerate(padded_chunks(
@@ -177,10 +188,16 @@ class GeneratorPredictor:
                 full = generate(
                     self.model, self.params, chunk, self.max_new_tokens,
                     temperature=self.temperature, top_k=self.top_k,
-                    top_p=self.top_p,
+                    top_p=self.top_p, eos_id=self.eos_id,
                     # distinct stream per chunk — identical prompts in
                     # different chunks must not draw identical samples
                     seed=self.seed + i,
                 )
             outs.append(full[:real, chunk.shape[1]:])
-        return ds.with_column(self.output_col, np.concatenate(outs))
+        out = ds.with_column(self.output_col, np.concatenate(outs))
+        if self.per_row_new_tokens:
+            out = out.with_column(
+                f"{self.output_col}_new_tokens",
+                per_row_new_token_counts(out[self.output_col], self.eos_id),
+            )
+        return out

@@ -1,0 +1,57 @@
+"""Serving tier: continuous-batching generation over a block-paged KV cache.
+
+The decode stack (KV cache, GQA/MQA, sliding-window, beam, speculative,
+int8 — SCALING.md) served one request at a time through
+``GeneratorPredictor``; this package is the millions-of-users front end on
+top of it:
+
+- :mod:`~distkeras_tpu.serving.paged_cache` — the block pool
+  (:class:`BlockAllocator`, :class:`PagedKVCache`): sequences of different
+  lengths share ONE preallocated static-shape cache through per-sequence
+  block tables (PagedAttention, Kwon et al. SOSP '23); the table-indexed
+  addressing lives in ``models/lm.py :: DecoderBlock.paged_extend`` and is
+  bit-identical to dense-cache decode.
+- :mod:`~distkeras_tpu.serving.scheduler` — :class:`GenerationEngine`,
+  iteration-level continuous batching (Orca, Yu et al. OSDI '22): FIFO
+  admission into free slots/blocks, mixed prefill+decode across in-flight
+  requests, per-row sampling params, per-step retirement, optional greedy
+  speculative decoding with per-row advancement.
+- :mod:`~distkeras_tpu.serving.server` — :class:`GenerationServer` /
+  :class:`GenerationClient` / :class:`ResilientGenerationClient` on the
+  hardened ``networking.py`` framing, with bounded-queue backpressure
+  (``ServerBusyError``), mid-stream death detection that frees the dead
+  client's blocks, and graceful drain.
+
+Benchmark: ``bench.py --serve`` (Poisson open-loop load, throughput vs
+p50/p99, vs the sequential ``GeneratorPredictor`` baseline).
+"""
+
+from distkeras_tpu.serving.paged_cache import (  # noqa: F401
+    BlockAllocator,
+    BlockPoolExhausted,
+    PagedKVCache,
+    slot_map,
+)
+from distkeras_tpu.serving.scheduler import (  # noqa: F401
+    GenerationEngine,
+    Request,
+    per_row_new_token_counts,
+)
+from distkeras_tpu.serving.server import (  # noqa: F401
+    GenerationClient,
+    GenerationServer,
+    ResilientGenerationClient,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "BlockPoolExhausted",
+    "PagedKVCache",
+    "slot_map",
+    "GenerationEngine",
+    "Request",
+    "per_row_new_token_counts",
+    "GenerationClient",
+    "GenerationServer",
+    "ResilientGenerationClient",
+]

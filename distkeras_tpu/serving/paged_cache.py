@@ -1,0 +1,175 @@
+"""Block-paged KV cache: one preallocated static-shape pool, many sequences.
+
+The decode stack's dense cache is ``[B, maxlen, Hkv, Dh]`` per layer — every
+sequence pays ``maxlen`` slots no matter its length, and a batch of
+concurrent requests of different lengths cannot share one compiled program
+without all paying the longest row's memory. PagedAttention's answer (Kwon
+et al., SOSP '23) is virtual memory for the KV cache: carve the pool into
+fixed-size **blocks** ``[num_blocks, block_size, Hkv, Dh]``, give every
+sequence a **block table** mapping its logical positions to pool blocks, and
+let the attention step gather through the table. Sequences then consume
+``ceil(len/block_size)`` blocks instead of ``maxlen`` slots, concurrent
+requests of any length mix share ONE compiled step, and admission becomes a
+host-side allocator decision rather than a recompile.
+
+This module is the host side: :class:`BlockAllocator` (free-list with leak
+accounting — the scheduler property tests pin "no block survives its
+request") and :class:`PagedKVCache` (the per-layer device pools, stored
+FLAT as ``[num_blocks·block_size, Hkv, Dh]`` so the model-side gather in
+``models/lm.py :: DecoderBlock.paged_extend`` is one ``pool[slots]``
+index). Block 0 is reserved as the scratch block: free batch rows and
+unallocated table entries point at it, so inactive rows write garbage
+nobody reads instead of needing a masked scatter.
+
+The device side — table-indexed addressing generalizing the ring cache's
+``slot = pos % cache_len`` to ``slot = table[pos // bs] · bs + pos % bs``
+— lives with the model (``paged_extend`` / ``paged_decode_step`` /
+``prefill_raw``), sharing the attention body with dense decode so paged
+serving is bit-identical to :func:`~distkeras_tpu.models.lm.generate`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BlockPoolExhausted(RuntimeError):
+    """The allocator has fewer free blocks than the request needs. Internal
+    to the scheduler: admission simply waits (requests queue) until
+    retirements free blocks — it is never a client-visible failure."""
+
+
+class BlockAllocator:
+    """Host-side free-list over the block pool. Block 0 is the reserved
+    scratch block (never handed out); capacity is ``num_blocks - 1``.
+
+    Deterministic: blocks are handed out lowest-id-first and returned to
+    the free list in sorted order, so a seeded request mix allocates
+    identically run-to-run (the scheduler property tests rely on it)."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is scratch), "
+                f"got {num_blocks}"
+            )
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free = list(range(num_blocks - 1, 0, -1))  # pop() → block 1
+        self._allocated: set[int] = set()
+        self.high_water = 0
+
+    @property
+    def capacity(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._allocated)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise BlockPoolExhausted(
+                f"need {n} blocks, {len(self._free)} free "
+                f"(capacity {self.capacity})"
+            )
+        blocks = [self._free.pop() for _ in range(n)]
+        self._allocated.update(blocks)
+        self.high_water = max(self.high_water, len(self._allocated))
+        return blocks
+
+    def free(self, blocks) -> None:
+        for b in blocks:
+            if b not in self._allocated:
+                raise ValueError(
+                    f"double-free or foreign block {b} (allocated: "
+                    f"{len(self._allocated)} blocks)"
+                )
+            self._allocated.discard(b)
+            self._free.append(b)
+        self._free.sort(reverse=True)  # keep pop() order deterministic
+
+
+class PagedKVCache:
+    """Per-layer flat slot pools for one :class:`TransformerLM`.
+
+    ``k_pools``/``v_pools`` are tuples (one per layer) of
+    ``[num_blocks · block_size, Hkv, Dh]`` arrays in the model dtype —
+    plain pytrees handed in and out of the jitted step with buffer
+    donation, so steady-state decode updates them in place."""
+
+    def __init__(self, module, num_blocks: int, block_size: int):
+        hkv = module.kv_heads if module.kv_heads is not None \
+            else module.heads
+        dh = module.dim // module.heads
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_slots = self.num_blocks * self.block_size
+        shape = (self.num_slots, hkv, dh)
+        self.k_pools = tuple(
+            jnp.zeros(shape, module.dtype) for _ in range(module.depth)
+        )
+        self.v_pools = tuple(
+            jnp.zeros(shape, module.dtype) for _ in range(module.depth)
+        )
+
+    @property
+    def nbytes(self) -> int:
+        per = self.k_pools[0].dtype.itemsize
+        return 2 * len(self.k_pools) * int(np.prod(self.k_pools[0].shape)) \
+            * per
+
+
+def slot_map(tables: np.ndarray, block_size: int) -> np.ndarray:
+    """Flatten block tables ``[B, nb]`` into per-position pool slots
+    ``[B, nb·bs]``: ``slots[b, t] = tables[b, t // bs] · bs + t % bs`` —
+    the table-indexed generalization of the ring cache's ``pos % window``
+    addressing, precomputed host-side once per step and shared by every
+    layer."""
+    bs = int(block_size)
+    nb = tables.shape[1]
+    return (np.repeat(tables, bs, axis=1) * bs
+            + np.tile(np.arange(bs, dtype=tables.dtype), nb))
+
+
+def sample_rows(logits, keys, temperature, top_k, top_p, greedy):
+    """Per-ROW sampling inside one batched step: every row carries its own
+    temperature / top-k / top-p / PRNG key, because a continuous batch
+    mixes requests with different sampling params. Same filter semantics
+    as the batch-static :func:`models.lm._warp_fn` (temperature scale →
+    top-k → minimal nucleus, ties at the boundary survive), encoded
+    per-row: a row with ``top_k = vocab`` / ``top_p = 1.0`` is unfiltered.
+    ``greedy`` rows take ``argmax`` of the RAW logits — bit-identical to
+    greedy :func:`generate`, independent of the warp path entirely."""
+    V = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # greedy rows run the warp with temp 1 so their (discarded) sampled
+    # lane never sees inf/nan from a zero temperature
+    temp = jnp.where(greedy, 1.0, jnp.maximum(temperature, 1e-6))
+    scaled = logits / temp[:, None]
+    desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        desc, jnp.clip(top_k - 1, 0, V - 1)[:, None], axis=-1
+    )
+    scaled = jnp.where(scaled < kth, -1e30, scaled)
+    desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(desc, axis=-1)
+    keep = jnp.cumsum(probs, axis=-1) - probs < top_p[:, None]
+    cutoff = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    scaled = jnp.where(scaled < cutoff, -1e30, scaled)
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled) \
+        .astype(jnp.int32)
+    return jnp.where(greedy, greedy_tok, sampled)

@@ -1,0 +1,827 @@
+"""Iteration-level continuous batching over the block-paged KV cache.
+
+Orca's scheduling insight (Yu et al., OSDI '22): batch at the granularity
+of one decode ITERATION, not one request. A static batch drains before
+admitting anyone new, so a 512-token generation holds 31 finished slots
+hostage; iteration-level scheduling retires a row the step its request
+finishes and admits a queued request into the freed slot at the very next
+step — the batch composition changes every iteration, the compiled step
+never does (fixed ``max_batch`` rows; free rows write the scratch block
+and are ignored).
+
+:class:`GenerationEngine` is that scheduler plus the device programs:
+
+- **admit** — strictly FIFO (the head of the queue is never skipped, so
+  long prompts cannot starve behind a stream of short ones) whenever a
+  batch slot AND enough pool blocks for the request's full budget
+  (``ceil((Lp + max_new [+ spec])/block_size)``) are free. Reserving the
+  whole budget up front keeps the pool overcommit-free: an admitted
+  request can never die of block exhaustion mid-flight, so there is no
+  preemption machinery to get wrong.
+- **prefill** — one BATCHED forward per admission burst and padded-length
+  group (prompts padded to a block multiple, group row count bucketed to
+  powers of two: compile count is ``O(maxlen/block_size · log
+  max_batch)``), scattered into the rows' allocated blocks through
+  ``TransformerLM.prefill_raw``. Pad K/V beyond a real prompt is masked
+  until decode overwrites it; dummy bucket rows write the scratch block.
+- **decode** — ONE jitted fixed-shape step for all in-flight rows, each at
+  its own position with its own sampling params
+  (:func:`~distkeras_tpu.serving.paged_cache.sample_rows`), pools updated
+  in place via buffer donation.
+- **retire** — host-side per step: EOS, budget exhaustion, or client
+  cancellation frees the row's blocks immediately (a dead connection
+  releases its memory before its request would have finished).
+
+With a ``draft`` model the engine runs greedy speculative decoding INSIDE
+the continuous batch: each iteration the draft proposes ``spec_tokens``
+greedily through its own paged pools (same block tables — the allocator is
+shared), the target verifies all rows in one ``paged_extend_rows`` pass,
+and each row advances by its OWN accepted length — no batch-minimum
+lockstep, because per-row positions are native here (the dense
+``speculative_generate`` must advance uniformly; the paged batch never
+had that constraint).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import threading
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.model import ModelSpec
+from distkeras_tpu.networking import ServerBusyError
+from distkeras_tpu.serving.paged_cache import (
+    BlockAllocator,
+    PagedKVCache,
+    sample_rows,
+    slot_map,
+)
+
+_req_ids = itertools.count()
+
+
+def per_row_new_token_counts(new_tokens, eos_id: int | None):
+    """Real tokens per row of a ``[B, T]`` generated block: everything up to
+    and INCLUDING the first ``eos_id`` (or all ``T`` when none appears /
+    ``eos_id`` is None). This is the batch form of the serving tier's
+    per-step retire rule — ``GeneratorPredictor(per_row_new_tokens=True)``
+    and the tests share it instead of re-deriving eos semantics."""
+    new_tokens = np.asarray(new_tokens)
+    B, T = new_tokens.shape
+    if eos_id is None:
+        return np.full((B,), T, np.int32)
+    hit = new_tokens == int(eos_id)
+    first = np.argmax(hit, axis=1)
+    return np.where(hit.any(axis=1), first + 1, T).astype(np.int32)
+
+
+class Request:
+    """One generation request moving through the engine.
+
+    States: ``queued`` → ``running`` → ``done`` | ``cancelled`` |
+    ``failed``; ``rejected`` never enters the queue. ``result()`` blocks
+    on completion and returns the NEW tokens (prompt excluded) as int32."""
+
+    def __init__(self, prompt: np.ndarray, *, max_new_tokens: int,
+                 temperature: float, top_k: int | None,
+                 top_p: float | None, seed: int, eos_id: int | None,
+                 request_id: str | None = None):
+        self.id = request_id if request_id is not None \
+            else f"req-{next(_req_ids)}"
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.top_p = top_p
+        self.seed = int(seed)
+        self.eos_id = eos_id
+        self.new_tokens: list[int] = []
+        self.state = "queued"
+        self.error: str | None = None
+        self.t_submit = time.monotonic()
+        self.t_admit: float | None = None
+        self.t_done: float | None = None
+        self._cancelled = False
+        self._event = threading.Event()
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._event.wait(timeout)
+
+    def result(self, timeout: float | None = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"request {self.id} still {self.state}")
+        if self.state != "done":
+            raise RuntimeError(
+                f"request {self.id} {self.state}"
+                + (f": {self.error}" if self.error else "")
+            )
+        return np.asarray(self.new_tokens, np.int32)
+
+    @property
+    def latency_s(self) -> float | None:
+        if self.t_done is None:
+            return None
+        return self.t_done - self.t_submit
+
+
+class _Slot:
+    """Host bookkeeping for one occupied batch row."""
+
+    __slots__ = ("request", "blocks", "next_pos", "last_tok")
+
+    def __init__(self, request: Request, blocks: list[int]):
+        self.request = request
+        self.blocks = blocks
+        self.next_pos = 0   # absolute position of the token being FED
+        self.last_tok = 0
+
+
+class GenerationEngine:
+    """Continuous-batching generation over a block-paged KV cache.
+
+    ``model``/``params`` as accepted by :func:`models.lm.generate`
+    (``ModelSpec`` or bare ``TransformerLM`` — int8 specs from
+    ``quantize_lm`` drop in unchanged). ``draft``/``draft_params`` switch
+    on greedy speculative serving with ``spec_tokens`` proposals per
+    iteration. ``num_blocks`` defaults to enough for ``max_batch`` rows of
+    ``maxlen`` each (+ the scratch block) — shrink it to oversubscribe and
+    let admission apply backpressure through the bounded queue instead.
+    """
+
+    def __init__(self, model, params, *, max_batch: int = 8,
+                 block_size: int = 16, num_blocks: int | None = None,
+                 max_queue: int = 64, draft=None, draft_params=None,
+                 spec_tokens: int = 4):
+        from distkeras_tpu.models.lm import TransformerLM
+
+        module = model.module if isinstance(model, ModelSpec) else model
+        if not isinstance(module, TransformerLM):
+            raise TypeError(
+                f"GenerationEngine needs a TransformerLM (or its "
+                f"ModelSpec), got {type(module)}"
+            )
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if block_size < 1 or block_size > module.maxlen:
+            raise ValueError(
+                f"block_size must be in [1, maxlen={module.maxlen}], "
+                f"got {block_size}"
+            )
+        self._module = module
+        self._params = params
+        self.max_batch = int(max_batch)
+        self.block_size = int(block_size)
+        self.max_queue = int(max_queue)
+        self._nb_per_seq = math.ceil(module.maxlen / self.block_size)
+        self._L = self._nb_per_seq * self.block_size
+        if num_blocks is None:
+            num_blocks = self.max_batch * self._nb_per_seq + 1
+        self.allocator = BlockAllocator(num_blocks, self.block_size)
+        self.cache = PagedKVCache(module, num_blocks, self.block_size)
+
+        self._draft_module = None
+        self._draft_params = draft_params
+        self.spec_tokens = 0
+        if draft is not None:
+            dm = draft.module if isinstance(draft, ModelSpec) else draft
+            if not isinstance(dm, TransformerLM):
+                raise TypeError(
+                    f"draft must be a TransformerLM (or its ModelSpec), "
+                    f"got {type(dm)}"
+                )
+            if dm.vocab != module.vocab:
+                raise ValueError(
+                    f"draft vocab {dm.vocab} != target vocab {module.vocab}"
+                )
+            if int(spec_tokens) < 1:
+                raise ValueError(
+                    f"spec_tokens must be >= 1, got {spec_tokens}"
+                )
+            if module.attn_window is not None or dm.attn_window is not None:
+                raise ValueError(
+                    "speculative serving does not support sliding-window "
+                    "models (the verify span crosses the window band)"
+                )
+            self._draft_module = dm
+            self.spec_tokens = int(spec_tokens)
+            self.draft_cache = PagedKVCache(dm, num_blocks, self.block_size)
+
+        self._tables = np.zeros((self.max_batch, self._nb_per_seq),
+                                np.int32)
+        self._slots: list[_Slot | None] = [None] * self.max_batch
+        # per-step hot-loop caches, refreshed only when the batch
+        # composition changes (admission/retire), not every token: the
+        # flattened slot map and the per-row sampling-param arrays
+        self._batch_dirty = True
+        self._np_slots: np.ndarray | None = None
+        self._dev_tables_by_width: dict[int, object] = {}
+        self._dev_sampling = None
+        self._all_greedy = True
+        self._queue: deque[Request] = deque()
+        self._lock = threading.RLock()
+        self._wake = threading.Condition(self._lock)
+        self._closed = False
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        self.stats_ = {
+            "submitted": 0, "admitted": 0, "completed": 0,
+            "cancelled": 0, "rejected": 0, "failed": 0,
+            "steps": 0, "prefills": 0, "tokens_generated": 0,
+            "occupancy_sum": 0,
+            "spec_rounds": 0, "spec_proposed": 0, "spec_accepted": 0,
+        }
+
+        self._decode_fn, self._decode_fn_greedy = self._make_decode()
+        self._prefill_fns: dict[int, object] = {}
+        self._spec_fn = self._make_spec() if self._draft_module else None
+
+    # -- device programs -----------------------------------------------------
+
+    def _make_decode(self):
+        from distkeras_tpu.models.lm import TransformerLM
+
+        module, bs = self._module, self.block_size
+
+        def fn(params, k_pools, v_pools, tok, tables, write_slot, positions,
+               temp, top_k, top_p, greedy, seeds):
+            logits, k_pools, v_pools = module.apply(
+                {"params": params}, tok, k_pools, v_pools, tables,
+                write_slot, positions, bs,
+                method=TransformerLM.paged_decode_step,
+            )
+            # deterministic per (request seed, absolute position): a
+            # resubmitted request replays the same stream
+            keys = jax.vmap(
+                lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p)
+            )(seeds, positions + 1)
+            nxt = sample_rows(logits, keys, temp, top_k, top_p, greedy)
+            return nxt, k_pools, v_pools
+
+        # all-greedy fast path: serving batches are frequently pure-greedy
+        # and the per-row warp costs two [B, vocab] sorts per token
+        def fn_greedy(params, k_pools, v_pools, tok, tables, write_slot,
+                      positions):
+            logits, k_pools, v_pools = module.apply(
+                {"params": params}, tok, k_pools, v_pools, tables,
+                write_slot, positions, bs,
+                method=TransformerLM.paged_decode_step,
+            )
+            nxt = jnp.argmax(logits.astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            return nxt, k_pools, v_pools
+
+        return (jax.jit(fn, donate_argnums=(1, 2)),
+                jax.jit(fn_greedy, donate_argnums=(1, 2)))
+
+    def _make_prefill(self):
+        from distkeras_tpu.models.lm import TransformerLM
+
+        module, dm = self._module, self._draft_module
+
+        def fn(params, d_params, k_pools, v_pools, dk_pools, dv_pools,
+               prompts, row_slots, lp, temp, top_k, top_p, greedy, seeds):
+            logits, kvs = module.apply(
+                {"params": params}, prompts,
+                method=TransformerLM.prefill_raw,
+            )
+            k_pools = tuple(p.at[row_slots].set(k)
+                            for p, (k, _) in zip(k_pools, kvs))
+            v_pools = tuple(p.at[row_slots].set(v)
+                            for p, (_, v) in zip(v_pools, kvs))
+            if dm is not None:
+                _, dkvs = dm.apply(
+                    {"params": d_params}, prompts,
+                    method=TransformerLM.prefill_raw,
+                )
+                dk_pools = tuple(p.at[row_slots].set(k)
+                                 for p, (k, _) in zip(dk_pools, dkvs))
+                dv_pools = tuple(p.at[row_slots].set(v)
+                                 for p, (_, v) in zip(dv_pools, dkvs))
+            last = jnp.take_along_axis(
+                logits, (lp - 1)[:, None, None], axis=1
+            )[:, 0]                                          # [n, V]
+            keys = jax.vmap(
+                lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p)
+            )(seeds, lp)
+            tok = sample_rows(last, keys, temp, top_k, top_p, greedy)
+            return tok, k_pools, v_pools, dk_pools, dv_pools
+
+        return jax.jit(fn, donate_argnums=(2, 3, 4, 5))
+
+    def _make_spec(self):
+        from distkeras_tpu.models.lm import TransformerLM
+
+        module, dm, K = self._module, self._draft_module, self.spec_tokens
+        bs = self.block_size
+
+        def fn(params, d_params, k, v, dk, dv, tok, tables, positions,
+               write_slots):
+            def draft_step(carry, xs):
+                t, dkp, dvp = carry
+                i, ws = xs
+                lg, dkp, dvp = dm.apply(
+                    {"params": d_params}, t, dkp, dvp, tables, ws,
+                    positions + i, bs,
+                    method=TransformerLM.paged_decode_step,
+                )
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                return (nxt, dkp, dvp), nxt
+
+            # K+1 draft steps for K proposals: the extra step writes the
+            # LAST proposal's K/V (its logits are discarded). Without it a
+            # fully-accepted round leaves a permanent hole in the draft
+            # cache at position p+K (the target's verify writes p..p+K,
+            # the draft scan only p..p+K-1) — a zero K/V that rescales
+            # the draft's softmax forever after and quietly erodes
+            # acceptance. Exactness never depends on the draft, but
+            # acceptance is the throughput, so the hole is worth one
+            # draft step per round.
+            xs = (jnp.arange(K + 1), jnp.swapaxes(write_slots, 0, 1))
+            (_, dk, dv), outs = jax.lax.scan(draft_step, (tok, dk, dv), xs)
+            props = outs.T[:, :K]                            # [B, K]
+            block = jnp.concatenate([tok[:, None], props], axis=1)
+            t_logits, k, v = module.apply(
+                {"params": params}, block, k, v, tables, write_slots,
+                positions, bs, method=TransformerLM.paged_extend_rows,
+            )
+            g = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+            match = (props == g[:, :K]).astype(jnp.int32)
+            a_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+            return props, g, a_row, k, v, dk, dv
+
+        return jax.jit(fn, donate_argnums=(2, 3, 4, 5))
+
+    # -- client surface ------------------------------------------------------
+
+    def _blocks_needed(self, lp: int, max_new: int) -> int:
+        return math.ceil((lp + max_new + self.spec_tokens)
+                         / self.block_size)
+
+    def submit(self, prompt, *, max_new_tokens: int = 32,
+               temperature: float = 0.0, top_k: int | None = None,
+               top_p: float | None = None, seed: int = 0,
+               eos_id: int | None = None,
+               request_id: str | None = None) -> Request:
+        """Queue one generation; returns the :class:`Request` handle
+        immediately. Raises :class:`ServerBusyError` when the bounded
+        admission queue is full (backpressure) and ``ValueError`` on
+        malformed requests — both BEFORE the queue, so a rejected request
+        costs the engine nothing."""
+        module = self._module
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1:
+            raise ValueError(f"prompt must be 1-D [length], got "
+                             f"{prompt.shape}")
+        lp = prompt.shape[0]
+        if lp < 1:
+            raise ValueError("prompt must have at least one token")
+        if prompt.min() < 0 or prompt.max() >= module.vocab:
+            raise ValueError(
+                f"prompt tokens outside [0, vocab={module.vocab})"
+            )
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if lp + max_new + self.spec_tokens > module.maxlen:
+            raise ValueError(
+                f"prompt length {lp} + max_new_tokens {max_new}"
+                + (f" + spec_tokens {self.spec_tokens}"
+                   if self.spec_tokens else "")
+                + f" exceeds the model's maxlen {module.maxlen}"
+            )
+        if self._blocks_needed(lp, max_new) > self.allocator.capacity:
+            raise ValueError(
+                f"request needs {self._blocks_needed(lp, max_new)} blocks "
+                f"but the pool only has {self.allocator.capacity}"
+            )
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if top_k is not None and not 1 <= int(top_k) <= module.vocab:
+            raise ValueError(
+                f"top_k must be in [1, vocab={module.vocab}], got {top_k}"
+            )
+        if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if eos_id is not None and not 0 <= int(eos_id) < module.vocab:
+            raise ValueError(
+                f"eos_id {eos_id} outside vocab {module.vocab}"
+            )
+        if self.spec_tokens and (temperature != 0.0 or top_k is not None
+                                 or top_p is not None):
+            raise ValueError(
+                "speculative serving is greedy-only: temperature/top_k/"
+                "top_p cannot be combined with a draft model"
+            )
+        req = Request(
+            prompt, max_new_tokens=max_new, temperature=float(temperature),
+            top_k=top_k, top_p=top_p, seed=int(seed),
+            eos_id=None if eos_id is None else int(eos_id),
+            request_id=request_id,
+        )
+        with self._wake:
+            if self._closed:
+                raise ServerBusyError("engine is draining: not accepting "
+                                      "new requests")
+            if len(self._queue) >= self.max_queue:
+                self.stats_["rejected"] += 1
+                req.state = "rejected"
+                raise ServerBusyError(
+                    f"admission queue full ({self.max_queue} waiting)"
+                )
+            self.stats_["submitted"] += 1
+            self._queue.append(req)
+            self._wake.notify_all()
+        return req
+
+    def cancel(self, request: Request) -> None:
+        """Mark a request for cancellation; the engine frees its slot and
+        blocks at the next iteration (queued requests never start)."""
+        with self._wake:
+            request._cancelled = True
+            self._wake.notify_all()
+
+    # -- the scheduler loop --------------------------------------------------
+
+    def _finalize(self, req: Request, state: str,
+                  error: str | None = None) -> None:
+        req.state = state
+        req.error = error
+        req.t_done = time.monotonic()
+        key = {"done": "completed", "cancelled": "cancelled",
+               "failed": "failed"}[state]
+        self.stats_[key] += 1
+        if state == "done":
+            self.stats_["tokens_generated"] += len(req.new_tokens)
+        req._event.set()
+
+    def _retire(self, b: int, state: str, error: str | None = None) -> None:
+        with self._wake:  # RLock: safe from inside step()'s locked region
+            slot = self._slots[b]
+            self._slots[b] = None
+            self._tables[b, :] = 0
+            self._batch_dirty = True
+            self.allocator.free(slot.blocks)
+            self._finalize(slot.request, state, error)
+
+    def _admit(self) -> list[tuple[int, Request]]:
+        """FIFO admission under the lock; returns newly filled (row, req)
+        pairs whose prefill still has to run (device work happens outside
+        the lock — ``submit`` must never block behind a forward pass)."""
+        admitted = []
+        free_rows = [b for b, s in enumerate(self._slots) if s is None]
+        while self._queue and free_rows:
+            head = self._queue[0]
+            if head._cancelled:
+                self._queue.popleft()
+                self._finalize(head, "cancelled", "cancelled while queued")
+                continue
+            need = self._blocks_needed(head.prompt.shape[0],
+                                       head.max_new_tokens)
+            if not self.allocator.can_alloc(need):
+                break       # strict FIFO: never skip the head (starvation)
+            self._queue.popleft()
+            b = free_rows.pop(0)
+            blocks = self.allocator.alloc(need)
+            slot = _Slot(head, blocks)
+            self._slots[b] = slot
+            self._tables[b, :] = 0
+            self._tables[b, :need] = blocks
+            self._batch_dirty = True
+            head.state = "running"
+            head.t_admit = time.monotonic()
+            self.stats_["admitted"] += 1
+            admitted.append((b, head))
+        return admitted
+
+    def _run_prefills(self, admitted) -> None:
+        """Prefill an admission burst in as few forwards as possible: one
+        BATCHED ``prefill_raw`` per padded-length group (row count bucketed
+        to powers of two — dummy rows write the scratch block — so compile
+        count stays ``O(len buckets · log max_batch)``, not one program per
+        group size). A burst of admissions at saturation was serializing
+        ``n`` batch-1 forwards, each streaming the full weights; grouping
+        streams them once per length bucket."""
+        groups: dict[int, list] = {}
+        for b, req in admitted:
+            lp = req.prompt.shape[0]
+            lpad = math.ceil(lp / self.block_size) * self.block_size
+            groups.setdefault(lpad, []).append((b, req))
+        vocab = self._module.vocab
+        for lpad, grp in groups.items():
+            n = len(grp)
+            npad = 1 << (n - 1).bit_length()
+            prompts = np.zeros((npad, lpad), np.int32)
+            # dummy rows scatter into the scratch block (block 0) only:
+            # duplicate indices are fine, nobody reads those slots
+            row_slots = np.tile(
+                np.tile(np.arange(self.block_size, dtype=np.int32),
+                        lpad // self.block_size), (npad, 1))
+            lp_arr = np.ones((npad,), np.int32)
+            temp = np.zeros((npad,), np.float32)
+            top_k = np.full((npad,), vocab, np.int32)
+            top_p = np.ones((npad,), np.float32)
+            greedy = np.ones((npad,), bool)
+            seeds = np.zeros((npad,), np.int32)
+            for i, (b, req) in enumerate(grp):
+                lp = req.prompt.shape[0]
+                prompts[i, :lp] = req.prompt
+                row_slots[i] = slot_map(self._tables[b:b + 1],
+                                        self.block_size)[0, :lpad]
+                lp_arr[i] = lp
+                temp[i] = req.temperature
+                if req.top_k is not None:
+                    top_k[i] = req.top_k
+                if req.top_p is not None:
+                    top_p[i] = req.top_p
+                greedy[i] = req.greedy
+                seeds[i] = req.seed
+            key = (lpad, npad)
+            if key not in self._prefill_fns:
+                self._prefill_fns[key] = self._make_prefill()
+            c, dc = self.cache, getattr(self, "draft_cache", None)
+            tok, c.k_pools, c.v_pools, dk, dv = self._prefill_fns[key](
+                self._params, self._draft_params, c.k_pools, c.v_pools,
+                dc.k_pools if dc else (), dc.v_pools if dc else (),
+                jnp.asarray(prompts), jnp.asarray(row_slots),
+                jnp.asarray(lp_arr), jnp.asarray(temp),
+                jnp.asarray(top_k), jnp.asarray(top_p),
+                jnp.asarray(greedy), jnp.asarray(seeds),
+            )
+            if dc:
+                dc.k_pools, dc.v_pools = dk, dv
+            tok = np.asarray(jax.device_get(tok))
+            self.stats_["prefills"] += n
+            for i, (b, req) in enumerate(grp):
+                slot = self._slots[b]
+                slot.next_pos = req.prompt.shape[0]
+                slot.last_tok = int(tok[i])
+                self._emit(b, [slot.last_tok])
+
+    def _emit(self, b: int, tokens: list[int]) -> None:
+        """Append emitted tokens to row ``b``'s request, applying the
+        retire rule (budget, then first EOS — the rule
+        :func:`per_row_new_token_counts` mirrors batch-wide)."""
+        slot = self._slots[b]
+        req = slot.request
+        done = False
+        for t in tokens:
+            req.new_tokens.append(int(t))
+            if req.eos_id is not None and int(t) == req.eos_id:
+                done = True
+                break
+            if len(req.new_tokens) >= req.max_new_tokens:
+                done = True
+                break
+        if done:
+            self._retire(b, "done")
+
+    def step(self) -> bool:
+        """One scheduler iteration: retire cancellations, admit + prefill,
+        one batched decode (or speculative) step. Returns whether any work
+        was done — the loop thread sleeps on False."""
+        with self._wake:
+            for b, slot in enumerate(self._slots):
+                if slot is not None and slot.request._cancelled:
+                    self._retire(b, "cancelled", "cancelled by client")
+            admitted = self._admit()
+        if admitted:
+            self._run_prefills(admitted)
+        active = [b for b, s in enumerate(self._slots) if s is not None]
+        if not active:
+            return bool(admitted)
+        if self._spec_fn is not None:
+            self._spec_step(active)
+        else:
+            self._decode_step(active)
+        with self._wake:
+            self.stats_["steps"] += 1
+            self.stats_["occupancy_sum"] += len(active)
+        return True
+
+    def _refresh_batch_cache(self):
+        """Rebuild the per-batch device arrays — ONLY when the batch
+        composition changed (admission/retire), never per token: the slot
+        map and sampling params are constants of a batch lineup, and
+        rebuilding + re-uploading them each step was measurable per-step
+        overhead on the 1-core bench host."""
+        if not self._batch_dirty:
+            return
+        B = self.max_batch
+        self._np_slots = slot_map(self._tables, self.block_size)
+        self._dev_tables_by_width = {}
+        temp = np.zeros((B,), np.float32)
+        top_k = np.full((B,), self._module.vocab, np.int32)
+        top_p = np.ones((B,), np.float32)
+        greedy = np.ones((B,), bool)
+        seeds = np.zeros((B,), np.int32)
+        for b, s in enumerate(self._slots):
+            if s is None:
+                continue
+            r = s.request
+            temp[b] = r.temperature
+            if r.top_k is not None:
+                top_k[b] = r.top_k
+            if r.top_p is not None:
+                top_p[b] = r.top_p
+            greedy[b] = r.greedy
+            seeds[b] = r.seed
+        self._all_greedy = bool(greedy.all())
+        self._dev_sampling = tuple(
+            jnp.asarray(a) for a in (temp, top_k, top_p, greedy, seeds)
+        )
+        self._batch_dirty = False
+
+    def _tables_for(self, need_pos: int):
+        """Device block tables truncated to the working width: the paged
+        gather (and the attention scores behind it) only needs to cover
+        positions ``< need_pos``, so the step attends over the longest
+        ACTIVE sequence, not ``maxlen`` — a real advantage over the dense
+        scan, whose ``[B, maxlen]`` cache pays full width every step.
+        Width is bucketed to 2-block multiples so XLA compiles a handful
+        of step shapes, not one per length."""
+        nb = min(self._nb_per_seq,
+                 2 * math.ceil(math.ceil(need_pos / self.block_size) / 2))
+        if nb not in self._dev_tables_by_width:
+            self._dev_tables_by_width[nb] = jnp.asarray(
+                self._tables[:, :nb]
+            )
+        return self._dev_tables_by_width[nb]
+
+    def _tok_positions(self, active):
+        B = self.max_batch
+        tok = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        for b in active:
+            s = self._slots[b]
+            tok[b] = s.last_tok
+            positions[b] = s.next_pos
+        return tok, positions
+
+    def _decode_step(self, active) -> None:
+        self._refresh_batch_cache()
+        tok, positions = self._tok_positions(active)
+        write_slot = self._np_slots[np.arange(self.max_batch), positions]
+        dev_tables = self._tables_for(int(positions.max()) + 1)
+        c = self.cache
+        if self._all_greedy:
+            nxt, c.k_pools, c.v_pools = self._decode_fn_greedy(
+                self._params, c.k_pools, c.v_pools, jnp.asarray(tok),
+                dev_tables, jnp.asarray(write_slot),
+                jnp.asarray(positions),
+            )
+        else:
+            nxt, c.k_pools, c.v_pools = self._decode_fn(
+                self._params, c.k_pools, c.v_pools, jnp.asarray(tok),
+                dev_tables, jnp.asarray(write_slot),
+                jnp.asarray(positions), *self._dev_sampling,
+            )
+        nxt = np.asarray(jax.device_get(nxt))
+        for b in active:
+            slot = self._slots[b]
+            slot.next_pos += 1
+            slot.last_tok = int(nxt[b])
+            self._emit(b, [slot.last_tok])
+
+    def _spec_step(self, active) -> None:
+        K = self.spec_tokens
+        self._refresh_batch_cache()
+        tok, positions = self._tok_positions(active)
+        slots = self._np_slots
+        idx = positions[:, None] + np.arange(K + 1)[None, :]
+        write_slots = np.take_along_axis(slots, idx, axis=1)
+        c, dc = self.cache, self.draft_cache
+        dev_tables = self._tables_for(int(positions.max()) + K + 1)
+        props, g, a_row, c.k_pools, c.v_pools, dc.k_pools, dc.v_pools = \
+            self._spec_fn(
+                self._params, self._draft_params, c.k_pools, c.v_pools,
+                dc.k_pools, dc.v_pools, jnp.asarray(tok),
+                dev_tables, jnp.asarray(positions),
+                jnp.asarray(write_slots),
+            )
+        props, g, a_row = jax.device_get((props, g, a_row))
+        with self._wake:
+            self.stats_["spec_rounds"] += 1
+            self.stats_["spec_proposed"] += K * len(active)
+        for b in active:
+            slot = self._slots[b]
+            a = int(a_row[b])
+            emitted = [int(x) for x in props[b, :a]] + [int(g[b, a])]
+            with self._wake:
+                self.stats_["spec_accepted"] += a
+            # per-row advancement: this row moves a+1 positions no matter
+            # what the rest of the batch accepted
+            slot.next_pos += a + 1
+            slot.last_tok = int(g[b, a])
+            self._emit(b, emitted)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _idle(self) -> bool:
+        return not self._queue and all(s is None for s in self._slots)
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> None:
+        """Synchronous drive (tests, parity oracles): step until every
+        queued and running request has retired."""
+        for _ in range(max_steps):
+            with self._lock:
+                if self._idle():
+                    return
+            self.step()
+        raise RuntimeError(f"no progress after {max_steps} steps")
+
+    def run(self) -> None:
+        while True:
+            with self._wake:
+                if self._stop:
+                    return
+                if self._idle():
+                    self._wake.wait(0.05)
+                    continue
+            try:
+                self.step()
+            except Exception as e:  # a poisoned step must not hang clients
+                with self._wake:
+                    # stop admitting: with the loop thread dead, anything
+                    # submitted later would queue forever — reject it as
+                    # busy (retryable) instead of hanging the client
+                    self._closed = True
+                    for b, slot in enumerate(self._slots):
+                        if slot is not None:
+                            self._retire(b, "failed", repr(e))
+                    while self._queue:
+                        self._finalize(self._queue.popleft(), "failed",
+                                       repr(e))
+                raise
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        """Stop accepting new requests (drain begins); in-flight and queued
+        requests keep running to completion."""
+        with self._wake:
+            self._closed = True
+            self._wake.notify_all()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait until every accepted request has retired."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._idle():
+                    return True
+            time.sleep(0.005)
+        return False
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        self.close()
+        if drain and self._thread is not None:
+            self.drain(timeout)
+        with self._wake:
+            self._stop = True
+            self._wake.notify_all()
+        if self._thread is not None:
+            # join BEFORE retiring leftovers: a step in flight reads
+            # _slots/_tables outside the lock, so yanking rows under it
+            # races into use-after-retire; the loop re-checks _stop each
+            # iteration, so the join is bounded by one step
+            self._thread.join(timeout=10)
+            self._thread = None
+        with self._wake:
+            # anything still queued/running dies visibly, not silently
+            for b, slot in enumerate(self._slots):
+                if slot is not None:
+                    self._retire(b, "cancelled", "engine stopped")
+            while self._queue:
+                self._finalize(self._queue.popleft(), "cancelled",
+                               "engine stopped")
+
+    def stats(self) -> dict:
+        with self._lock:
+            s = dict(self.stats_)
+            s["queued"] = len(self._queue)
+            s["active"] = sum(1 for x in self._slots if x is not None)
+            s["blocks_in_use"] = self.allocator.used_blocks
+            s["blocks_free"] = self.allocator.free_blocks
+            s["blocks_high_water"] = self.allocator.high_water
+            s["mean_batch_occupancy"] = (
+                round(s["occupancy_sum"] / s["steps"], 3)
+                if s["steps"] else 0.0
+            )
+            if self.spec_tokens:
+                s["spec_acceptance"] = (
+                    round(s["spec_accepted"] / s["spec_proposed"], 4)
+                    if s["spec_proposed"] else 0.0
+                )
+            return s

@@ -98,3 +98,29 @@ def test_peak_flops_by_device_kind():
         device_kind = "cpu"
 
     assert bench.peak_flops(Cpu()) is None
+
+
+def test_serving_bench_smoke():
+    """--serve plumbing: a tiny run produces the stdout-JSON record
+    contract the BENCH_* trajectory consumes — throughput, latency
+    percentiles, the sequential/static-batch reference points, and the
+    engine stats (full-size runs are manual / --full)."""
+    out = bench.run_serving_bench(
+        vocab=64, maxlen=32, dim=32, heads=2, depth=1, prompt_len=4,
+        max_new=4, max_batch=2, n_baseline=2, rates=(8.0,), seconds=0.3,
+        legs=("paged",),
+    )
+    assert set(out) == {"serve_paged"}
+    rec = out["serve_paged"]
+    for key in ("sequential_rps", "static_batch_rps", "host_ceiling_x",
+                "throughput_rps", "p50_ms", "p99_ms",
+                "speedup_vs_sequential", "bound_fraction",
+                "mean_batch_occupancy", "blocks_high_water",
+                "target_3x_met"):
+        assert key in rec, key
+    assert rec["sequential_rps"] > 0
+    assert rec["throughput_rps"] > 0
+    assert rec["p99_ms"] >= rec["p50_ms"]
+    assert rec["rates"] and all("offered_rps" in r for r in rec["rates"])
+    # every accepted request completed (none stranded by the drain)
+    assert rec["completed"] > 0

@@ -250,6 +250,80 @@ def test_generator_predictor_appends_column(lm):
         GeneratorPredictor(mlp(), params)
 
 
+def test_generate_eos_id_stops_rows_and_pads(lm):
+    """eos_id: each row matches the eos-free greedy stream up to and
+    including its first eos, then pads with eos_id — static output shape,
+    mask-and-carry done flags (the serving tier's retire rule)."""
+    spec, params = lm
+    rng = np.random.default_rng(9)
+    prompts = rng.integers(0, VOCAB, size=(3, 6)).astype(np.int32)
+    free = generate(spec, params, prompts, max_new_tokens=10)
+    # pick the token row 0 emits at step 3 as eos: row 0 must stop there
+    eos = int(free[0, 6 + 3])
+    out = generate(spec, params, prompts, max_new_tokens=10, eos_id=eos)
+    assert out.shape == free.shape
+    cuts = []
+    for b in range(3):
+        new = free[b, 6:]
+        hits = np.where(new == eos)[0]
+        cut = hits[0] + 1 if hits.size else 10
+        cuts.append(cut)
+        np.testing.assert_array_equal(out[b, :6 + cut], free[b, :6 + cut])
+        assert (out[b, 6 + cut:] == eos).all()
+    assert min(cuts) < 10, "eos token never fired — test is vacuous"
+
+    from distkeras_tpu.serving import per_row_new_token_counts
+
+    np.testing.assert_array_equal(
+        per_row_new_token_counts(out[:, 6:], eos), cuts
+    )
+
+    with pytest.raises(ValueError, match="eos_id"):
+        generate(spec, params, prompts, 4, eos_id=VOCAB)
+
+
+def test_generate_eos_id_sampled_path(lm):
+    """eos works with temperature/top_k sampling and stays deterministic
+    per seed (its own fold_in key schedule)."""
+    spec, params = lm
+    prompt = np.ones((2, 5), np.int32)
+    a = generate(spec, params, prompt, 12, temperature=0.9, top_k=12,
+                 seed=4, eos_id=3)
+    b = generate(spec, params, prompt, 12, temperature=0.9, top_k=12,
+                 seed=4, eos_id=3)
+    np.testing.assert_array_equal(a, b)
+    for row in a[:, 5:]:
+        hits = np.where(row == 3)[0]
+        if hits.size:
+            assert (row[hits[0]:] == 3).all()
+
+
+def test_generator_predictor_eos_and_per_row_counts(lm):
+    """Satellite: eos_id now rides the sampling path (beams=1) instead of
+    raising, and per_row_new_tokens adds the serving-tier count column."""
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.predictors import GeneratorPredictor
+    from distkeras_tpu.serving import per_row_new_token_counts
+
+    spec, params = lm
+    rng = np.random.default_rng(5)
+    prompts = rng.integers(0, VOCAB, size=(6, 6)).astype(np.int32)
+    free = generate(spec, params, prompts, max_new_tokens=8)
+    eos = int(free[0, 6])  # row 0's first new token → count 1 for row 0
+    p = GeneratorPredictor(spec, params, max_new_tokens=8, batch_size=4,
+                           eos_id=eos, per_row_new_tokens=True)
+    out = p.predict(Dataset({"features": prompts}))
+    assert out["generated"].shape == (6, 8)
+    np.testing.assert_array_equal(
+        out["generated_new_tokens"],
+        per_row_new_token_counts(out["generated"], eos),
+    )
+    assert out["generated_new_tokens"][0] == 1
+    # length_penalty stays beam-only
+    with pytest.raises(ValueError, match="length_penalty"):
+        GeneratorPredictor(spec, params, length_penalty=0.5)
+
+
 def test_generate_single_token_and_program_reuse(lm):
     """max_new_tokens=1 (zero-length scan) works, and repeated generate()
     calls with one decode config reuse one compiled program."""

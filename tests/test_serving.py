@@ -1,0 +1,416 @@
+"""Serving tier (distkeras_tpu/serving): block-paged KV cache, continuous
+batching, and the socket front end.
+
+The load-bearing oracle: block-paged decode through the engine must emit
+EXACTLY the tokens dense-cache :func:`generate` emits for the same prompt
+(greedy — bf16 and f32), no matter what batch the scheduler mixed the
+request into. Paged attention is an addressing change, never a different
+model.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu import networking
+from distkeras_tpu.models import generate, transformer_lm
+from distkeras_tpu.serving import (
+    BlockAllocator,
+    BlockPoolExhausted,
+    GenerationClient,
+    GenerationEngine,
+    GenerationServer,
+    ResilientGenerationClient,
+    per_row_new_token_counts,
+)
+
+VOCAB, MAXLEN, DIM, HEADS, DEPTH = 64, 64, 32, 4, 2
+
+
+@pytest.fixture(scope="module")
+def lm():
+    spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS,
+                          depth=DEPTH, dtype=jnp.float32,
+                          pos_embedding="rope", kv_heads=2)
+    params, _ = spec.init_np(0)
+    return spec, params
+
+
+@pytest.fixture(scope="module")
+def lm16():
+    spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=DIM, heads=HEADS,
+                          depth=DEPTH, dtype=jnp.bfloat16)
+    params, _ = spec.init_np(0)
+    return spec, params
+
+
+def _prompts(rng, lengths):
+    return [rng.integers(0, VOCAB, (lp,)).astype(np.int32)
+            for lp in lengths]
+
+
+# -- block allocator ----------------------------------------------------------
+
+
+def test_allocator_alloc_free_and_leak_accounting():
+    a = BlockAllocator(num_blocks=9, block_size=4)
+    assert a.capacity == 8            # block 0 is scratch
+    b1 = a.alloc(3)
+    b2 = a.alloc(5)
+    assert a.used_blocks == 8 and a.free_blocks == 0
+    assert 0 not in b1 + b2           # scratch never handed out
+    with pytest.raises(BlockPoolExhausted):
+        a.alloc(1)
+    a.free(b1)
+    assert a.used_blocks == 5 and a.high_water == 8
+    with pytest.raises(ValueError, match="double-free"):
+        a.free(b1)
+    a.free(b2)
+    assert a.used_blocks == 0
+    # deterministic: fresh allocator hands out lowest ids first, and a
+    # freed-then-realloc'd pool repeats the same order
+    a2 = BlockAllocator(num_blocks=9, block_size=4)
+    assert a2.alloc(3) == [1, 2, 3]
+    assert a.alloc(3) == [1, 2, 3]
+
+
+def test_per_row_new_token_counts():
+    toks = np.array([[3, 5, 5, 5], [1, 2, 3, 4], [5, 0, 0, 5]])
+    np.testing.assert_array_equal(
+        per_row_new_token_counts(toks, eos_id=5), [2, 4, 1]
+    )
+    np.testing.assert_array_equal(
+        per_row_new_token_counts(toks, eos_id=None), [4, 4, 4]
+    )
+
+
+# -- paged-cache vs dense-cache parity (the acceptance oracle) ----------------
+
+
+def _engine_parity(spec, params, lengths, max_new=12, **eng_kw):
+    rng = np.random.default_rng(7)
+    eng = GenerationEngine(spec, params, max_batch=4, block_size=8,
+                           **eng_kw)
+    reqs = [(p, eng.submit(p, max_new_tokens=max_new))
+            for p in _prompts(rng, lengths)]
+    eng.run_until_idle()
+    for p, r in reqs:
+        oracle = generate(spec, params, p[None], max_new)[0, len(p):]
+        np.testing.assert_array_equal(r.result(0), oracle)
+    s = eng.stats()
+    assert s["completed"] == len(lengths)
+    assert s["blocks_in_use"] == 0, "blocks leaked across retirements"
+    return s
+
+
+def test_paged_decode_matches_dense_oracle_f32(lm):
+    """Greedy engine output == dense generate() per request, bitwise, with
+    ragged prompt lengths (block-aligned and not) mixed in one batch —
+    rope+GQA exercise the per-row angle/table paths."""
+    spec, params = lm
+    s = _engine_parity(spec, params, [8, 13, 16, 5, 24, 9])
+    # continuous batching actually batched (not serialized admissions)
+    assert s["mean_batch_occupancy"] > 1.5
+
+
+def test_paged_decode_matches_dense_oracle_bf16(lm16):
+    """The acceptance-criteria dtype: block-paged decode bit-identical to
+    the dense-cache oracle in bf16, greedy."""
+    spec, params = lm16
+    _engine_parity(spec, params, [8, 16, 11, 24])
+
+
+def test_paged_sampling_deterministic_and_valid(lm):
+    spec, params = lm
+    rng = np.random.default_rng(3)
+    p = rng.integers(0, VOCAB, (9,)).astype(np.int32)
+    eng = GenerationEngine(spec, params, max_batch=2, block_size=8)
+    r1 = eng.submit(p, max_new_tokens=10, temperature=0.8, top_k=8, seed=5)
+    r2 = eng.submit(p, max_new_tokens=10, temperature=0.8, top_k=8, seed=5)
+    r3 = eng.submit(p, max_new_tokens=10, temperature=0.8, top_k=8, seed=6)
+    eng.run_until_idle()
+    t1, t2, t3 = r1.result(0), r2.result(0), r3.result(0)
+    np.testing.assert_array_equal(t1, t2)   # same seed → same stream,
+    assert not np.array_equal(t1, t3)       # whatever batch row it landed in
+    assert t1.min() >= 0 and t1.max() < VOCAB
+
+
+def test_engine_eos_retires_early(lm):
+    spec, params = lm
+    # find the greedy stream, then use one of its tokens as eos
+    p = np.arange(10, dtype=np.int32) % VOCAB
+    oracle = generate(spec, params, p[None], 12)[0, 10:]
+    eos = int(oracle[4])
+    eng = GenerationEngine(spec, params, max_batch=2, block_size=8)
+    r = eng.submit(p, max_new_tokens=12, eos_id=eos)
+    eng.run_until_idle()
+    toks = r.result(0)
+    assert toks[-1] == eos and len(toks) <= 12
+    np.testing.assert_array_equal(toks, oracle[:len(toks)])
+    assert eng.stats()["blocks_in_use"] == 0
+
+
+def test_engine_validates_requests(lm):
+    spec, params = lm
+    eng = GenerationEngine(spec, params, max_batch=2, block_size=8)
+    with pytest.raises(ValueError, match="1-D"):
+        eng.submit(np.ones((2, 3), np.int32))
+    with pytest.raises(ValueError, match="maxlen"):
+        eng.submit(np.ones(60, np.int32), max_new_tokens=16)
+    with pytest.raises(ValueError, match="top_k"):
+        eng.submit(np.ones(4, np.int32), top_k=0)
+    with pytest.raises(ValueError, match="eos_id"):
+        eng.submit(np.ones(4, np.int32), eos_id=VOCAB)
+    with pytest.raises(ValueError, match="vocab"):
+        eng.submit(np.full(4, VOCAB, np.int32))
+    with pytest.raises(TypeError, match="TransformerLM"):
+        GenerationEngine(object(), params)
+
+
+# -- scheduler properties -----------------------------------------------------
+
+
+def test_scheduler_seeded_mix_completes_without_starvation(lm):
+    """Property test: a seeded mix of short/long prompts against a small
+    slot+block budget — every admitted request completes, FIFO admission
+    starves nobody (completion covers ALL requests), and the block pool
+    is empty after the last retirement."""
+    spec, params = lm
+    rng = np.random.default_rng(11)
+    eng = GenerationEngine(spec, params, max_batch=3, block_size=8,
+                           num_blocks=3 * (MAXLEN // 8) + 1, max_queue=32)
+    lengths = [int(x) for x in rng.integers(4, 40, size=14)]
+    reqs = []
+    for i, lp in enumerate(lengths):
+        p = rng.integers(0, VOCAB, (lp,)).astype(np.int32)
+        # long generations mixed with short ones
+        reqs.append(eng.submit(p, max_new_tokens=4 + (i % 3) * 8))
+    eng.run_until_idle()
+    assert all(r.state == "done" for r in reqs), \
+        [(r.id, r.state) for r in reqs]
+    for r, lp in zip(reqs, lengths):
+        assert len(r.new_tokens) == r.max_new_tokens
+    s = eng.stats()
+    assert s["completed"] == len(reqs)
+    assert s["blocks_in_use"] == 0 and s["active"] == 0 and s["queued"] == 0
+    assert s["blocks_high_water"] <= eng.allocator.capacity
+
+
+def test_cancel_frees_blocks_midflight(lm):
+    spec, params = lm
+    eng = GenerationEngine(spec, params, max_batch=2, block_size=8)
+    r1 = eng.submit(np.ones(8, np.int32), max_new_tokens=30)
+    r2 = eng.submit(np.ones(8, np.int32), max_new_tokens=5)
+    for _ in range(3):
+        eng.step()
+    assert eng.stats()["blocks_in_use"] > 0
+    eng.cancel(r1)
+    eng.run_until_idle()
+    assert r1.state == "cancelled" and r2.state == "done"
+    with pytest.raises(RuntimeError, match="cancelled"):
+        r1.result(0)
+    assert eng.stats()["blocks_in_use"] == 0
+
+
+def test_speculative_engine_matches_generate_and_accepts_self_draft(lm):
+    """Greedy speculative serving: exact vs the dense oracle, per-row
+    advancement (no batch-min lockstep), and a self-draft accepts every
+    proposal — including across fully-accepted rounds (the draft-cache
+    hole one extra draft step per round exists to close)."""
+    spec, params = lm
+    s = _engine_parity(spec, params, [8, 11, 16], max_new=12,
+                       draft=spec, draft_params=params, spec_tokens=3)
+    assert s["spec_acceptance"] == 1.0
+    assert s["spec_rounds"] < 12        # fewer target passes than tokens
+    eng = GenerationEngine(spec, params, draft=spec, draft_params=params,
+                           spec_tokens=3, max_batch=2, block_size=8)
+    with pytest.raises(ValueError, match="greedy-only"):
+        eng.submit(np.ones(6, np.int32), temperature=0.5)
+
+
+# -- socket front end ---------------------------------------------------------
+
+
+def _start_server(spec, params, **eng_kw):
+    eng = GenerationEngine(spec, params, **eng_kw)
+    srv = GenerationServer(eng, poll_interval=0.02)
+    srv.start()
+    return srv
+
+
+def test_server_concurrent_clients_with_midstream_kill(lm):
+    """N concurrent client threads (mixed greedy/sampled) all complete
+    with greedy rows matching the dense oracle, while one client killed
+    mid-stream has its request cancelled and its blocks freed — a dead
+    connection cannot leak pool memory."""
+    spec, params = lm
+    srv = _start_server(spec, params, max_batch=4, block_size=8,
+                        max_queue=32)
+    results, errs = {}, []
+
+    def client(i):
+        try:
+            c = GenerationClient("127.0.0.1", srv.port)
+            p = np.random.default_rng(i).integers(
+                0, VOCAB, (6 + i,)).astype(np.int32)
+            kw = {} if i % 2 == 0 else {
+                "temperature": 0.7, "top_k": 8, "seed": i}
+            results[i] = (p, c.generate(p, max_new_tokens=8, **kw), kw)
+            c.close()
+        except Exception as e:   # surfaced below
+            errs.append((i, e))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    # the victim: submit a long generation, then slam the socket shut
+    k = networking.connect("127.0.0.1", srv.port)
+    networking.send_data(k, {"action": "generate",
+                             "prompt": np.ones(8, np.int32),
+                             "max_new_tokens": 40})
+    time.sleep(0.1)
+    k.close()
+    for t in threads:
+        t.join(30)
+    try:
+        assert not errs, errs
+        assert len(results) == 6
+        for i, (p, toks, kw) in results.items():
+            if not kw:
+                oracle = generate(spec, params, p[None], 8)[0, len(p):]
+                np.testing.assert_array_equal(toks, oracle)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            s = srv.stats()
+            if s["cancelled"] >= 1 and s["blocks_in_use"] == 0 \
+                    and s["active"] == 0:
+                break
+            time.sleep(0.02)
+        assert s["completed"] >= 6
+        assert s["cancelled"] >= 1 and s["dead_connections"] >= 1
+        assert s["blocks_in_use"] == 0, "dead client leaked blocks"
+    finally:
+        srv.stop()
+
+
+def test_server_backpressure_and_resilient_client(lm):
+    """A flooded bounded queue answers busy (typed, retryable); the
+    reconnecting client rides the backpressure out and completes."""
+    from distkeras_tpu.resilience import RetryPolicy
+
+    spec, params = lm
+    srv = _start_server(spec, params, max_batch=1, block_size=8,
+                        max_queue=1)
+    busy, done = [], []
+
+    def flood(i):
+        c = GenerationClient("127.0.0.1", srv.port)
+        try:
+            done.append(c.generate(np.ones(8, np.int32),
+                                   max_new_tokens=16))
+        except networking.ServerBusyError:
+            busy.append(i)
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=flood, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    try:
+        assert busy, "expected at least one busy rejection"
+        assert done, "expected at least one completion"
+        rc = ResilientGenerationClient(
+            lambda: GenerationClient("127.0.0.1", srv.port),
+            policy=RetryPolicy(max_attempts=100, base_delay=0.05,
+                               max_delay=0.5, deadline=60),
+        )
+        toks = rc.generate(np.ones(8, np.int32), max_new_tokens=4)
+        assert toks.shape == (4,)
+        rc.close()
+    finally:
+        srv.stop()
+
+
+def test_server_stats_and_bad_request(lm):
+    spec, params = lm
+    srv = _start_server(spec, params, max_batch=2, block_size=8)
+    try:
+        c = GenerationClient("127.0.0.1", srv.port)
+        with pytest.raises(networking.ProtocolError, match="bad_request"):
+            c.generate(np.ones(80, np.int32), max_new_tokens=8)
+        toks = c.generate(np.ones(6, np.int32), max_new_tokens=4)
+        assert toks.shape == (4,)
+        s = c.stats()
+        assert s["completed"] == 1 and s["connections"] >= 1
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_serve_smoke_16_concurrent(lm16):
+    """The CI serve-smoke contract: a tiny bf16 LM server under 16
+    concurrent clients — every request completes with the right shape and
+    the stats blob is JSON-serializable."""
+    import json
+
+    spec, params = lm16
+    srv = _start_server(spec, params, max_batch=4, block_size=8,
+                        max_queue=32)
+    results, errs = {}, []
+
+    def client(i):
+        try:
+            c = GenerationClient("127.0.0.1", srv.port)
+            p = np.random.default_rng(i).integers(
+                0, VOCAB, (4 + i % 7,)).astype(np.int32)
+            results[i] = c.generate(p, max_new_tokens=6, seed=i)
+            c.close()
+        except Exception as e:
+            errs.append((i, e))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    try:
+        assert not errs, errs
+        assert len(results) == 16
+        assert all(v.shape == (6,) for v in results.values())
+        blob = json.dumps(srv.stats())
+        parsed = json.loads(blob)
+        assert parsed["completed"] >= 16 and parsed["blocks_in_use"] == 0
+    finally:
+        srv.stop()
+
+
+def test_graceful_drain_completes_inflight(lm):
+    spec, params = lm
+    eng = GenerationEngine(spec, params, max_batch=2, block_size=8)
+    srv = GenerationServer(eng, poll_interval=0.02)
+    srv.start()
+    c = GenerationClient("127.0.0.1", srv.port)
+    out = {}
+
+    def go():
+        out["toks"] = c.generate(np.ones(8, np.int32), max_new_tokens=20)
+
+    t = threading.Thread(target=go)
+    t.start()
+    # wait until it is actually running, then drain
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and eng.stats()["active"] == 0:
+        time.sleep(0.01)
+    srv.stop(drain=True)
+    t.join(10)
+    assert out["toks"].shape == (20,)
+    with pytest.raises(networking.ServerBusyError):
+        eng.submit(np.ones(4, np.int32))
+    c.close()
