@@ -63,6 +63,18 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dkps_server_start.argtypes = [ctypes.c_void_p]
     lib.dkps_server_stop.restype = None
     lib.dkps_server_stop.argtypes = [ctypes.c_void_p]
+    lib.dkps_server_crash.restype = None
+    lib.dkps_server_crash.argtypes = [ctypes.c_void_p]
+    lib.dkps_server_wal_open.restype = ctypes.c_int
+    lib.dkps_server_wal_open.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_double,
+    ]
+    lib.dkps_server_set_ema.restype = ctypes.c_int
+    lib.dkps_server_set_ema.argtypes = [ctypes.c_void_p, f32p]
+    lib.dkps_server_restore_worker.restype = None
+    lib.dkps_server_restore_worker.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int64, ctypes.c_int64,
+    ]
     lib.dkps_server_destroy.restype = None
     lib.dkps_server_destroy.argtypes = [ctypes.c_void_p]
     lib.dkps_server_num_updates.restype = ctypes.c_uint64

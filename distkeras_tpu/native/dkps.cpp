@@ -80,6 +80,7 @@
 #include <cfloat>
 #include <chrono>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -88,6 +89,7 @@
 #include <vector>
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -106,6 +108,144 @@ constexpr uint64_t kPullBlock = 1024;
 
 inline uint64_t pull_blocks(uint64_t n) {
   return (n + kPullBlock - 1) / kPullBlock;
+}
+
+// ---------------------------------------------------------------- crc32 --
+// zlib-compatible CRC-32 (poly 0xEDB88320), slice-by-8: the payload hash
+// runs once per durable commit OFF the center mutex, so it only needs to
+// be fast enough not to dominate the handler thread (~1 B/cycle here).
+// Python's zlib.crc32 verifies these frames on replay — same polynomial,
+// same init/xorout, so the two sides agree bit-for-bit.
+struct Crc32Tables {
+  uint32_t t[8][256];
+  Crc32Tables() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int j = 1; j < 8; ++j)
+        t[j][i] = (t[j - 1][i] >> 8) ^ t[0][t[j - 1][i] & 0xFF];
+  }
+};
+const Crc32Tables kCrc;
+
+uint32_t crc32_buf(const void* data, size_t len, uint32_t seed = 0) {
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  uint32_t c = ~seed;
+  while (len >= 8) {
+    uint32_t lo, hi;
+    std::memcpy(&lo, p, 4);
+    std::memcpy(&hi, p + 4, 4);
+    c ^= lo;
+    c = kCrc.t[7][c & 0xFF] ^ kCrc.t[6][(c >> 8) & 0xFF] ^
+        kCrc.t[5][(c >> 16) & 0xFF] ^ kCrc.t[4][c >> 24] ^
+        kCrc.t[3][hi & 0xFF] ^ kCrc.t[2][(hi >> 8) & 0xFF] ^
+        kCrc.t[1][(hi >> 16) & 0xFF] ^ kCrc.t[0][hi >> 24];
+    p += 8;
+    len -= 8;
+  }
+  while (len--) c = kCrc.t[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+  return ~c;
+}
+
+// -------------------------------------------------------------- adler32 --
+// zlib-compatible Adler-32 for the O(model) WAL payload checksum (the
+// fixed-size prefixes keep CRC-32). On the 1-hash-pass-per-durable-commit
+// hot path the checksum IS the cost: slice-by-8 CRC runs ~1 B/cycle,
+// while the SSSE3 maddubs formulation below runs ~5 B/cycle — and
+// Python's zlib.adler32 verifies the same value on replay. Weaker mixing
+// than CRC is fine for the job here (detecting torn/partial tails).
+constexpr uint32_t kAdlerMod = 65521;
+constexpr size_t kAdlerNMax = 5552;  // max bytes before the deferred mod
+
+uint32_t adler32_scalar(const uint8_t* p, size_t len, uint32_t seed) {
+  uint32_t a = seed & 0xFFFF, b = seed >> 16;
+  while (len) {
+    size_t n = len < kAdlerNMax ? len : kAdlerNMax;
+    len -= n;
+    for (size_t i = 0; i < n; ++i) {
+      a += p[i];
+      b += a;
+    }
+    p += n;
+    a %= kAdlerMod;
+    b %= kAdlerMod;
+  }
+  return (b << 16) | a;
+}
+
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+__attribute__((target("ssse3"))) uint32_t adler32_ssse3(const uint8_t* p,
+                                                        size_t len,
+                                                        uint32_t seed) {
+  uint32_t a = seed & 0xFFFF, b = seed >> 16;
+  const __m128i zero = _mm_setzero_si128();
+  const __m128i weights =
+      _mm_setr_epi8(16, 15, 14, 13, 12, 11, 10, 9, 8, 7, 6, 5, 4, 3, 2, 1);
+  const __m128i ones16 = _mm_set1_epi16(1);
+  while (len >= 16) {
+    size_t blocks = len / 16;
+    if (blocks > kAdlerNMax / 16) blocks = kAdlerNMax / 16;
+    // accumulators stay < 2^32 for <= 347 blocks (worst case ~3.92e9)
+    __m128i vs2 = zero;   // weighted contributions to b
+    __m128i vsum = zero;  // plain byte sum so far in this run
+    const uint32_t a0 = a;
+    for (size_t i = 0; i < blocks; ++i) {
+      const __m128i chunk =
+          _mm_loadu_si128(reinterpret_cast<const __m128i*>(p));
+      p += 16;
+      vs2 = _mm_add_epi32(vs2, _mm_slli_epi32(vsum, 4));
+      const __m128i mad = _mm_maddubs_epi16(chunk, weights);
+      vs2 = _mm_add_epi32(vs2, _mm_madd_epi16(mad, ones16));
+      vsum = _mm_add_epi32(vsum, _mm_sad_epu8(chunk, zero));
+    }
+    alignas(16) uint32_t t[4];
+    _mm_store_si128(reinterpret_cast<__m128i*>(t), vsum);
+    const uint32_t sum = t[0] + t[2];  // sad lands in lanes 0 and 2
+    _mm_store_si128(reinterpret_cast<__m128i*>(t), vs2);
+    const uint32_t s2 = t[0] + t[1] + t[2] + t[3];
+    const uint32_t nbytes = static_cast<uint32_t>(blocks * 16);
+    b = (b + nbytes * a0 + s2) % kAdlerMod;
+    a = (a0 + sum) % kAdlerMod;
+    len -= blocks * 16;
+  }
+  return len ? adler32_scalar(p, len, (b << 16) | a) : (b << 16) | a;
+}
+#endif
+
+uint32_t adler32_buf(const void* data, size_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+#if defined(__x86_64__) || defined(__i386__)
+  static const bool ssse3 = __builtin_cpu_supports("ssse3");
+  if (ssse3) return adler32_ssse3(p, len, 1);
+#endif
+  return adler32_scalar(p, len, 1);
+}
+
+// WAL record types shared with resilience/wal.py (the flat, pickle-free
+// family — Python's iter_records/replay_record decode them natively)
+constexpr uint8_t REC_COMMIT_FLAT = 7;
+constexpr uint8_t REC_PULL_FLAT = 8;
+constexpr uint8_t REC_DEREG_FLAT = 9;
+constexpr uint8_t REC_EVICT_FLAT = 10;
+constexpr uint8_t REC_FENCE_FLAT = 11;
+// frame header matches wal._HDR (">BII": type, crc32, len — BIG-endian)
+constexpr size_t kWalHdr = 9;
+// flat-commit prefix matches wal._CMTF ("<IqQQfI", packed little-endian):
+// wid u32, seq i64 (-1 = none), pull_version u64, version u64,
+// fold-scale f32, adler32(payload) u32
+constexpr size_t kCmtPrefix = 36;
+
+void put_hdr(char* out, uint8_t type, uint32_t crc, uint32_t len) {
+  out[0] = static_cast<char>(type);
+  uint32_t be_crc = __builtin_bswap32(crc);
+  uint32_t be_len = __builtin_bswap32(len);
+  std::memcpy(out + 1, &be_crc, 4);
+  std::memcpy(out + 5, &be_len, 4);
 }
 
 bool send_all(int fd, const void* buf, size_t n) {
@@ -201,6 +341,333 @@ struct Server {
   uint64_t fence_epoch = 0;
   std::atomic<uint64_t> st_fenced{0};
 
+  // -- write-ahead log with GROUP COMMIT (ISSUE 7; same frame format as
+  // resilience/wal.py, so Python's recover_ps_state replays a native-
+  // written log bit-identically). Appends run under the center mutex —
+  // fold order IS log order — but only memcpy pre-encoded bytes into the
+  // in-memory `pending` buffer; the flusher thread batches a window of
+  // commits onto ONE write+fsync and wakes every waiter at once. Commit
+  // handlers defer their ACK until their record is durable (wal_wait),
+  // so ACK => fsync'd — the strongest durability this file has ever had,
+  // at ~1/window the sync cost. window 0 = time-bounded async (no ACK
+  // deferral; fsync at least every interval_s — the quiet-period bound).
+  struct WalRec {
+    char head[kWalHdr + kCmtPrefix];  // header + (for commits) prefix
+    uint32_t head_len = 0;
+    // commit payloads are logged ZERO-COPY in the deferred-ACK modes:
+    // `payload` points into the handler's scratch buffer, which stays
+    // alive because the handler blocks in wal_wait until this record is
+    // durable (and a crash clears the queue before waking it). Window 0
+    // (no wait) copies into `owned` instead.
+    const char* payload = nullptr;
+    size_t payload_len = 0;
+    std::vector<char> owned;
+  };
+  struct Wal {
+    int fd = -1;
+    uint64_t window = 8;
+    double interval_s = 0.25;
+    std::mutex wmu;  // guards the queue/counters; taken AFTER mu, never
+                     // the other way (the flusher takes wmu only)
+    std::mutex io_mu;  // serializes writers (flusher / close); appenders
+                       // never take it — the fold path can't block on I/O
+    std::condition_variable cv;
+    std::vector<WalRec> queue;
+    uint64_t appended = 0, durable = 0;
+    uint64_t commits_appended = 0, commits_durable = 0;
+    uint64_t queued_bytes = 0;
+    uint64_t waiters = 0;
+    bool running = false, abandoned = false;
+    std::chrono::steady_clock::time_point first_pending{};
+    bool has_pending = false;
+    std::thread flusher;
+    std::atomic<uint64_t> st_records{0}, st_fsyncs{0}, st_group_max{0};
+  };
+  Wal wal;
+  bool wal_on = false;  // set before start(), read-only afterwards
+
+  // queue one encoded record — call under mu (log order == fold order);
+  // takes wmu internally. O(1) in the payload when `copy` is false (the
+  // deferred-ACK modes): the queue holds a POINTER into the caller's
+  // buffer, pinned by the caller's wal_wait. Returns the wait token.
+  uint64_t wal_append_locked(const char* head, size_t head_len,
+                             const void* payload, size_t payload_len,
+                             bool commit, bool copy) {
+    std::lock_guard<std::mutex> g(wal.wmu);
+    wal.queue.emplace_back();
+    WalRec& r = wal.queue.back();
+    std::memcpy(r.head, head, head_len);
+    r.head_len = static_cast<uint32_t>(head_len);
+    if (payload_len) {
+      const char* pay = static_cast<const char*>(payload);
+      if (copy) {
+        r.owned.assign(pay, pay + payload_len);
+        r.payload = r.owned.data();
+      } else {
+        r.payload = pay;
+      }
+      r.payload_len = payload_len;
+    }
+    wal.appended += 1;
+    wal.queued_bytes += head_len + payload_len;
+    wal.st_records += 1;
+    if (commit) wal.commits_appended += 1;
+    if (!wal.has_pending) {
+      wal.has_pending = true;
+      wal.first_pending = std::chrono::steady_clock::now();
+    }
+    wal.cv.notify_all();
+    return wal.appended;
+  }
+
+  // `staged`: window-0 callers pre-copy the payload bytes OFF the center
+  // mutex (they never wal_wait, so the queue can't reference their
+  // receive buffer) and hand ownership here; window >= 1 callers pass
+  // nullptr and the queue references `payload` zero-copy — the handler
+  // blocks in wal_wait before reusing it. Either way the critical
+  // section stays O(1) in the payload size.
+  uint64_t wal_append_commit_locked(uint32_t wid, int64_t seq, uint64_t pv,
+                                    uint64_t version, float scale,
+                                    const float* payload, uint64_t count,
+                                    uint32_t payload_crc,
+                                    std::vector<char>* staged) {
+    char head[kWalHdr + kCmtPrefix];
+    char* prefix = head + kWalHdr;
+    std::memcpy(prefix + 0, &wid, 4);
+    std::memcpy(prefix + 4, &seq, 8);
+    std::memcpy(prefix + 12, &pv, 8);
+    std::memcpy(prefix + 20, &version, 8);
+    std::memcpy(prefix + 28, &scale, 4);
+    std::memcpy(prefix + 32, &payload_crc, 4);
+    put_hdr(head, REC_COMMIT_FLAT, crc32_buf(prefix, kCmtPrefix),
+            static_cast<uint32_t>(kCmtPrefix + count * 4));
+    if (staged != nullptr)
+      return wal_append_owned_locked(head, sizeof(head), staged,
+                                     /*commit=*/true);
+    return wal_append_locked(head, sizeof(head), payload, count * 4,
+                             /*commit=*/true, /*copy=*/false);
+  }
+
+  // take ownership of a pre-staged payload vector (O(1) move under mu)
+  uint64_t wal_append_owned_locked(const char* head, size_t head_len,
+                                   std::vector<char>* staged, bool commit) {
+    std::lock_guard<std::mutex> g(wal.wmu);
+    wal.queue.emplace_back();
+    WalRec& r = wal.queue.back();
+    std::memcpy(r.head, head, head_len);
+    r.head_len = static_cast<uint32_t>(head_len);
+    r.owned = std::move(*staged);
+    r.payload = r.owned.data();
+    r.payload_len = r.owned.size();
+    wal.appended += 1;
+    wal.queued_bytes += head_len + r.payload_len;
+    wal.st_records += 1;
+    if (commit) wal.commits_appended += 1;
+    if (!wal.has_pending) {
+      wal.has_pending = true;
+      wal.first_pending = std::chrono::steady_clock::now();
+    }
+    wal.cv.notify_all();
+    return wal.appended;
+  }
+
+  uint64_t wal_append_small_locked(uint8_t type, const char* body,
+                                   size_t len) {
+    // small control records (pull/dereg/evict/fence) are copied into the
+    // queue — their stack bodies die with this call. An evict body can
+    // exceed the fixed head buffer, so it rides the owned-payload slot.
+    char head[kWalHdr + kCmtPrefix];
+    put_hdr(head, type, crc32_buf(body, len), static_cast<uint32_t>(len));
+    return wal_append_locked(head, kWalHdr, body, len,
+                             /*commit=*/false, /*copy=*/true);
+  }
+
+  void wal_append_pull_locked(uint32_t wid, uint64_t version) {
+    char body[12];
+    std::memcpy(body + 0, &wid, 4);
+    std::memcpy(body + 4, &version, 8);
+    wal_append_small_locked(REC_PULL_FLAT, body, sizeof(body));
+  }
+
+  uint64_t wal_append_fence_locked(uint64_t epoch) {
+    char body[8];
+    std::memcpy(body, &epoch, 8);
+    return wal_append_small_locked(REC_FENCE_FLAT, body, sizeof(body));
+  }
+
+  void wal_append_dereg_locked(uint32_t wid) {
+    char body[4];
+    std::memcpy(body, &wid, 4);
+    wal_append_small_locked(REC_DEREG_FLAT, body, sizeof(body));
+  }
+
+  void wal_append_evict_locked(const std::vector<uint32_t>& wids) {
+    std::vector<char> body(4 + wids.size() * 4);
+    uint32_t count = static_cast<uint32_t>(wids.size());
+    std::memcpy(body.data(), &count, 4);
+    for (size_t i = 0; i < wids.size(); ++i)
+      std::memcpy(body.data() + 4 + i * 4, &wids[i], 4);
+    wal_append_small_locked(REC_EVICT_FLAT, body.data(), body.size());
+  }
+
+  // block until record `token` is fsync'd (the deferred ACK). False =
+  // the log was abandoned (crash seam) — the caller skips its ACK; the
+  // client never hears back and replays, the dedup table folds it once.
+  // A zero-copy record's payload buffer is pinned exactly as long as its
+  // appender sits here: the flusher's drain writes it BEFORE durability
+  // advances, and a crash clears the queue BEFORE `abandoned` wakes us.
+  bool wal_wait(uint64_t token) {
+    std::unique_lock<std::mutex> lk(wal.wmu);
+    wal.waiters += 1;
+    wal.cv.notify_all();  // the flusher syncs eagerly for waiters
+    while (wal.durable < token && !wal.abandoned)
+      wal.cv.wait_for(lk, std::chrono::milliseconds(100));
+    wal.waiters -= 1;
+    return wal.durable >= token;
+  }
+
+  // drain the queue → write → fsync → publish durability. Writers
+  // (flusher, wal_close) serialize on io_mu; appenders never take it.
+  bool wal_drain_and_sync() {
+    std::lock_guard<std::mutex> io(wal.io_mu);
+    std::vector<WalRec> batch;
+    uint64_t upto, upto_commits;
+    {
+      std::lock_guard<std::mutex> g(wal.wmu);
+      if (wal.abandoned || wal.fd < 0) return false;
+      batch.swap(wal.queue);
+      upto = wal.appended;
+      upto_commits = wal.commits_appended;
+      wal.queued_bytes = 0;
+      wal.has_pending = false;
+    }
+    bool ok = true;
+    for (const WalRec& r : batch) {
+      const char* parts[2] = {r.head, r.payload};
+      const size_t lens[2] = {r.head_len, r.payload_len};
+      for (int i = 0; i < 2 && ok; ++i) {
+        const char* p = parts[i];
+        size_t left = lens[i];
+        while (left) {
+          ssize_t k = ::write(wal.fd, p, left);
+          if (k < 0) {
+            if (errno == EINTR) continue;
+            ok = false;
+            break;
+          }
+          p += k;
+          left -= static_cast<size_t>(k);
+        }
+      }
+      if (!ok) break;
+    }
+    if (ok && ::fsync(wal.fd) != 0) ok = false;
+    {
+      std::lock_guard<std::mutex> g(wal.wmu);
+      if (ok) {
+        uint64_t group = upto_commits - wal.commits_durable;
+        uint64_t prev = wal.st_group_max.load();
+        if (group > prev) wal.st_group_max = group;
+        wal.durable = std::max(wal.durable, upto);
+        wal.commits_durable = std::max(wal.commits_durable, upto_commits);
+        wal.st_fsyncs += 1;
+      } else {
+        // a write/fsync that cannot succeed would strand waiters (and
+        // their pinned buffers) forever: abandon instead — clients see
+        // no ACK and replay against whatever IS durable
+        wal.abandoned = true;
+      }
+      wal.cv.notify_all();
+    }
+    return ok;
+  }
+
+  void wal_flush_loop() {
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> lk(wal.wmu);
+        for (;;) {
+          if (!wal.running) return;
+          if (!wal.queue.empty() && !wal.abandoned) {
+            const double age =
+                wal.has_pending
+                    ? std::chrono::duration<double>(
+                          std::chrono::steady_clock::now() -
+                          wal.first_pending)
+                          .count()
+                    : 0.0;
+            const uint64_t pending_commits =
+                wal.commits_appended - wal.commits_durable;
+            if (wal.waiters > 0 ||
+                (wal.window >= 1 && pending_commits >= wal.window) ||
+                wal.queued_bytes >= (64u << 20) || age >= wal.interval_s)
+              break;
+          }
+          wal.cv.wait_for(
+              lk, std::chrono::duration<double>(wal.interval_s));
+        }
+      }
+      wal_drain_and_sync();
+    }
+  }
+
+  // clean shutdown: drain + fsync + close (a CRASH uses wal_abandon).
+  // Handlers blocked in wal_wait were released by the still-running
+  // flusher before the server joined them — only no-waiter records
+  // (pulls, window-0 commits) can still sit in the queue here.
+  void wal_close() {
+    if (!wal_on) return;
+    bool was_abandoned;
+    {
+      std::lock_guard<std::mutex> g(wal.wmu);
+      was_abandoned = wal.abandoned;
+    }
+    if (!was_abandoned) wal_drain_and_sync();
+    {
+      std::lock_guard<std::mutex> g(wal.wmu);
+      wal.running = false;
+      wal.cv.notify_all();
+    }
+    if (wal.flusher.joinable()) wal.flusher.join();
+    std::lock_guard<std::mutex> io(wal.io_mu);
+    std::lock_guard<std::mutex> g(wal.wmu);
+    if (wal.fd >= 0) {
+      ::close(wal.fd);
+      wal.fd = -1;
+    }
+    wal.queue.clear();
+  }
+
+  // crash seam: lose the queued records (a SIGKILL'd process's user-space
+  // bytes) and wake every deferred-ACK waiter to give up. Order matters
+  // for the zero-copy payloads: (1) clear the queue and stop the flusher
+  // — waiters stay parked, so every buffer a swapped in-flight batch
+  // might still reference stays alive; (2) join the flusher; (3) only
+  // THEN set `abandoned`, waking waiters whose buffers nothing
+  // references anymore; (4) close the fd last, so no write ever lands on
+  // a recycled descriptor.
+  void wal_abandon() {
+    if (!wal_on) return;
+    {
+      std::lock_guard<std::mutex> g(wal.wmu);
+      wal.running = false;  // flusher exits; wal_wait does NOT check this
+      wal.queue.clear();
+      wal.cv.notify_all();
+    }
+    if (wal.flusher.joinable()) wal.flusher.join();
+    {
+      std::lock_guard<std::mutex> g(wal.wmu);
+      wal.abandoned = true;
+      wal.cv.notify_all();
+    }
+    std::lock_guard<std::mutex> io(wal.io_mu);
+    std::lock_guard<std::mutex> g(wal.wmu);
+    if (wal.fd >= 0) {
+      ::close(wal.fd);
+      wal.fd = -1;
+    }
+  }
+
   static uint64_t now_ns() {
     return static_cast<uint64_t>(
         std::chrono::duration_cast<std::chrono::nanoseconds>(
@@ -240,6 +707,7 @@ struct Server {
         // must not grow last_seq without bound
         last_seq.erase(wid);
       }
+      if (wal_on) wal_append_evict_locked(dead);
     }
   }
 
@@ -274,6 +742,7 @@ struct Server {
     // fence would only grow the map) — lease_mu released before mu
     std::lock_guard<std::mutex> g(mu);
     last_seq.erase(wid);
+    if (wal_on) wal_append_dereg_locked(wid);
   }
 
   // Contention/throughput counters (parity with the Python PS's stats():
@@ -331,6 +800,12 @@ struct Server {
     for (uint64_t i = 0; i < n; ++i) e[i] = d * e[i] + od * c[i];
   }
 
+  // conn_wid_'s recorded pull version (0 = never pulled) — call under mu
+  uint64_t pull_version_locked() {
+    auto it = pull_versions.find(conn_wid_);
+    return it != pull_versions.end() ? it->second : 0;
+  }
+
   // fold scale for one commit from conn_wid_'s staleness — call under mu
   float fold_scale_locked() {
     float s = static_cast<float>(fixed_scale);
@@ -352,6 +827,8 @@ struct Server {
     std::vector<uint64_t> lens;
     std::vector<float> scales;
     std::vector<float> pscales;  // compressed-pull per-block scales
+    std::vector<float> wbuf;     // durable int8 commits: dequantized
+                                 // payload staged off-lock for the WAL
     for (;;) {
       uint8_t action;
       if (!recv_all(fd, &action, 1)) break;
@@ -365,6 +842,7 @@ struct Server {
           // staleness bookkeeping, exactly the Python PS's pull():
           // tau at the next commit = center updates since this pull
           pull_versions[conn_wid_] = num_updates;
+          if (wal_on) wal_append_pull_locked(conn_wid_, num_updates);
           std::memcpy(buf.data(), center.data(), n * sizeof(float));
         }
         if (!send_all(fd, &version, 8)) break;
@@ -385,6 +863,7 @@ struct Server {
           StatGuard g(this);
           version = num_updates;
           pull_versions[conn_wid_] = num_updates;  // same staleness
+          if (wal_on) wal_append_pull_locked(conn_wid_, num_updates);
           pe = &pull_errors[conn_wid_];            // bookkeeping as PULL
           std::memcpy(buf.data(), center.data(), n * sizeof(float));
         }
@@ -449,6 +928,16 @@ struct Server {
       } else if (action == 2) {  // COMMIT
         if (!recv_all(fd, buf.data(), n * sizeof(float))) break;
         uint8_t ack = 1;
+        // the O(model) payload hash runs OFF the center mutex, in this
+        // worker's handler thread — the lock's section stays fold+append
+        const uint32_t pcrc =
+            wal_on ? adler32_buf(buf.data(), n * sizeof(float)) : 0;
+        std::vector<char> staged;  // window 0: payload copy, OFF the mutex
+        if (wal_on && wal.window == 0) {
+          const char* pb = reinterpret_cast<const char*>(buf.data());
+          staged.assign(pb, pb + n * sizeof(float));
+        }
+        uint64_t tok = 0;
         {
           StatGuard g(this);
           const float s = fold_scale_locked();
@@ -457,9 +946,14 @@ struct Server {
           for (uint64_t i = 0; i < n; ++i) c[i] += d[i] * s;
           ema_fold_locked();
           num_updates += 1;
+          if (wal_on)
+            tok = wal_append_commit_locked(
+                conn_wid_, -1, pull_version_locked(), num_updates, s,
+                d, n, pcrc, wal.window == 0 ? &staged : nullptr);
         }
         st_commits += 1;
         st_bytes_in += n * sizeof(float);
+        if (tok && wal.window >= 1 && !wal_wait(tok)) break;  // crashed
         if (!send_all(fd, &ack, 1)) break;
       } else if (action == 4) {  // COMMIT_INT8: per-segment scaled int8
         uint32_t segs;
@@ -487,29 +981,73 @@ struct Server {
         if (qbuf.size() != n) qbuf.resize(n);
         if (!recv_all(fd, qbuf.data(), n)) break;
         uint8_t ack = 1;
+        uint32_t pcrc = 0;
+        if (wal_on) {
+          // durable int8 commits dequantize OFF the mutex into wbuf and
+          // fold `c += s * wbuf` — two rounding steps instead of the
+          // no-WAL path's fused `(s*scale_seg)*q`, because the REPLAY
+          // must reproduce the fold from the logged dense payload with
+          // one multiply; logging q+scales would save bytes but force
+          // the replayer to re-implement this segment walk
+          if (wbuf.size() != n) wbuf.resize(n);
+          uint64_t off = 0;
+          for (uint32_t seg = 0; seg < segs; ++seg) {
+            const float sc = scales[seg];
+            const int8_t* d = qbuf.data() + off;
+            for (uint64_t i = 0; i < lens[seg]; ++i)
+              wbuf[off + i] = sc * static_cast<float>(d[i]);
+            off += lens[seg];
+          }
+          pcrc = adler32_buf(wbuf.data(), n * sizeof(float));
+        }
+        std::vector<char> staged;  // window 0: payload copy, OFF the mutex
+        if (wal_on && wal.window == 0) {
+          const char* pb = reinterpret_cast<const char*>(wbuf.data());
+          staged.assign(pb, pb + n * sizeof(float));
+        }
+        uint64_t tok = 0;
         {
           StatGuard g(this);
           const float s = fold_scale_locked();
           float* c = center.data();
-          uint64_t off = 0;
-          for (uint32_t seg = 0; seg < segs; ++seg) {
-            const float ss = s * scales[seg];
-            const int8_t* d = qbuf.data() + off;
-            for (uint64_t i = 0; i < lens[seg]; ++i)
-              c[off + i] += ss * static_cast<float>(d[i]);
-            off += lens[seg];
+          if (wal_on) {
+            const float* d = wbuf.data();
+            for (uint64_t i = 0; i < n; ++i) c[i] += d[i] * s;
+          } else {
+            uint64_t off = 0;
+            for (uint32_t seg = 0; seg < segs; ++seg) {
+              const float ss = s * scales[seg];
+              const int8_t* d = qbuf.data() + off;
+              for (uint64_t i = 0; i < lens[seg]; ++i)
+                c[off + i] += ss * static_cast<float>(d[i]);
+              off += lens[seg];
+            }
           }
           ema_fold_locked();
           num_updates += 1;
+          if (wal_on)
+            tok = wal_append_commit_locked(
+                conn_wid_, -1, pull_version_locked(), num_updates, s,
+                wbuf.data(), n, pcrc,
+                wal.window == 0 ? &staged : nullptr);
         }
         st_commits += 1;
         st_bytes_in += static_cast<uint64_t>(segs) * 12 + n;
+        if (tok && wal.window >= 1 && !wal_wait(tok)) break;
         if (!send_all(fd, &ack, 1)) break;
       } else if (action == 7) {  // COMMIT_SEQ: retry-safe seq'd commit
         uint64_t seq;
         if (!recv_all(fd, &seq, 8)) break;
         if (!recv_all(fd, buf.data(), n * sizeof(float))) break;
+        const uint32_t pcrc =
+            wal_on ? adler32_buf(buf.data(), n * sizeof(float)) : 0;
+        std::vector<char> staged;  // window 0: payload copy, OFF the mutex
+        if (wal_on && wal.window == 0) {
+          const char* pb = reinterpret_cast<const char*>(buf.data());
+          staged.assign(pb, pb + n * sizeof(float));
+        }
         bool dup;
+        uint64_t tok = 0;
         {
           StatGuard g(this);
           uint64_t& last = last_seq[conn_wid_];
@@ -522,6 +1060,11 @@ struct Server {
             for (uint64_t i = 0; i < n; ++i) c[i] += d[i] * s;
             ema_fold_locked();
             num_updates += 1;
+            if (wal_on)
+              tok = wal_append_commit_locked(
+                  conn_wid_, static_cast<int64_t>(seq),
+                  pull_version_locked(), num_updates, s, d, n, pcrc,
+                  wal.window == 0 ? &staged : nullptr);
           }
         }
         if (dup) {
@@ -530,6 +1073,7 @@ struct Server {
           st_commits += 1;
         }
         st_bytes_in += n * sizeof(float);
+        if (tok && wal.window >= 1 && !wal_wait(tok)) break;
         uint8_t ack = dup ? 2 : 1;
         if (!send_all(fd, &ack, 1)) break;
       } else if (action == 10) {  // COMMIT_SEQ_E: fenced + seq'd commit
@@ -537,8 +1081,16 @@ struct Server {
         if (!recv_all(fd, &epoch, 8)) break;
         if (!recv_all(fd, &seq, 8)) break;
         if (!recv_all(fd, buf.data(), n * sizeof(float))) break;
+        const uint32_t pcrc =
+            wal_on ? adler32_buf(buf.data(), n * sizeof(float)) : 0;
+        std::vector<char> staged;  // window 0: payload copy, OFF the mutex
+        if (wal_on && wal.window == 0) {
+          const char* pb = reinterpret_cast<const char*>(buf.data());
+          staged.assign(pb, pb + n * sizeof(float));
+        }
         bool dup = false, fenced = false;
         uint64_t server_epoch;
+        uint64_t tok = 0;
         {
           StatGuard g(this);
           server_epoch = fence_epoch;
@@ -554,6 +1106,11 @@ struct Server {
               for (uint64_t i = 0; i < n; ++i) c[i] += d[i] * s;
               ema_fold_locked();
               num_updates += 1;
+              if (wal_on)
+                tok = wal_append_commit_locked(
+                    conn_wid_, static_cast<int64_t>(seq),
+                    pull_version_locked(), num_updates, s, d, n, pcrc,
+                    wal.window == 0 ? &staged : nullptr);
             }
           }
         }
@@ -565,6 +1122,7 @@ struct Server {
           st_commits += 1;
         }
         st_bytes_in += n * sizeof(float);
+        if (tok && wal.window >= 1 && !wal_wait(tok)) break;
         uint8_t ack = fenced ? 3 : (dup ? 2 : 1);
         if (!send_all(fd, &ack, 1)) break;
         if (!send_all(fd, &server_epoch, 8)) break;
@@ -572,11 +1130,15 @@ struct Server {
         uint64_t epoch;
         if (!recv_all(fd, &epoch, 8)) break;
         uint64_t now_epoch;
+        uint64_t tok = 0;
         {
           StatGuard g(this);
           if (epoch > fence_epoch) fence_epoch = epoch;
           now_epoch = fence_epoch;
+          if (wal_on) tok = wal_append_fence_locked(now_epoch);
         }
+        // the fence ack implies durability (parity with the Python PS)
+        if (tok && !wal_wait(tok)) break;
         uint8_t ack = 1;
         if (!send_all(fd, &ack, 1)) break;
         if (!send_all(fd, &now_epoch, 8)) break;
@@ -730,7 +1292,10 @@ int dkps_server_start(void* h) {
 
 void dkps_server_stop(void* h) {
   auto* s = static_cast<Server*>(h);
-  if (!s->running.exchange(false)) return;
+  if (!s->running.exchange(false)) {
+    s->wal_close();  // idempotent; a crash() already abandoned it
+    return;
+  }
   ::shutdown(s->listen_fd, SHUT_RDWR);
   ::close(s->listen_fd);
   if (s->accept_thread.joinable()) s->accept_thread.join();
@@ -740,6 +1305,48 @@ void dkps_server_stop(void* h) {
   }
   for (auto& t : s->handlers)
     if (t.joinable()) t.join();
+  s->wal_close();  // clean stop: drain + fsync + close the log
+}
+
+// Crash seam (parity with SocketParameterServer._crash): die like a
+// SIGKILL'd process — tear the listener and every live connection, and
+// abandon the WAL losing its user-space pending buffer WITHOUT a flush
+// or fsync. Records an earlier group fsync made durable survive; the
+// torn group's commits were never ACKed, so their clients replay them
+// against the recovered server and the dedup table folds each once.
+void dkps_server_crash(void* h) {
+  auto* s = static_cast<Server*>(h);
+  if (s->running.exchange(false)) {
+    ::shutdown(s->listen_fd, SHUT_RDWR);
+    ::close(s->listen_fd);
+    std::lock_guard<std::mutex> g(s->conn_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  s->wal_abandon();
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  for (auto& t : s->handlers)
+    if (t.joinable()) t.join();
+}
+
+// Attach the write-ahead log: open `path` for appending and start the
+// group-commit flusher (`window` commits per fsync batch, 0 = async
+// time-bounded mode; `interval_s` bounds the durability window in
+// seconds either way). Call BEFORE dkps_server_start. Returns 0, or -1
+// when the file cannot be opened. The Python wrapper owns recovery,
+// snapshot publication, and torn-tail truncation — this side only
+// appends records to the live segment it is handed.
+int dkps_server_wal_open(void* h, const char* path, uint64_t window,
+                         double interval_s) {
+  auto* s = static_cast<Server*>(h);
+  int fd = ::open(path, O_WRONLY | O_APPEND | O_CREAT | O_CLOEXEC, 0644);
+  if (fd < 0) return -1;
+  s->wal.fd = fd;
+  s->wal.window = window;
+  s->wal.interval_s = interval_s > 0 ? interval_s : 0.25;
+  s->wal.running = true;
+  s->wal_on = true;
+  s->wal.flusher = std::thread([s] { s->wal_flush_loop(); });
+  return 0;
 }
 
 void dkps_server_destroy(void* h) {
@@ -791,10 +1398,11 @@ void dkps_server_record_pull(void* h, uint32_t wid) {
 }
 
 // Contention/throughput counters (parity with the Python PS's stats()).
-// Fills out[14]: pulls, compressed_pulls, commits, bytes_in, bytes_out,
+// Fills out[17]: pulls, compressed_pulls, commits, bytes_in, bytes_out,
 // center_lock_acquires, center_lock_wait_ns, center_lock_hold_ns,
 // dup_commits, active_workers, evicted_workers, heartbeats,
-// worker_retries, fenced_commits. Runs a FORCED expiry pass first (a stats read must see
+// worker_retries, fenced_commits, wal_records, wal_fsyncs,
+// wal_group_max. Runs a FORCED expiry pass first (a stats read must see
 // already-lapsed leases as evicted — no rate-limit window); the counter
 // reads stay lock-free atomics and may lag in-flight ops by one —
 // telemetry semantics, same as the Python side.
@@ -820,14 +1428,51 @@ void dkps_server_stats(void* h, uint64_t* out) {
     out[12] = retries;
   }
   out[13] = s->st_fenced.load();
+  out[14] = s->wal.st_records.load();
+  out[15] = s->wal.st_fsyncs.load();
+  out[16] = s->wal.st_group_max.load();
 }
 
-// fencing-epoch admin (parity with ParameterServer.fence / fence_epoch)
-uint64_t dkps_server_fence(void* h, uint64_t epoch) {
+// -- durable-state restore (crash recovery; the Python wrapper replays
+// the log with resilience/wal.py and installs the result here) ----------
+
+// EMA restore: 0 on success, -1 when the server was created without EMA.
+// Must run after dkps_server_set_center (which resets the EMA to the
+// center) and before serving traffic.
+int dkps_server_set_ema(void* h, const float* in) {
   auto* s = static_cast<Server*>(h);
   std::lock_guard<std::mutex> g(s->mu);
-  if (epoch > s->fence_epoch) s->fence_epoch = epoch;
-  return s->fence_epoch;
+  if (s->ema_decay < 0) return -1;
+  std::memcpy(s->ema.data(), in, s->n * sizeof(float));
+  return 0;
+}
+
+// Per-worker recovered state: last applied commit seqno (-1 = none) and
+// recorded pull version (-1 = none) — the dedup fence and the DynSGD
+// staleness base must survive a restart, or a replayed pre-crash commit
+// double-folds / gets mispriced.
+void dkps_server_restore_worker(void* h, uint32_t wid, int64_t last_seq,
+                                int64_t pull_version) {
+  auto* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (last_seq >= 0) s->last_seq[wid] = static_cast<uint64_t>(last_seq);
+  if (pull_version >= 0)
+    s->pull_versions[wid] = static_cast<uint64_t>(pull_version);
+}
+
+// fencing-epoch admin (parity with ParameterServer.fence / fence_epoch);
+// durable before returning when a WAL is attached, like the Python PS
+uint64_t dkps_server_fence(void* h, uint64_t epoch) {
+  auto* s = static_cast<Server*>(h);
+  uint64_t out, tok = 0;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    if (epoch > s->fence_epoch) s->fence_epoch = epoch;
+    out = s->fence_epoch;
+    if (s->wal_on && s->wal.running) tok = s->wal_append_fence_locked(out);
+  }
+  if (tok) s->wal_wait(tok);
+  return out;
 }
 
 uint64_t dkps_server_fence_epoch(void* h) {

@@ -28,6 +28,7 @@ and dtypes on the way out.
 from __future__ import annotations
 
 import ctypes
+import os
 import struct
 import time
 from typing import Any
@@ -127,22 +128,26 @@ class NativeSocketParameterServer:
                  ema_decay: float | None = None,
                  lease_timeout: float | None = None,
                  wal_dir: str | None = None, snapshot_every: int = 100,
-                 fence_epoch: int = 0):
-        if wal_dir is not None:
-            # graceful degrade (ISSUE 5): the C++ server has no WAL yet —
-            # a run asking for durability on the native transport gets a
-            # loud warning and an undurable (but otherwise identical)
-            # server, instead of a crash or a silent ignore. The fencing
-            # protocol (FENCE / COMMIT_SEQ_E) IS implemented natively.
-            import warnings
-
-            warnings.warn(
-                "ps_transport='native' has no write-ahead log yet: "
-                "ps_wal_dir is ignored and this PS will not survive a "
-                "crash — use ps_transport='socket' for durability",
-                stacklevel=2,
-            )
+                 fence_epoch: int = 0, wal_group_window: int = 8,
+                 wal_group_interval: float = 0.25):
+        # Durability (ISSUE 7 — the fastest transport is no longer the
+        # least durable): `wal_dir` attaches the C++ group-commit WAL.
+        # The C++ side appends flat CRC-framed records (same frame format
+        # as resilience/wal.py) and defers each commit's ACK until its
+        # group's fsync; THIS side owns recovery — it replays
+        # (snapshot, wal) through the same recover_ps_state path the
+        # Python PS uses (bit-identical: flat records carry the exact
+        # fold scale), restores the center/EMA/dedup/staleness state into
+        # the C++ server, publishes a fresh base snapshot, and hands the
+        # live segment to the native appender.
         self._requested_fence_epoch = int(fence_epoch)
+        self.wal_dir = None if wal_dir is None else str(wal_dir)
+        self.snapshot_every = int(snapshot_every)
+        self.wal_group_window = int(wal_group_window)
+        self.wal_group_interval = float(wal_group_interval)
+        self.recovered_ = False
+        self.wal_replay_s = 0.0
+        self.crashed_ = False
         self._lib = load_dkps(required=True)
         self.spec = FlatSpec(center)
         self.rule = rule
@@ -171,9 +176,15 @@ class NativeSocketParameterServer:
         self.lease_timeout = lease_timeout
 
     def initialize(self) -> None:
+        state = self._recover_wal_state()
         mode, scale = fold_mode(self.rule, self.num_workers)
+        init_vec = self._init_vec
+        if state is not None:
+            init_vec = np.ascontiguousarray(
+                self.spec.flatten(state["center"])
+            )
         h = self._lib.dkps_server_create(
-            _f32p(self._init_vec), self.spec.n, mode, scale,
+            _f32p(init_vec), self.spec.n, mode, scale,
             self.host.encode(), self._requested_port,
             -1.0 if self.ema_decay is None else self.ema_decay,
             -1.0 if self.lease_timeout is None else self.lease_timeout,
@@ -184,9 +195,109 @@ class NativeSocketParameterServer:
             )
         self._handle = h
         self.port = int(self._lib.dkps_server_port(h))
-        if self._requested_fence_epoch:
-            self._lib.dkps_server_fence(h, self._requested_fence_epoch)
+        fence = self._requested_fence_epoch
+        if state is not None:
+            self._restore_state(state)
+            fence = max(fence, int(state["fence_epoch"]))
+        if fence:
+            self._lib.dkps_server_fence(h, fence)
+        if self.wal_dir is not None:
+            self._attach_wal(state)
         self._t_start = time.monotonic()  # stats() rate denominator
+
+    # -- durability plumbing (recovery is Python's job, appending C++'s) -----
+
+    def _recover_wal_state(self) -> dict | None:
+        if self.wal_dir is None:
+            return None
+        from distkeras_tpu.resilience.wal import recover_ps_state
+
+        t0 = time.monotonic()
+        state = recover_ps_state(
+            self.wal_dir, self.rule, self.num_workers, self.ema_decay,
+            template=self.spec.unflatten(self._init_vec),
+        )
+        if state is not None:
+            self.recovered_ = True
+            self.wal_replay_s = time.monotonic() - t0
+        return state
+
+    def _restore_state(self, state: dict) -> None:
+        """Install the replayed durable state into the C++ server: update
+        count, per-worker dedup seqnos + pull versions (the exactly-once
+        fence and the DynSGD staleness base), and the EMA."""
+        self._lib.dkps_server_set_num_updates(
+            self._handle, int(state["num_updates"])
+        )
+        wids = set(state["pull_versions"]) | set(state["last_seq"])
+        for wid in wids:
+            self._lib.dkps_server_restore_worker(
+                self._handle, int(wid),
+                int(state["last_seq"].get(wid, -1)),
+                int(state["pull_versions"].get(wid, -1)),
+            )
+        if self.ema_decay is not None and state.get("ema") is not None:
+            ema_vec = np.ascontiguousarray(self.spec.flatten(state["ema"]))
+            self._lib.dkps_server_set_ema(self._handle, _f32p(ema_vec))
+
+    def _attach_wal(self, state: dict | None) -> None:
+        """Publish a fresh base snapshot at the (possibly recovered)
+        version — which also truncates pre-snapshot history — and hand
+        the live segment to the C++ appender. The snapshot is written by
+        the SAME CommitLog machinery the Python PS uses, so the on-disk
+        layout is transport-agnostic: a native log replays through
+        recover_ps_state, a recovered directory can even switch
+        transports between runs."""
+        from distkeras_tpu.resilience import wal as _wal
+
+        version = self.num_updates
+        if state is not None:
+            snap_state = dict(state)
+            snap_state.pop("replayed", None)
+        else:
+            center = self.spec.unflatten(self._init_vec)
+            snap_state = _wal.ps_state_dict(
+                center, 0, {}, {},
+                None, 0, self.fence_epoch,
+            )
+            if self.ema_decay is not None:
+                import jax
+
+                snap_state["ema"] = jax.tree.map(
+                    np.copy, snap_state["center"]
+                )
+                snap_state["ema_version"] = 0
+        snap_state["fence_epoch"] = max(
+            int(snap_state.get("fence_epoch", 0)), self.fence_epoch
+        )
+        log = _wal.CommitLog(self.wal_dir,
+                             snapshot_every=self.snapshot_every)
+        try:
+            # rotate-then-publish, the Python PS's snapshot discipline:
+            # open (and torn-tail-truncate) the live segment at the base
+            # version FIRST, so the publish's history truncation never
+            # strands un-snapshotted records
+            log.rotate(version)
+            log.publish_snapshot(snap_state)
+        finally:
+            log.close()
+        seg_path = os.path.join(
+            self.wal_dir, f"{_wal._SEG_PREFIX}{version:012d}{_wal._SEG_SUFFIX}"
+        )
+        rc = self._lib.dkps_server_wal_open(
+            self._handle, seg_path.encode(),
+            max(0, self.wal_group_window), self.wal_group_interval,
+        )
+        if rc != 0:
+            raise OSError(f"dkps could not open WAL segment {seg_path}")
+
+    def crash(self) -> None:
+        """Chaos seam (parity with SocketParameterServer._crash): die like
+        a SIGKILL'd process — connections torn, WAL abandoned losing its
+        un-flushed pending buffer, no final fsync."""
+        if self._handle is not None:
+            self._lib.dkps_server_crash(self._handle)
+        self.crashed_ = True
 
     def start(self) -> None:
         self._lib.dkps_server_start(self._handle)
@@ -243,10 +354,11 @@ class NativeSocketParameterServer:
         the time since ``initialize()``."""
         from distkeras_tpu.parameter_servers import build_ps_stats
 
-        raw = (ctypes.c_uint64 * 14)()
+        raw = (ctypes.c_uint64 * 17)()
         self._lib.dkps_server_stats(self._handle, raw)
         (pulls, cpulls, commits, bytes_in, bytes_out, acq, wait, hold,
-         dups, active, evicted, heartbeats, retries, fenced) = (
+         dups, active, evicted, heartbeats, retries, fenced,
+         wal_records, wal_fsyncs, wal_group_max) = (
             int(v) for v in raw)
         return build_ps_stats(
             pulls, cpulls, commits, bytes_in, bytes_out, acq, wait, hold,
@@ -254,6 +366,8 @@ class NativeSocketParameterServer:
             active_workers=active, evicted_workers=evicted,
             heartbeats=heartbeats, worker_retries=retries,
             fenced_commits=fenced, num_updates=self.num_updates,
+            wal_records=wal_records, wal_fsyncs=wal_fsyncs,
+            wal_group_max=wal_group_max,
         )
 
     # -- fencing (protocol parity with the Python PS) ------------------------

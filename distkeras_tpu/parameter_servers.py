@@ -121,7 +121,8 @@ class ParameterServer:
                  ema_decay: float | None = None,
                  lease_timeout: float | None = None,
                  wal_dir: str | None = None, snapshot_every: int = 100,
-                 fence_epoch: int = 0):
+                 fence_epoch: int = 0, wal_group_window: int = 8,
+                 wal_group_interval: float = 0.25):
         from distkeras_tpu.resilience.heartbeat import WorkerRegistry
 
         self.center = utils.tree_to_numpy(center)
@@ -212,9 +213,15 @@ class ParameterServer:
         # gets its ACK (append-before-ACK is what makes a torn-log commit
         # safely replayable: no ACK went out, the client retries, the
         # recovered dedup table folds it once). The O(model) payload
-        # pickle runs BEFORE the lock; only the buffered write rides the
-        # critical section. A standby send failure degrades: the replica
-        # is dropped (counted), never wedging the fold path for good.
+        # pickle AND its CRC run BEFORE the lock (REC_COMMIT2's split-CRC
+        # framing exists exactly so they can); only a buffered append of
+        # pre-encoded chunks rides the critical section. With group
+        # commit (wal_group_window > 1, the default) the ACK is deferred
+        # until the flusher thread lands a whole window of commits on ONE
+        # fsync — the replica stream keeps its pre-ACK ordering either
+        # way (records are sent under the lock, the ACK only moves
+        # later). A standby send failure degrades: the replica is dropped
+        # (counted), never wedging the fold path for good.
         self._wal = None
         self.recovered_ = False
         self.wal_replay_s = 0.0
@@ -233,7 +240,9 @@ class ParameterServer:
                 self._adopt_state(state)
                 self.recovered_ = True
                 self.wal_replay_s = time.monotonic() - t0
-            self._wal = CommitLog(wal_dir, snapshot_every=snapshot_every)
+            self._wal = CommitLog(wal_dir, snapshot_every=snapshot_every,
+                                  group_window=wal_group_window,
+                                  group_interval=wal_group_interval)
             self._wal.open_segment(self.num_updates)
         self._replica_sock = None   # hot-standby stream (attach_standby)
         self._n_standby_drops = 0
@@ -453,7 +462,8 @@ class ParameterServer:
                 nbytes)
 
     def commit(self, worker_id: int, payload: Pytree,
-               seq: int | None = None, epoch: int | None = None) -> bool:
+               seq: int | None = None, epoch: int | None = None,
+               wire_frame: bytes | None = None) -> bool:
         """Fold one worker's commit into the center under the center lock.
 
         Commits may arrive codec-compressed (``parallel.compression`` —
@@ -481,19 +491,38 @@ class ParameterServer:
         Returns True when the commit folded, False when it was a
         duplicate.
         """
+        import zlib as _zlib
+
+        from distkeras_tpu.resilience import wal as _wal
+
         nbytes = self._payload_nbytes(payload)  # wire size: BEFORE decode
         payload = maybe_decode(payload)
         rec_payload = None
+        rec_sum = 0
+        rec_type = _wal.REC_COMMIT2
         if self._wal is not None or self._replica_sock is not None:
             # durable sinks replay the EXACT fold input: coerce to numpy
             # once (workers already send numpy trees; this is a no-op
-            # pass) and pickle OUTSIDE the lock. The fold below uses the
-            # same coerced tree, so replay is bit-identical.
+            # pass), then encode AND checksum OUTSIDE the lock — the
+            # whole O(model) work happens here, in this worker's handler
+            # thread (the PR 3 per-worker discipline), so different
+            # workers' encodes overlap instead of serializing behind the
+            # center. The fold below uses the same coerced tree (and the
+            # wire-frame replay re-runs this same decode pipeline), so
+            # replay is bit-identical either way.
             payload = utils.tree_to_numpy(payload)
-            rec_payload = pickle.dumps(
-                payload, protocol=pickle.HIGHEST_PROTOCOL
-            )
+            if wire_frame is not None:
+                # socket path: the request frame's bytes are already in
+                # hand — log them verbatim, saving the re-pickle pass
+                rec_payload = wire_frame
+                rec_type = _wal.REC_COMMIT_WIRE
+            else:
+                rec_payload = pickle.dumps(
+                    payload, protocol=pickle.HIGHEST_PROTOCOL
+                )
+            rec_sum = _zlib.adler32(rec_payload)
         snap_state = None
+        wait_token = None
         with self._lock:
             fenced = epoch is not None and epoch != self.fence_epoch
             server_epoch = self.fence_epoch
@@ -522,20 +551,24 @@ class ParameterServer:
                     # under the lock, but only for the one commit that
                     # straddles the attach) so the stream never misses a
                     # fold the attach-time base state didn't include
-                    payload = utils.tree_to_numpy(payload)
-                    rec_payload = pickle.dumps(
-                        payload, protocol=pickle.HIGHEST_PROTOCOL
-                    )
+                    if wire_frame is not None:
+                        rec_payload = wire_frame
+                        rec_type = _wal.REC_COMMIT_WIRE
+                    else:
+                        payload = utils.tree_to_numpy(payload)
+                        rec_payload = pickle.dumps(
+                            payload, protocol=pickle.HIGHEST_PROTOCOL
+                        )
+                    rec_sum = _zlib.adler32(rec_payload)
                 if rec_payload is not None:
-                    from distkeras_tpu.resilience import wal as _wal
-
-                    rec = _wal.encode_record(
-                        _wal.REC_COMMIT,
-                        (int(worker_id),
-                         None if seq is None else int(seq),
-                         int(pull_version), int(version), rec_payload),
+                    # O(1) under the lock: frame the pre-encoded payload
+                    # (split-checksum commit — the header hashes only the
+                    # 32-byte prefix) and queue the chunk REFS (bytes are
+                    # immutable: no copy, no I/O, inside the lock)
+                    wait_token = self._log_commit_locked(
+                        worker_id, seq, pull_version, version,
+                        rec_payload, rec_sum, rec_type,
                     )
-                    self._log_locked(rec, commit=True)
                 if self._wal is not None and self._wal.should_snapshot():
                     # phase 1 under the lock: rotate the segment at this
                     # exact version and capture the center-side state;
@@ -558,8 +591,32 @@ class ParameterServer:
             self._count(dup_commits=1, bytes_in=nbytes)
             return False
         self._count(commits=1, bytes_in=nbytes)
+        hook = self.post_commit_hook
+        if hook is not None:
+            # chaos seam, deliberately BEFORE the durability wait: a
+            # kill-PS fault here crashes the server with this commit
+            # appended but its group not yet flushed — the torn-GROUP
+            # case the recovery tests pin (every unACKed commit in the
+            # lost window replays and folds exactly once)
+            hook(version)
         if self._wal is not None:
-            self._wal.maybe_fsync()  # periodic, off the critical section
+            if wait_token is not None and self._wal.group_mode:
+                # group commit: the ACK this return releases must imply
+                # fsync'd — block until the flusher lands our window. A
+                # failed wait (the log was abandoned by a crash/IO error,
+                # or timed out) means this commit is NOT durable: refuse
+                # to ACK it — the retryable error tears the caller's
+                # connection (the C++ handler breaks the same way), the
+                # client replays, and the dedup table on whatever server
+                # answers next folds it at most once.
+                if not self._wal.wait_durable(wait_token):
+                    raise networking.ProtocolError(
+                        "commit folded but its WAL group never became "
+                        "durable (log abandoned or fsync stalled) — "
+                        "no ACK; replay it", retryable=True,
+                    )
+            else:
+                self._wal.maybe_fsync()  # periodic, off the critical path
         if self._ema is not None:
             d = self.ema_decay
 
@@ -576,25 +633,54 @@ class ParameterServer:
                 if version > self._ema_version:
                     self._ema_version = version
                     _tree_map(fma, self._ema, snap, self._ema_scratch)
-        if snap_state is not None:
+        if snap_state is not None and self._wal._fh is not None:
             self._attach_ema_state(snap_state)
             self._wal.publish_snapshot(snap_state)
-        hook = self.post_commit_hook
-        if hook is not None:
-            hook(version)
         return True
 
-    def _log_locked(self, rec: bytes, commit: bool = False) -> None:
-        """Hand one framed record to every durable sink — call under the
-        center lock (durable order == fold order; append-before-ACK).
-        The WAL write is buffered; the replica send lands in the kernel
-        socket buffer (a primary crash still flushes it — semi-sync
+    def _log_commit_locked(self, worker_id: int, seq: int | None,
+                           pull_version: int, version: int,
+                           rec_payload: bytes, rec_sum: int,
+                           rec_type: int) -> int | None:
+        """Hand one commit record to every durable sink — call under the
+        center lock (durable order == fold order; record-before-ACK).
+        The payload bytes and their checksum were computed OFF the lock;
+        this frames and queues pre-encoded chunks without ever copying or
+        hashing the O(model) payload. Returns the WAL durability token
+        (None without a WAL)."""
+        from distkeras_tpu.resilience import wal as _wal
+
+        chunks = _wal.encode_commit_chunks(
+            worker_id, seq, pull_version, version, rec_payload, rec_sum,
+            rec_type=rec_type,
+        )
+        token = None
+        if self._wal is not None:
+            token = self._wal.append_chunks(chunks)
+            self._wal.commits_since_snapshot += 1
+        sock = self._replica_sock
+        if sock is not None:
+            try:
+                for chunk in chunks:
+                    sock.sendall(chunk)
+            except OSError:
+                self._replica_sock = None
+                self._n_standby_drops += 1
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        return token
+
+    def _log_locked(self, rec: bytes) -> None:
+        """Hand one framed NON-commit record to every durable sink — call
+        under the center lock (durable order == fold order). The WAL
+        write is buffered; the replica send lands in the kernel socket
+        buffer (a primary crash still flushes it — semi-sync
         replication). A replica send failure degrades to running without
         the standby instead of wedging the fold path."""
         if self._wal is not None:
             self._wal.append(rec)
-            if commit:
-                self._wal.commits_since_snapshot += 1
         sock = self._replica_sock
         if sock is not None:
             try:
@@ -802,6 +888,7 @@ class ParameterServer:
             bytes_in, bytes_out = self._bytes_in, self._bytes_out
             dups = self._n_dup_commits
         hb = self._registry.stats()
+        wal = self._wal
         return build_ps_stats(
             pulls, cpulls, commits, bytes_in, bytes_out,
             self._lock.acquires, self._lock.wait_ns, self._lock.hold_ns,
@@ -812,6 +899,9 @@ class ParameterServer:
             worker_retries=hb["worker_retries"],
             fenced_commits=self._n_fenced_commits,
             num_updates=self.num_updates,
+            wal_records=0 if wal is None else wal.wal_records,
+            wal_fsyncs=0 if wal is None else wal.wal_fsyncs,
+            wal_group_max=0 if wal is None else wal.wal_group_max,
         )
 
 
@@ -821,7 +911,9 @@ def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
                    elapsed_s: float, dup_commits: int = 0,
                    active_workers: int = 0, evicted_workers: int = 0,
                    heartbeats: int = 0, worker_retries: int = 0,
-                   fenced_commits: int = 0, num_updates: int = 0) -> dict:
+                   fenced_commits: int = 0, num_updates: int = 0,
+                   wal_records: int = 0, wal_fsyncs: int = 0,
+                   wal_group_max: int = 0) -> dict:
     """The ONE stats-dict builder both PS transports share (Python counters
     here, C++ atomics via ``native_ps.NativeSocketParameterServer.stats``):
     key set and derived-value math are pinned by construction, so the
@@ -854,6 +946,13 @@ def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
         # the durable state — THE counter for the cross-failover
         # exactly-once oracle (num_updates == logical commits issued)
         "num_updates": num_updates,
+        # WAL observability (0 without a WAL): records appended, real
+        # fsync syscalls, and the largest commit window one fsync ever
+        # released — wal_records/wal_fsyncs is the amortization proof
+        # (group commit's whole point), wal_group_max the batching one
+        "wal_records": wal_records,
+        "wal_fsyncs": wal_fsyncs,
+        "wal_group_max": wal_group_max,
     }
 
 
@@ -894,11 +993,14 @@ class SocketParameterServer(ParameterServer):
                  ema_decay: float | None = None,
                  lease_timeout: float | None = None,
                  wal_dir: str | None = None, snapshot_every: int = 100,
-                 fence_epoch: int = 0):
+                 fence_epoch: int = 0, wal_group_window: int = 8,
+                 wal_group_interval: float = 0.25):
         super().__init__(center, rule, num_workers, ema_decay=ema_decay,
                          lease_timeout=lease_timeout, wal_dir=wal_dir,
                          snapshot_every=snapshot_every,
-                         fence_epoch=fence_epoch)
+                         fence_epoch=fence_epoch,
+                         wal_group_window=wal_group_window,
+                         wal_group_interval=wal_group_interval)
         self.host = host
         self.port = int(port)
         self._server_sock: Any = None
@@ -953,7 +1055,10 @@ class SocketParameterServer(ParameterServer):
         # node types are rejected by the restricted unpickler by design.)
         try:
             while True:
-                msg = networking.recv_data(conn)
+                # raw frame kept alongside the decoded message: a durable
+                # commit logs its wire bytes verbatim (REC_COMMIT_WIRE)
+                # instead of re-pickling the tree
+                msg, raw = networking.recv_data_raw(conn)
                 action = msg.get("action")
                 if action == "pull":
                     self._serve_pull(conn, msg["worker_id"])
@@ -968,6 +1073,7 @@ class SocketParameterServer(ParameterServer):
                         applied = self.commit(
                             msg["worker_id"], msg["payload"],
                             seq=msg.get("seq"), epoch=msg.get("epoch"),
+                            wire_frame=raw,
                         )
                     except networking.FencedEpochError as fe:
                         # fencing is a protocol-level verdict, not a dead
@@ -1114,14 +1220,13 @@ class SocketParameterServer(ParameterServer):
                 c.close()
             except OSError:
                 pass
-        # drop the WAL handle without fsync: a real kill never syncs (the
-        # per-append flushes already handed every record to the OS)
-        if self._wal is not None and self._wal._fh is not None:
-            fh, self._wal._fh = self._wal._fh, None
-            try:
-                fh.close()
-            except OSError:
-                pass
+        # abandon the WAL without flush or fsync: a real kill loses the
+        # user-space buffer and never syncs — whatever earlier flushes
+        # (mode 1) or group fsyncs already made durable survives, and
+        # every deferred-ACK waiter is woken to give up (their clients
+        # never saw an ACK, so they replay)
+        if self._wal is not None:
+            self._wal.abandon()
         sock = self._replica_sock
         self._replica_sock = None
         if sock is not None:
@@ -1154,10 +1259,14 @@ class StandbySocketParameterServer(SocketParameterServer):
                  host: str = "127.0.0.1", port: int = 0,
                  ema_decay: float | None = None,
                  lease_timeout: float | None = None,
-                 wal_dir: str | None = None, snapshot_every: int = 100):
+                 wal_dir: str | None = None, snapshot_every: int = 100,
+                 wal_group_window: int = 8,
+                 wal_group_interval: float = 0.25):
         super().__init__(center, rule, num_workers, host=host, port=port,
                          ema_decay=ema_decay, lease_timeout=lease_timeout,
-                         wal_dir=wal_dir, snapshot_every=snapshot_every)
+                         wal_dir=wal_dir, snapshot_every=snapshot_every,
+                         wal_group_window=wal_group_window,
+                         wal_group_interval=wal_group_interval)
         self.is_standby = True
         self._repl_lock = threading.Lock()
         self._repl_state: dict | None = None

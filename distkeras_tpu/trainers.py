@@ -552,6 +552,8 @@ class DistributedTrainer(Trainer):
                  lease_timeout: float | None = None,
                  fault_plan=None,
                  ps_wal_dir=None, ps_snapshot_every: int = 100,
+                 ps_wal_group_window: int = 8,
+                 ps_wal_group_interval: float = 0.25,
                  ps_standby: bool = False,
                  ps_failover_timeout: float | None = None,
                  prefetch: int = 1, ema_decay: float | None = None,
@@ -744,10 +746,18 @@ class DistributedTrainer(Trainer):
         # - ps_wal_dir: write-ahead commit log + periodic fsync'd center
         #   snapshots — a crashed PS restarts in place from (snapshot,
         #   wal) with center/EMA/staleness/dedup state reconstructed
-        #   bit-identically. On ps_transport='native' the WAL degrades
-        #   gracefully (warns, runs without durability).
+        #   bit-identically, on every transport (the native C++ server
+        #   writes the same CRC frame format; recover_ps_state replays
+        #   either side's log).
         # - ps_snapshot_every: commits between snapshots (log truncation
         #   cadence).
+        # - ps_wal_group_window: group commit — defer each commit's ACK
+        #   and land up to this many on ONE fsync (ACK => fsync'd, at
+        #   ~1/window the sync cost; the default). 1 = the PR 5 behavior
+        #   (flush per record, periodic fsync, immediate ACK); 0 =
+        #   time-bounded async (immediate ACK, fsync on the interval).
+        # - ps_wal_group_interval: seconds bounding the durability window
+        #   in EVERY mode (a pull-heavy quiet period still gets fsync'd).
         # - ps_standby (socket transport): a warm replica streams every
         #   applied commit from the primary; the trainer-side
         #   PSFailoverSupervisor promotes it (with a fencing-epoch bump,
@@ -760,6 +770,19 @@ class DistributedTrainer(Trainer):
         if self.ps_snapshot_every <= 0:
             raise ValueError(
                 f"ps_snapshot_every must be positive, got {ps_snapshot_every}"
+            )
+        self.ps_wal_group_window = int(ps_wal_group_window)
+        if self.ps_wal_group_window < 0:
+            raise ValueError(
+                f"ps_wal_group_window must be >= 0 (0 = time-bounded "
+                f"async, 1 = per-record flush, N = group size), got "
+                f"{ps_wal_group_window}"
+            )
+        self.ps_wal_group_interval = float(ps_wal_group_interval)
+        if self.ps_wal_group_interval <= 0:
+            raise ValueError(
+                f"ps_wal_group_interval must be positive, got "
+                f"{ps_wal_group_interval}"
             )
         self.ps_standby = bool(ps_standby)
         if ps_failover_timeout is not None and ps_failover_timeout <= 0:

@@ -301,6 +301,14 @@ def run_async_training(trainer, ds, shuffle: bool):
     # on a lapsed lease, repointing the workers' endpoint resolver.
     ps_wal_dir = getattr(trainer, "ps_wal_dir", None)
     ps_snapshot_every = int(getattr(trainer, "ps_snapshot_every", 100))
+    # group commit (ISSUE 7): >1 batches a window of commits onto one
+    # fsync with the ACKs deferred until it lands (durable AND fast); 1 is
+    # the PR 5 flush-per-record behavior; 0 is time-bounded async. The
+    # interval bounds the durability window in seconds in every mode.
+    ps_wal_group_window = int(getattr(trainer, "ps_wal_group_window", 8))
+    ps_wal_group_interval = float(
+        getattr(trainer, "ps_wal_group_interval", 0.25)
+    )
     ps_standby = bool(getattr(trainer, "ps_standby", False))
     ps_failover_timeout = getattr(trainer, "ps_failover_timeout", None)
     if ps_failover_timeout is None:
@@ -387,7 +395,12 @@ def run_async_training(trainer, ds, shuffle: bool):
             params, rule, W, port=getattr(trainer, "ps_port", 0),
             ema_decay=getattr(trainer, "ema_decay", None),
             lease_timeout=lease_timeout,
-            wal_dir=ps_wal_dir,  # graceful degrade: warns, runs undurable
+            # full durability on the native transport too (ISSUE 7): the
+            # C++ group-commit WAL writes a log recover_ps_state replays
+            # bit-identically — a crashed native PS restarts in place
+            wal_dir=ps_wal_dir, snapshot_every=ps_snapshot_every,
+            wal_group_window=ps_wal_group_window,
+            wal_group_interval=ps_wal_group_interval,
         )
         ps.initialize()
         ps.start()
@@ -401,6 +414,8 @@ def run_async_training(trainer, ds, shuffle: bool):
             ema_decay=getattr(trainer, "ema_decay", None),
             lease_timeout=lease_timeout,
             wal_dir=ps_wal_dir, snapshot_every=ps_snapshot_every,
+            wal_group_window=ps_wal_group_window,
+            wal_group_interval=ps_wal_group_interval,
         )
         ps.initialize()
         ps.start()
@@ -429,6 +444,8 @@ def run_async_training(trainer, ds, shuffle: bool):
             params, rule, W, ema_decay=getattr(trainer, "ema_decay", None),
             lease_timeout=lease_timeout,
             wal_dir=ps_wal_dir, snapshot_every=ps_snapshot_every,
+            wal_group_window=ps_wal_group_window,
+            wal_group_interval=ps_wal_group_interval,
         )
 
         def make_client(i):
@@ -453,6 +470,8 @@ def run_async_training(trainer, ds, shuffle: bool):
                 wal_dir=(None if ps_wal_dir is None
                          else f"{ps_wal_dir}/standby"),
                 snapshot_every=ps_snapshot_every,
+                wal_group_window=ps_wal_group_window,
+                wal_group_interval=ps_wal_group_interval,
             )
             ps_standby_server.initialize()
             ps_standby_server.start()
@@ -474,6 +493,8 @@ def run_async_training(trainer, ds, shuffle: bool):
                     ema_decay=getattr(trainer, "ema_decay", None),
                     lease_timeout=lease_timeout,
                     wal_dir=ps_wal_dir, snapshot_every=ps_snapshot_every,
+                    wal_group_window=ps_wal_group_window,
+                    wal_group_interval=ps_wal_group_interval,
                 )
                 new.initialize()
                 new.start()

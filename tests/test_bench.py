@@ -62,6 +62,31 @@ def test_ps_microbench_smoke():
         assert rec["center_lock_mean_hold_ns"] >= 0
 
 
+def test_ps_group_commit_sweep_contract():
+    """--chaos-ps's flush-window sweep (ISSUE 7): every leg present with
+    positive rates, the exactly-once oracle asserted per leg, the
+    durable legs carrying the WAL amortization counters, and the
+    durable-vs-raw fraction computed against the no-WAL line."""
+    out = bench.run_ps_group_commit_sweep(n_params=16_384, workers=2,
+                                          seconds=0.25,
+                                          transports=("socket",))
+    rec = out["ps_group_commit_socket"]
+    assert set(rec["legs"]) == {"nowal", "w1", "w8", "w32", "time"}
+    assert rec["host_cores"] >= 1 and rec["wal_fs"]
+    for leg, r in rec["legs"].items():
+        assert r["rounds_per_sec"] > 0, leg
+        assert r["dedup_exact_once"], leg
+        assert "invalid" not in r, leg
+        if leg == "nowal":
+            assert r["wal_records"] == 0
+        else:
+            assert r["wal_records"] > 0
+            assert 0 < r["durable_fraction"]
+            if leg != "time":  # a short run may not cross the deadline
+                assert r["wal_fsyncs"] >= 1
+    assert rec["durable_fraction_w8"] == rec["legs"]["w8"]["durable_fraction"]
+
+
 def test_analytic_flop_models():
     # hand-checked reference points (training = 3× forward)
     assert bench.mlp_flops((784, 500, 300, 10)) == 3 * 2 * (

@@ -85,6 +85,36 @@ def test_wal_record_framing_and_torn_tail():
     assert list(walmod.iter_records(bytes(corrupt))) == recs[:1]
 
 
+def test_wal_split_checksum_commit_framing():
+    """REC_COMMIT2's split-checksum frame: header CRC covers only the
+    32-byte prefix, the payload rides its own adler32 — a torn or
+    corrupted payload kills the record, a clean one round-trips."""
+    import zlib
+
+    payload = b"p" * 1000
+    hdrpre, pay = walmod.encode_commit_chunks(
+        3, 9, 2, 7, payload, zlib.adler32(payload))
+    data = hdrpre + pay
+    recs = list(walmod.iter_records(data))
+    assert recs == [(walmod.REC_COMMIT2, (3, 9, 2, 7, payload))]
+    assert walmod.durable_prefix_len(data) == len(data)
+    # seq None encodes as -1 and decodes back to None
+    hdrpre2, pay2 = walmod.encode_commit_chunks(
+        1, None, 0, 1, payload, zlib.adler32(payload))
+    assert list(walmod.iter_records(hdrpre2 + pay2))[0][1][1] is None
+    # torn payload: the whole record (and everything after) is refused
+    torn = data[:-3] + walmod.encode_record(walmod.REC_PULL, (0, 0))
+    assert list(walmod.iter_records(torn)) == []
+    # corrupt payload byte: adler32 refuses it
+    corrupt = bytearray(data)
+    corrupt[walmod._HDR.size + walmod._CMT2.size + 5] ^= 0xFF
+    assert list(walmod.iter_records(bytes(corrupt))) == []
+    # corrupt prefix byte: header CRC refuses it
+    corrupt = bytearray(data)
+    corrupt[walmod._HDR.size + 2] ^= 0xFF
+    assert list(walmod.iter_records(bytes(corrupt))) == []
+
+
 def test_wal_reopen_truncates_torn_tail(tmp_path):
     log = walmod.CommitLog(str(tmp_path))
     log.open_segment(0)
@@ -124,10 +154,14 @@ def test_wal_snapshot_truncates_history(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_recovery_bit_identical_to_no_crash_oracle(tmp_path):
+@pytest.mark.parametrize("group_window", [1, 8])
+def test_recovery_bit_identical_to_no_crash_oracle(group_window, tmp_path):
     """DynSGD + EMA + interleaved pulls, then a crash: the recovered
     server must match a never-crashed server folding the same events —
-    bitwise, across center, EMA, staleness table, and dedup table."""
+    bitwise, across center, EMA, staleness table, and dedup table. Runs
+    in both durability modes (window 1 = PR 5 flush-per-record, window 8
+    = group commit with deferred ACKs): the replay oracle is unchanged
+    by group commit."""
     rng = np.random.default_rng(0)
 
     def events():
@@ -144,7 +178,8 @@ def test_recovery_bit_identical_to_no_crash_oracle(tmp_path):
     evs = events()
     oracle = ParameterServer(center4(), DynSGDMerge(), 3, ema_decay=0.97)
     walled = ParameterServer(center4(), DynSGDMerge(), 3, ema_decay=0.97,
-                             wal_dir=str(tmp_path), snapshot_every=7)
+                             wal_dir=str(tmp_path), snapshot_every=7,
+                             wal_group_window=group_window)
     for w, do_pull, payload, seq in evs:
         for ps in (oracle, walled):
             if do_pull:
@@ -153,8 +188,13 @@ def test_recovery_bit_identical_to_no_crash_oracle(tmp_path):
     oracle.deregister_worker(1)
     walled.deregister_worker(1)
 
-    # crash: abandon the object (per-append flushes are all that's left)
-    walled._wal._fh.close()
+    # the trailing dereg record has no commit behind it to ride: only the
+    # flusher's time deadline makes it durable — stand in for that
+    # deadline, then crash (commits needed no such help: their ACKs
+    # already implied fsync in group mode, OS-flush in mode 1)
+    walled._wal.sync()
+    # crash: abandon the log (whatever reached the OS is all that's left)
+    walled._wal.abandon()
     recovered = ParameterServer(center4(), DynSGDMerge(), 3, ema_decay=0.97,
                                 wal_dir=str(tmp_path), snapshot_every=7)
     assert recovered.recovered_
@@ -178,7 +218,7 @@ def test_recovery_dedups_replay_of_pre_crash_commit(tmp_path):
     ps = ParameterServer(center4(), DownpourMerge(), 1,
                          wal_dir=str(tmp_path))
     ps.commit(0, delta4(1.0), seq=7)
-    ps._wal._fh.close()  # crash after fold+append, "before" the ACK
+    ps._wal.abandon()  # crash after fold+append, "before" the ACK
     ps2 = ParameterServer(center4(), DownpourMerge(), 1,
                           wal_dir=str(tmp_path))
     assert ps2.commit(0, delta4(1.0), seq=7) is False   # replay refused
@@ -193,7 +233,7 @@ def test_recovery_survives_torn_last_record(tmp_path):
                          wal_dir=str(tmp_path))
     for k in range(3):
         ps.commit(0, delta4(1.0), seq=k + 1)
-    ps._wal._fh.close()
+    ps._wal.abandon()
     seg = next(p for p in os.listdir(tmp_path) if p.startswith("wal-"))
     path = os.path.join(str(tmp_path), seg)
     size = os.path.getsize(path)
@@ -239,6 +279,195 @@ def test_socket_ps_restart_in_place(tmp_path):
         c2.close()
     finally:
         ps2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Group commit (ISSUE 7): deferred ACKs, torn groups, the time deadline
+# ---------------------------------------------------------------------------
+
+
+def test_group_commit_acks_imply_fsync_and_batch(tmp_path):
+    """Concurrent committers in group mode: every ACKed commit is fsync'd
+    (stronger than PR 5's flush-only contract), whole windows ride single
+    fsyncs, and the recovered state equals the no-crash oracle."""
+    ps = ParameterServer(center4(), DownpourMerge(), 4,
+                         wal_dir=str(tmp_path), wal_group_window=8)
+    n_each = 6
+    errors = []
+
+    def committer(w):
+        try:
+            for k in range(n_each):
+                ps.pull(w)
+                assert ps.commit(w, delta4(1.0), seq=k + 1) is True
+        except Exception as e:  # surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=committer, args=(w,))
+               for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    s = ps.stats()
+    assert s["wal_records"] == 2 * 4 * n_each  # a pull + a commit each
+    assert s["wal_fsyncs"] >= 1
+    assert s["wal_group_max"] >= 1
+    before = ps.get_model()
+    ps._wal.abandon()  # crash: ACKed ⇒ fsync'd, so NOTHING may be lost
+    ps2 = ParameterServer(center4(), DownpourMerge(), 4,
+                          wal_dir=str(tmp_path))
+    assert ps2.recovered_ and ps2.num_updates == 4 * n_each
+    assert_trees_equal(ps2.get_model(), before)
+
+
+def test_torn_group_tail_replays_exactly_once(tmp_path):
+    """The torn-GROUP case: the PS dies (kill-PS seam, fired between the
+    append and the group flush) with a commit folded in memory and queued
+    but not yet fsync'd. The record is lost with the crash, its ACK never
+    went out — the client's replay against the recovered server folds it
+    exactly once, landing on the no-crash oracle bit-for-bit."""
+    wal_dir = str(tmp_path / "wal")
+    # a huge window + long interval pins the flusher: nothing syncs until
+    # a waiter blocks, so at hook time THIS commit is provably undurable
+    ps = SocketParameterServer(center4(), DownpourMerge(), 1,
+                               wal_dir=wal_dir, wal_group_window=64,
+                               wal_group_interval=60.0)
+    ps.initialize()
+    ps.start()
+    plan = FaultPlan(kill_ps_after_commits=5)
+
+    def kill_hook(version):
+        if plan.should_kill_ps(version):
+            plan.note_ps_kill()
+            ps._crash()
+
+    ps.post_commit_hook = kill_hook
+    resolver = PSEndpoint("127.0.0.1", ps.port, epoch=0)
+
+    def mk():
+        host, port, epoch = resolver.resolve()
+        return ParameterServerClient(host, port, 0, epoch=epoch,
+                                     connect_timeout=5.0)
+
+    rc = ResilientPSClient(
+        mk, 0, policy=RetryPolicy(max_attempts=4, base_delay=0.01,
+                                  max_delay=0.05, deadline=10),
+        resolver=resolver)
+    oracle = ParameterServer(center4(), DownpourMerge(), 1)
+    n_commits = 8
+    restarted = []
+
+    def restart_in_place():
+        # the kill window: restart the PS from the WAL and repoint the
+        # resolver (what PSFailoverSupervisor does, minus the daemon)
+        new = SocketParameterServer(
+            center4(), DownpourMerge(), 1, wal_dir=wal_dir,
+            wal_group_window=64, wal_group_interval=60.0)
+        assert new.recovered_
+        # the torn-group commit (the 5th) was folded in memory but its
+        # group never flushed: the recovered server must NOT contain it
+        assert new.num_updates == 4
+        new.initialize()
+        new.start()
+        restarted.append(new)
+        resolver.update("127.0.0.1", new.port, 0)
+
+    for k in range(n_commits):
+        payload = delta4(float(k + 1))
+        for attempt in range(10):
+            try:
+                rc.pull()
+                rc.commit(0, payload)
+                break
+            except (ConnectionError, ProtocolError, OSError):
+                assert plan.stats()["ps_kills"] == 1
+                if not restarted:
+                    restart_in_place()
+        else:
+            raise AssertionError(f"commit {k + 1} never landed")
+    assert plan.stats()["ps_kills"] == 1 and len(restarted) == 1
+    new = restarted[0]
+    for k in range(n_commits):
+        oracle.pull(0)
+        oracle.commit(0, delta4(float(k + 1)), seq=k + 1)
+    # exactly-once across the torn group: every logical commit folded
+    # once — the replayed 5th did not double-fold, the lost window was
+    # re-sent — and the center is bit-identical to the no-crash oracle
+    assert new.num_updates == n_commits == rc.seq
+    assert_trees_equal(new.get_model(), oracle.get_model())
+    rc.close()
+    new.stop()
+
+
+def test_wal_time_deadline_bounds_quiet_periods(tmp_path):
+    """Satellite: a pull-/heartbeat-heavy quiet period trips no commit
+    counter, but the flusher's time deadline still fsyncs the appended
+    records within group_interval seconds — the durability window is
+    bounded in seconds, not commits (all modes, incl. the PR 5 one)."""
+    for window in (1, 8, 0):
+        d = tmp_path / f"w{window}"
+        ps = ParameterServer(center4(), DownpourMerge(), 2,
+                             wal_dir=str(d), wal_group_window=window,
+                             wal_group_interval=0.05)
+        for k in range(5):
+            ps.pull(k % 2)          # pull records only: no commit path
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with ps._wal._cond:
+                if ps._wal._durable >= ps._wal._appended > 0:
+                    break
+            time.sleep(0.01)
+        with ps._wal._cond:
+            assert ps._wal._appended == 5
+            assert ps._wal._durable == 5, f"window={window}"
+        assert ps.stats()["wal_fsyncs"] >= 1
+        ps.stop()
+
+
+def test_wal_verify_tool(tmp_path):
+    """`python -m distkeras_tpu.resilience.wal verify <dir>`: reports
+    snapshot health, per-segment valid-prefix/torn-tail bytes, and
+    record-type counts — the chaos tests' replacement for ad-hoc
+    segment parsing."""
+    ps = ParameterServer(center4(), DownpourMerge(), 2,
+                         wal_dir=str(tmp_path), snapshot_every=4)
+    for k in range(6):
+        ps.pull(0)
+        ps.commit(0, delta4(1.0), seq=k + 1)
+    ps.deregister_worker(0)
+    ps._wal.sync()
+    ps._wal.abandon()
+    report = walmod.verify_dir(str(tmp_path))
+    assert report["ok"]
+    # the snapshot at version 4 truncated the first 4 commits' history:
+    # the live segment holds exactly the post-snapshot records
+    assert report["record_totals"]["commit"] == 2
+    assert report["record_totals"]["pull"] == 2
+    assert report["record_totals"]["dereg"] == 1
+    assert len(report["snapshots"]) == 1
+    assert report["snapshots"][0]["crc_ok"]
+    assert report["snapshots"][0]["version"] == 4
+    assert report["torn_tail_bytes"] == 0
+    # tear the live segment: the report counts the torn bytes but stays
+    # ok (a torn LIVE tail is the expected post-crash state)
+    seg = sorted(p for p in os.listdir(tmp_path) if p.startswith("wal-"))[-1]
+    path = os.path.join(str(tmp_path), seg)
+    with open(path, "ab") as f:
+        f.write(b"\x01torn-half-record")
+    report = walmod.verify_dir(str(tmp_path))
+    assert report["ok"] and report["torn_tail_bytes"] > 0
+    # CLI surface: exit 0 + JSON on stdout
+    assert walmod.main(["verify", str(tmp_path)]) == 0
+    assert walmod.main(["bogus"]) == 2
+    # a corrupt snapshot is NOT ok
+    snap = next(p for p in os.listdir(tmp_path) if p.startswith("snap-"))
+    with open(os.path.join(str(tmp_path), snap), "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff\xff")
+    report = walmod.verify_dir(str(tmp_path))
+    assert not report["ok"]
 
 
 # ---------------------------------------------------------------------------
@@ -485,7 +714,7 @@ def test_kill_ps_chaos_requires_a_recovery_path():
 
 
 # ---------------------------------------------------------------------------
-# Native transport parity: fencing protocol + WAL graceful degrade
+# Native transport parity: fencing protocol + the C++ WAL round trip
 # ---------------------------------------------------------------------------
 
 
@@ -538,21 +767,135 @@ def test_native_fencing_protocol_parity():
         ps.stop()
 
 
-def test_native_wal_degrades_gracefully():
+def test_native_wal_roundtrip_bit_identical(tmp_path):
+    """The ISSUE 7 acceptance oracle for the native transport: the C++
+    server writes the WAL (flat records, group-commit flusher), and the
+    PYTHON replay path reconstructs a center/EMA bit-identical to the
+    live server's — plus dedup seqnos and pull versions, so a restarted
+    native server refuses a pre-crash replay exactly like the Python PS.
+    No warning, no degrade: the fastest transport is no longer the least
+    durable."""
+    import warnings as _warnings
+
+    from distkeras_tpu.native import load_dkps
+    from distkeras_tpu.resilience.wal import recover_ps_state
+
+    if load_dkps() is None:
+        pytest.skip("no C++ toolchain to build libdkps")
+    from distkeras_tpu.native_ps import (
+        NativePSClient,
+        NativeSocketParameterServer,
+    )
+
+    center = {"w": np.arange(600, dtype=np.float32) * 1e-3,
+              "b": {"x": np.ones(7, np.float32)}}
+    rule = DynSGDMerge()  # staleness-priced: pull logging must be exact
+    rng = np.random.default_rng(3)
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")  # the old degrade warning is gone
+        ps = NativeSocketParameterServer(
+            center, rule, 2, wal_dir=str(tmp_path), ema_decay=0.9,
+            wal_group_window=4)
+    ps.initialize()
+    ps.start()
+    clients = [NativePSClient("127.0.0.1", ps.port, i, ps.spec)
+               for i in range(2)]
+    try:
+        for k in range(9):
+            w = k % 2
+            if k % 3 != 2:
+                clients[w].pull()   # irregular pulls: staleness varies
+            delta = {
+                "w": rng.standard_normal(600).astype(np.float32),
+                "b": {"x": rng.standard_normal(7).astype(np.float32)},
+            }
+            clients[w].commit(w, delta, seq=k + 1)
+        clients[0].commit(0, delta, seq=8)  # dup: refused, not logged
+        live_model = ps.get_model()
+        live_ema = ps.get_ema()
+        s = ps.stats()
+        assert s["num_updates"] == 9 and s["dup_commits"] == 1
+        assert s["wal_records"] > 0 and s["wal_fsyncs"] > 0
+    finally:
+        for c in clients:
+            c.close()
+        ps.stop()
+
+    # (a) Python replays the native log to the live state, bit-for-bit
+    state = recover_ps_state(str(tmp_path), rule, 2, 0.9, template=center)
+    assert state is not None and state["num_updates"] == 9
+    assert_trees_equal(state["center"], live_model)
+    assert_trees_equal(state["ema"], live_ema)
+    assert state["last_seq"] == {0: 9, 1: 8}
+    # (b) the WAL-verify report agrees with what was written
+    report = walmod.verify_dir(str(tmp_path))
+    assert report["ok"] and report["record_totals"]["commit"] == 9
+    # (c) a restarted native server recovers that state and keeps the
+    # exactly-once fence: the pre-crash seqno replays as a duplicate
+    ps2 = NativeSocketParameterServer(center, rule, 2,
+                                      wal_dir=str(tmp_path), ema_decay=0.9)
+    ps2.initialize()
+    ps2.start()
+    try:
+        assert ps2.recovered_ and ps2.num_updates == 9
+        assert_trees_equal(ps2.get_model(), live_model)
+        assert_trees_equal(ps2.get_ema(), live_ema)
+        c = NativePSClient("127.0.0.1", ps2.port, 0, ps2.spec)
+        c.commit(0, delta, seq=9)          # pre-crash seq: dedup'd
+        assert ps2.num_updates == 9
+        c.commit(0, delta, seq=10)
+        assert ps2.num_updates == 10
+        c.close()
+    finally:
+        ps2.stop()
+
+
+def test_native_torn_group_lost_window_replays(tmp_path):
+    """Native torn group: in the time-bounded mode (window 0, long
+    interval) commits ACK before their records leave the user-space
+    queue; a crash() loses that window. The recovered server is missing
+    those folds — and the client replaying EVERY seqno folds each
+    exactly once, landing on the full-history oracle."""
     from distkeras_tpu.native import load_dkps
 
     if load_dkps() is None:
         pytest.skip("no C++ toolchain to build libdkps")
-    from distkeras_tpu.native_ps import NativeSocketParameterServer
+    from distkeras_tpu.native_ps import (
+        NativePSClient,
+        NativeSocketParameterServer,
+    )
 
-    with pytest.warns(UserWarning, match="no write-ahead log"):
-        ps = NativeSocketParameterServer(
-            {"w": np.zeros(3, np.float32)}, DownpourMerge(), 1,
-            wal_dir="/tmp/ignored",
-        )
+    center = {"w": np.zeros(64, np.float32)}
+    ps = NativeSocketParameterServer(
+        center, DownpourMerge(), 1, wal_dir=str(tmp_path),
+        wal_group_window=0, wal_group_interval=120.0)
     ps.initialize()
     ps.start()
-    ps.stop()
+    c = NativePSClient("127.0.0.1", ps.port, 0, ps.spec)
+    for k in range(6):
+        c.commit(0, {"w": np.full(64, 1.0, np.float32)}, seq=k + 1)
+    assert ps.num_updates == 6
+    ps.crash()  # the queued (never-written) window dies with the process
+    assert ps.crashed_
+    with pytest.raises(ConnectionError):
+        c.commit(0, {"w": np.full(64, 1.0, np.float32)}, seq=7)
+    c.close()
+    ps2 = NativeSocketParameterServer(center, DownpourMerge(), 1,
+                                      wal_dir=str(tmp_path))
+    ps2.initialize()
+    ps2.start()
+    try:
+        lost = 6 - ps2.num_updates
+        assert lost > 0  # the un-flushed window really was torn away
+        c2 = NativePSClient("127.0.0.1", ps2.port, 0, ps2.spec)
+        for k in range(6):  # replay EVERYTHING: dedup sorts it out
+            c2.commit(0, {"w": np.full(64, 1.0, np.float32)}, seq=k + 1)
+        assert ps2.num_updates == 6
+        np.testing.assert_allclose(ps2.get_model()["w"], 6.0)
+        assert ps2.stats()["dup_commits"] == 6 - lost
+        c2.close()
+    finally:
+        ps2.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -618,6 +961,12 @@ def test_ps_killed_mid_run_completes_and_converges(cls_name, standby,
     # primary's log; the standby leg snapshots into its own at promotion)
     rule = t.allocate_merge_rule()
     oracle_dir = os.path.join(wal_dir, "standby") if standby else wal_dir
+    # the WAL-verify tool first (the structured health report CI uploads
+    # as an artifact): snapshots CRC-clean, no torn non-live segments,
+    # and at least the post-failover history's commits on disk
+    report = walmod.verify_dir(oracle_dir)
+    assert report["ok"], report
+    assert report["record_totals"].get("commit", 0) > 0
     state = recover_ps_state(oracle_dir, rule, 4, None)
     assert state is not None
     assert state["num_updates"] == s["num_updates"]
