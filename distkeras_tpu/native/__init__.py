@@ -125,6 +125,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.dkps_client_fence.restype = ctypes.c_int64
     lib.dkps_client_fence.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.dkps_server_set_shard.restype = None
+    lib.dkps_server_set_shard.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+    ]
+    lib.dkps_client_shard_info.restype = ctypes.c_int
+    lib.dkps_client_shard_info.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint64),
+    ]
     lib.dkps_server_fence.restype = ctypes.c_uint64
     lib.dkps_server_fence.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.dkps_server_fence_epoch.restype = ctypes.c_uint64

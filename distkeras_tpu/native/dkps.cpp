@@ -341,6 +341,14 @@ struct Server {
   uint64_t fence_epoch = 0;
   std::atomic<uint64_t> st_fenced{0};
 
+  // Shard-map handshake (distkeras_tpu/sharding): which shard of an
+  // N-shard center this server holds. num_shards == 0 means unsharded
+  // (the default — SHARD_INFO then reports "no shard record", exactly
+  // like the Python server's shard_info = None). Atomics: set once by
+  // dkps_server_set_shard before traffic, read per SHARD_INFO request.
+  std::atomic<uint32_t> shard_id{0};
+  std::atomic<uint32_t> num_shards{0};
+
   // -- write-ahead log with GROUP COMMIT (ISSUE 7; same frame format as
   // resilience/wal.py, so Python's recover_ps_state replays a native-
   // written log bit-identically). Appends run under the center mutex —
@@ -1152,6 +1160,19 @@ struct Server {
         deregister(conn_wid_);
         uint8_t ack = 1;
         if (!send_all(fd, &ack, 1)) break;
+      } else if (action == 11) {  // SHARD_INFO: shard-map handshake
+        // reply: u32 shard_id, u32 num_shards (0 = unsharded), u64
+        // fence_epoch — the sharded client verifies it is wired to the
+        // shard it represents before folding anything (parity with the
+        // Python server's "shard_map" action)
+        uint32_t info[2] = {shard_id.load(), num_shards.load()};
+        uint64_t epoch;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          epoch = fence_epoch;
+        }
+        if (!send_all(fd, info, 8)) break;
+        if (!send_all(fd, &epoch, 8)) break;
       } else {  // BYE or garbage: drop the connection either way
         break;
       }
@@ -1481,6 +1502,15 @@ uint64_t dkps_server_fence_epoch(void* h) {
   return s->fence_epoch;
 }
 
+// Shard-map record (distkeras_tpu/sharding): this server holds shard
+// `sid` of an `n_shards`-way partitioned center. Served to clients via
+// SHARD_INFO (action 11); n_shards 0 = unsharded (the default).
+void dkps_server_set_shard(void* h, uint32_t sid, uint32_t n_shards) {
+  auto* s = static_cast<Server*>(h);
+  s->shard_id.store(sid);
+  s->num_shards.store(n_shards);
+}
+
 // ---------------------------------------------------------------- client --
 
 static void* client_handshake(int fd, uint32_t wid, uint64_t n) {
@@ -1625,6 +1655,24 @@ int64_t dkps_client_fence(void* h, uint64_t epoch) {
       !recv_all(c->fd, &now_epoch, 8))
     return -1;
   return static_cast<int64_t>(now_epoch);
+}
+
+// shard-map handshake (SHARD_INFO, action 11): which shard of which
+// partition this server holds. Returns 0 on success (*out_num == 0 means
+// the server is unsharded), -1 on transport failure.
+int dkps_client_shard_info(void* h, uint32_t* out_shard, uint32_t* out_num,
+                           uint64_t* out_epoch) {
+  auto* c = static_cast<Client*>(h);
+  uint8_t action = 11;
+  uint32_t info[2] = {0, 0};
+  uint64_t epoch = 0;
+  if (!send_all(c->fd, &action, 1) || !recv_all(c->fd, info, 8) ||
+      !recv_all(c->fd, &epoch, 8))
+    return -1;
+  if (out_shard) *out_shard = info[0];
+  if (out_num) *out_num = info[1];
+  if (out_epoch) *out_epoch = epoch;
+  return 0;
 }
 
 // heartbeat (action 6): renew this worker's lease, reporting the client's

@@ -148,6 +148,7 @@ class NativeSocketParameterServer:
         self.recovered_ = False
         self.wal_replay_s = 0.0
         self.crashed_ = False
+        self.shard_info: dict | None = None  # see set_shard_info
         self._lib = load_dkps(required=True)
         self.spec = FlatSpec(center)
         self.rule = rule
@@ -382,6 +383,20 @@ class NativeSocketParameterServer:
         """Raise the fencing epoch (monotone); returns the new value."""
         return int(self._lib.dkps_server_fence(self._handle, int(epoch)))
 
+    # -- shard-map handshake (distkeras_tpu/sharding) ------------------------
+
+    def set_shard_info(self, shard_id: int, num_shards: int) -> None:
+        """Mark this server as holding one shard of an N-way partitioned
+        center; SHARD_INFO (action 11) then advertises it to clients.
+        Also mirrors the record onto ``self.shard_info`` for surface
+        parity with the Python servers."""
+        self._lib.dkps_server_set_shard(
+            self._handle, int(shard_id), int(num_shards)
+        )
+        self.shard_info = {
+            "shard_id": int(shard_id), "num_shards": int(num_shards),
+        }
+
 
 class NativePSClient:
     """Worker-side proxy over the C ABI — same call surface as
@@ -518,6 +533,24 @@ class NativePSClient:
         if rc < 0:
             raise ConnectionError("dkps fence failed (server gone?)")
         return rc
+
+    def shard_info(self) -> dict | None:
+        """Shard-map handshake (SHARD_INFO, action 11): the server's
+        shard record, or None when it serves an unsharded center —
+        surface parity with ``ParameterServerClient.shard_map``."""
+        sid = ctypes.c_uint32(0)
+        num = ctypes.c_uint32(0)
+        epoch = ctypes.c_uint64(0)
+        rc = self._lib.dkps_client_shard_info(
+            self._handle, ctypes.byref(sid), ctypes.byref(num),
+            ctypes.byref(epoch),
+        )
+        if rc != 0:
+            raise ConnectionError("dkps shard_info failed (server gone?)")
+        if int(num.value) == 0:
+            return None
+        return {"shard_id": int(sid.value), "num_shards": int(num.value),
+                "epoch": int(epoch.value)}
 
     def _commit_int8(self, blob: dict) -> None:
         """Ship an Int8Codec blob on the segmented-int8 wire (action 4):

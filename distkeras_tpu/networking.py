@@ -88,6 +88,18 @@ class FencedEpochError(ProtocolError):
         self.server_epoch = server_epoch
 
 
+class ShardMapMismatchError(ProtocolError):
+    """A sharded-PS client is wired to the wrong shard: the endpoint's
+    shard-map handshake (shard id, shard count, ring digest — see
+    ``distkeras_tpu/sharding``) disagrees with the client's plan. NOT
+    retryable: the mismatch is deterministic configuration, and folding
+    leaves into the wrong shard's center would silently corrupt training
+    — failing fast here is the whole point of the handshake."""
+
+    def __init__(self, message: str, *, peer: str | None = None):
+        super().__init__(message, peer=peer, retryable=False)
+
+
 class ServerBusyError(ProtocolError):
     """The serving tier's bounded admission queue is full — backpressure,
     not failure. Retryable by design: the reconnecting client backs off

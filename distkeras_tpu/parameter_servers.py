@@ -253,6 +253,13 @@ class ParameterServer:
         # count (a poll-based kill can miss a fast run entirely), and
         # mid-service, so in-flight ACKs tear exactly like a real kill.
         self.post_commit_hook = None
+        # shard-map handshake record (distkeras_tpu/sharding): when this
+        # server holds ONE SHARD of a partitioned center, the group sets
+        # {"shard_id", "num_shards", "ring"} here; ping and the
+        # "shard_map" action advertise it so a mis-wired client fails
+        # fast (ShardMapMismatchError) instead of folding leaves into
+        # the wrong shard. None = unsharded (the default).
+        self.shard_info: dict | None = None
 
     def _adopt_state(self, state: dict) -> None:
         """Install a recovered/streamed full state (wal.ps_state_dict
@@ -1093,6 +1100,15 @@ class SocketParameterServer(ParameterServer):
                         "ok": True, "epoch": self.fence_epoch,
                         "num_updates": self.num_updates,
                         "standby": bool(getattr(self, "is_standby", False)),
+                        "shard": self.shard_info,
+                    })
+                elif action == "shard_map":
+                    # shard-map handshake: which shard of which plan this
+                    # server holds (None = unsharded), plus the fencing
+                    # epoch the shard-map epoch is summed from
+                    networking.send_data(conn, {
+                        "ok": True, "shard": self.shard_info,
+                        "epoch": self.fence_epoch,
                     })
                 elif action == "fence":
                     # admin: raise the fencing epoch (the promoting
@@ -1300,6 +1316,12 @@ class StandbySocketParameterServer(SocketParameterServer):
                             else self.num_updates
                         ),
                         "standby": True,
+                        "shard": self.shard_info,
+                    })
+                elif action == "shard_map":
+                    networking.send_data(conn, {
+                        "ok": True, "shard": self.shard_info,
+                        "epoch": self.fence_epoch,
                     })
                 elif action in ("stop", "bye"):
                     break
@@ -1351,12 +1373,76 @@ class StandbySocketParameterServer(SocketParameterServer):
                         self._repl_state, recs[0][0], recs[0][1],
                         self.rule, self.num_workers, self.ema_decay,
                     )
+                    # chain replication (distkeras_tpu/sharding): a middle
+                    # link forwards the RAW frame to its own successor
+                    # after applying it — under the same lock, so the
+                    # down-chain order IS the apply order (= the primary's
+                    # fold order). A wedged/dead successor is dropped
+                    # (bounded by its send timeout), never wedging this
+                    # link's apply loop for good.
+                    self._forward_chain_locked(head, body)
         finally:
             # promote()'s drain loop watches this flag: stream-end (the
             # dead primary's kernel flushed its buffer and FIN'd) means
             # every ACKed record has been applied
             with self._repl_lock:
                 self._repl_streaming = False
+
+    def _forward_chain_locked(self, head: bytes, body: bytes) -> None:
+        """Send one applied record to this link's own successor (call with
+        ``_repl_lock`` held). Failure degrades to a shorter chain —
+        counted, never fatal to the apply loop."""
+        sock = self._replica_sock
+        if sock is None:
+            return
+        try:
+            sock.sendall(head)
+            sock.sendall(body)
+        except OSError:
+            self._replica_sock = None
+            self._n_standby_drops += 1
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def attach_standby(self, host: str, port: int,
+                       timeout: float = 10.0) -> None:
+        """Chain link: attach THIS standby's successor. The base state it
+        sends is the replicated state if a stream is already running,
+        else this server's constructor state — chains are attached
+        TAIL-FIRST before traffic (see ``ShardedPSGroup.start``), where
+        the two are identical, so the successor never misses a record.
+        After promotion this server is an ordinary primary and the base
+        implementation applies."""
+        if not self.is_standby:
+            return super().attach_standby(host, port, timeout=timeout)
+        sock = networking.connect(host, int(port), timeout=timeout)
+        sock.settimeout(timeout)
+        with self._repl_lock:
+            if self._repl_state is not None:
+                base = {
+                    k: v for k, v in self._repl_state.items()
+                    if k != "replayed"
+                }
+            else:
+                with self._lock:
+                    base = self._capture_state_locked()
+                self._attach_ema_state(base)
+                base.setdefault("ema", None)
+                base.setdefault("ema_version", 0)
+            networking.send_data(
+                sock, {"action": "replicate_stream", "state": base}
+            )
+            reply = networking.recv_data(sock)
+            if not reply.get("ok"):
+                sock.close()
+                raise ConnectionError(
+                    f"chain successor at {host}:{port} refused the "
+                    f"replication stream: {reply}"
+                )
+            self._replica_sock = sock
+        sock.settimeout(5.0)  # bounded per-record forward, like the base
 
     def promote(self, epoch: int, drain_timeout: float = 5.0) -> None:
         """Become the primary: drain the replication stream, install the
@@ -1464,6 +1550,14 @@ class ParameterServerClient:
             self._sock, {"action": "fence", "epoch": int(epoch)}
         )
         return int(networking.recv_data(self._sock).get("epoch", epoch))
+
+    def shard_map(self) -> dict | None:
+        """Shard-map handshake: the server's shard record
+        (``{"shard_id", "num_shards", "ring"}``) or None when it serves
+        an unsharded center. The sharded client verifies this against
+        its plan before first use — see ``sharding.client``."""
+        networking.send_data(self._sock, {"action": "shard_map"})
+        return networking.recv_data(self._sock).get("shard")
 
     def commit(self, worker_id: int | None, payload: Pytree,
                seq: int | None = None) -> None:

@@ -79,7 +79,12 @@ class FaultPlan:
       count, not wall time. Fires once per run; the supervisor then
       proves the failover (hot-standby promotion or WAL
       restart-in-place). Requires the supervisor to be active
-      (``ps_standby=True`` or ``ps_wal_dir`` on the trainer).
+      (``ps_standby=True``, ``ps_wal_dir``, or ``ps_chain_length > 1``
+      on the trainer).
+    - ``kill_shard_id``: with a sharded center (``ps_num_shards > 1``),
+      WHICH shard's primary the kill targets (default 0) — the
+      kill-one-shard chaos: that shard fails over while its siblings
+      keep folding, and the exactly-once oracle must hold per shard.
 
     ``max_faults`` caps drops+partition hits (delays excluded) so runs
     terminate; ``stats()`` reports what was actually injected.
@@ -91,7 +96,8 @@ class FaultPlan:
                  partition_ops: int = 0,
                  kill_at: dict[int, int] | None = None,
                  max_faults: int | None = None,
-                 kill_ps_after_commits: int | None = None):
+                 kill_ps_after_commits: int | None = None,
+                 kill_shard_id: int | None = None):
         for name, p in (("drop_send", drop_send), ("drop_recv", drop_recv),
                         ("delay", delay)):
             if not 0.0 <= p <= 1.0:
@@ -108,6 +114,13 @@ class FaultPlan:
         self.kill_ps_after_commits = (
             None if kill_ps_after_commits is None
             else int(kill_ps_after_commits)
+        )
+        if kill_shard_id is not None and kill_shard_id < 0:
+            raise ValueError(
+                f"kill_shard_id must be >= 0, got {kill_shard_id}"
+            )
+        self.kill_shard_id = (
+            None if kill_shard_id is None else int(kill_shard_id)
         )
         self._rng = np.random.Generator(np.random.Philox(self.seed))
         self._lock = threading.Lock()

@@ -83,7 +83,18 @@ class PSFailoverSupervisor:
                  fault_plan=None, max_failovers: int = 4):
         self.resolver = resolver
         self.active = primary
-        self.standby = standby
+        # `standby` accepts one replica (the PR 5 hot standby) or a LIST —
+        # a replication chain, head first (distkeras_tpu/sharding): each
+        # failover promotes the first not-yet-promoted link, so a chain of
+        # length k survives k successive primary deaths before falling
+        # back to restart_factory.
+        if standby is None:
+            self.standbys: list = []
+        elif isinstance(standby, (list, tuple)):
+            self.standbys = [s for s in standby if s is not None]
+        else:
+            self.standbys = [standby]
+        self.standby = self.standbys[0] if self.standbys else None
         self.restart_factory = restart_factory
         self.failover_timeout = float(failover_timeout)
         self.ping_interval = (
@@ -204,10 +215,24 @@ class PSFailoverSupervisor:
         # usually a corpse and the connect is refused instantly; an
         # unconfirmed fence goes on the retry list — see _pending_fences)
         fence_confirmed = self._try_fence(old_host, old_port, epoch)
-        # 2. promote
-        if self.standby is not None and not self.standby.promoted_:
-            self.standby.promote(epoch)
-            new = self.standby
+        # 2. promote: the first LIVE not-yet-promoted link of the chain.
+        # A crashed/stopped link is skipped, not promoted — promoting a
+        # corpse would burn every worker's retry deadline behind a closed
+        # listener before the NEXT failover finds the real successor.
+        # (A dead middle link also means its downstream tail stopped
+        # receiving records at its death — the primary drops the broken
+        # stream and keeps ACKing, the PR 5 degrade semantics — so a
+        # later promotion of that tail recovers only the folds it saw;
+        # the chain guards against successive HEAD deaths.)
+        nxt = next(
+            (s for s in self.standbys
+             if not s.promoted_ and not getattr(s, "crashed_", False)
+             and getattr(s, "_running", True)),
+            None,
+        )
+        if nxt is not None:
+            nxt.promote(epoch)
+            new = nxt
             via = "standby"
         elif self.restart_factory is not None:
             new = self.restart_factory()

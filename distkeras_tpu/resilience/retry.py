@@ -322,6 +322,23 @@ class ResilientPSClient:
             return False
         return True
 
+    def shard_map(self) -> dict | None:
+        """Forward the shard-map handshake to the wrapped transport
+        client (under the retry policy). Without this, a sharded center's
+        mis-wiring guard would be silently skipped on exactly the
+        resilient path supervised sharded runs always use — `sharding.
+        client.verify_shard_map` treats a client with no handshake
+        surface as unsharded/legacy. Returns None when the inner
+        transport has no shard channel at all."""
+        def op():
+            # re-resolve per attempt: a retry's reconnect swaps _client
+            inner = self._client
+            probe = (getattr(inner, "shard_map", None)
+                     or getattr(inner, "shard_info", None))
+            return None if probe is None else probe()
+
+        return self._run(op)
+
     def set_timeout(self, seconds: float | None) -> None:
         """Bound the inner client's round-trips (transport-appropriate);
         sticky — re-applied to every replacement client a reconnect

@@ -970,8 +970,68 @@ def verify_dir(directory: str) -> dict:
     return report
 
 
+def _holds_wal_files(directory: str) -> bool:
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return False
+    return any(
+        (n.startswith(_SNAP_PREFIX) and n.endswith(_SNAP_SUFFIX))
+        or (n.startswith(_SEG_PREFIX) and n.endswith(_SEG_SUFFIX))
+        for n in names
+    )
+
+
+def find_wal_dirs(root: str) -> list[str]:
+    """Every directory under ``root`` (inclusive) holding WAL/snapshot
+    files, sorted — a sharded center's root fans out into per-shard
+    subdirectories (``shard-00``, …) each possibly with chain-replica
+    subdirectories (``chain-1``, …); see ``sharding.group``."""
+    out = []
+    for dirpath, dirnames, _ in os.walk(root):
+        dirnames.sort()
+        if _holds_wal_files(dirpath):
+            out.append(dirpath)
+    return sorted(out)
+
+
+def verify_tree(root: str) -> dict:
+    """Verify a WAL location that may be a single directory OR a sharded
+    root (per-shard subdirectories, each verified like any other WAL dir,
+    rolled into ONE aggregate report — the shape the chaos tests and the
+    CI artifact consume). A plain directory returns ``verify_dir``'s
+    report unchanged."""
+    dirs = find_wal_dirs(root)
+    if dirs == [root] or not dirs:
+        return verify_dir(root)
+    reports = []
+    totals: dict[str, int] = {}
+    ok = True
+    for d in dirs:
+        rep = verify_dir(d)
+        rep["dir"] = os.path.relpath(d, root)
+        reports.append(rep)
+        ok = ok and rep["ok"]
+        for key, n in rep.get("record_totals", {}).items():
+            totals[key] = totals.get(key, 0) + n
+    return {
+        "dir": str(root),
+        "sharded": True,
+        "ok": ok,
+        "dirs": reports,
+        "num_wal_dirs": len(reports),
+        "record_totals": totals,
+        "torn_tail_bytes": sum(r["torn_tail_bytes"] for r in reports),
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
-    """CLI: ``python -m distkeras_tpu.resilience.wal verify <dir>``."""
+    """CLI: ``python -m distkeras_tpu.resilience.wal verify <dir>``.
+
+    ``<dir>`` may be one server's WAL directory or a sharded root — the
+    latter prints one aggregate report over every shard (and chain
+    replica) directory beneath it.
+    """
     import json
     import sys
 
@@ -980,7 +1040,7 @@ def main(argv: list[str] | None = None) -> int:
         print("usage: python -m distkeras_tpu.resilience.wal verify <dir>",
               file=sys.stderr)
         return 2
-    report = verify_dir(argv[1])
+    report = verify_tree(argv[1])
     print(json.dumps(report, indent=2, sort_keys=True))
     return 0 if report["ok"] else 1
 

@@ -1,0 +1,30 @@
+"""Sharded parameter-server center (ISSUE 8).
+
+Partitions the parameter tree across N PS shards by byte-weighted
+consistent hashing over leaf paths (``ring.py``), fans worker traffic out
+to every shard in parallel (``client.py``), and runs the shard servers
+with per-shard WAL, chain replication, and per-shard failover
+(``group.py``). An N-shard run is bit-identical to the single-PS run —
+folds are leafwise and every shard sees the same fold order and the same
+per-worker staleness as the global schedule.
+"""
+
+from distkeras_tpu.sharding.client import ShardedPSClient
+from distkeras_tpu.sharding.group import (
+    ShardedPSGroup,
+    aggregate_ps_stats,
+    chain_wal_dir,
+    shard_wal_dir,
+)
+from distkeras_tpu.sharding.ring import HashRing, ShardPlan, stable_hash
+
+__all__ = [
+    "HashRing",
+    "ShardPlan",
+    "ShardedPSClient",
+    "ShardedPSGroup",
+    "aggregate_ps_stats",
+    "chain_wal_dir",
+    "shard_wal_dir",
+    "stable_hash",
+]

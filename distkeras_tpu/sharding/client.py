@@ -1,0 +1,184 @@
+"""Worker-side sharded PS client: fan out, reassemble, stay exactly-once.
+
+``ShardedPSClient`` presents the exact single-PS client surface the hogwild
+workers already speak (``pull`` / ``commit`` / ``heartbeat`` /
+``maybe_heartbeat`` / ``deregister`` / ``close``), backed by one transport
+client per shard. Every pull hits EVERY shard (the worker needs the whole
+tree) and every commit scatters to EVERY shard (a window delta has leaves
+everywhere) — which is precisely what keeps per-shard DynSGD staleness
+equal to the single-PS τ: each shard's ``num_updates`` and this worker's
+per-shard pull version advance in lockstep with the global schedule.
+
+Fan-out runs on a per-client thread pool (one thread per shard), so an
+N-shard pull costs ~one shard's latency, not N of them. Exactly-once under
+retries is PER SHARD: each sub-client is (optionally) a
+``ResilientPSClient`` carrying its own seqno stream against its own
+shard's dedup table — a lost ACK on shard 2 replays against shard 2 only,
+and the other shards' folds are never disturbed.
+
+The shard-map handshake (``verify_shard_map``) checks each sub-client is
+actually wired to the shard it thinks it is (shard id, shard count, and
+the ring digest of the plan) — a mis-wired endpoint raises the typed,
+non-retryable :class:`~distkeras_tpu.networking.ShardMapMismatchError`
+instead of silently folding leaves into the wrong shard's center.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable
+
+from distkeras_tpu.networking import ShardMapMismatchError
+from distkeras_tpu.sharding.ring import ShardPlan
+
+Pytree = Any
+
+
+class ShardedPSClient:
+    """Fan-out proxy over one transport client per shard."""
+
+    def __init__(self, clients: list, plan: ShardPlan, worker_id: int):
+        if len(clients) != plan.num_shards:
+            raise ValueError(
+                f"{len(clients)} shard clients for a "
+                f"{plan.num_shards}-shard plan"
+            )
+        self._clients = list(clients)
+        self.plan = plan
+        self.worker_id = int(worker_id)
+        self._pool = ThreadPoolExecutor(
+            max_workers=plan.num_shards,
+            thread_name_prefix=f"dk-shard-w{worker_id}",
+        )
+        self._closed = False
+        self._lock = threading.Lock()
+
+    # -- fan-out plumbing ----------------------------------------------------
+
+    def _scatter(self, op: Callable[[Any, int], Any]) -> list:
+        """Run ``op(client, sid)`` on every shard concurrently; wait for
+        ALL to settle (a failed shard must not leave siblings in flight,
+        racing this worker's next op), then raise the first failure."""
+        futs = [
+            self._pool.submit(op, c, sid)
+            for sid, c in enumerate(self._clients)
+        ]
+        results, first_err = [], None
+        for fut in futs:
+            try:
+                results.append(fut.result())
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                results.append(None)
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+        return results
+
+    # -- the worker-facing surface -------------------------------------------
+
+    def pull(self, worker_id: int | None = None) -> Pytree:
+        # every sub-client transport already decodes its own pull reply
+        # (compressed pulls included), so shard parts arrive as plain
+        # {path: leaf} dicts ready to join
+        return self.plan.join(self._scatter(lambda c, sid: c.pull()))
+
+    def commit(self, worker_id: int | None, payload: Pytree,
+               seq: int | None = None) -> None:
+        # NOTE: seqnos are per shard, owned by each sub-client (resilient
+        # wrapping); an explicit `seq` has no cross-shard meaning here.
+        if seq is not None:
+            raise ValueError(
+                "ShardedPSClient assigns per-shard seqnos internally; "
+                "wrap the shard clients in ResilientPSClient instead of "
+                "passing seq"
+            )
+        parts = self.plan.split(payload)
+        self._scatter(
+            lambda c, sid: c.commit(self.worker_id, parts[sid])
+        )
+
+    def heartbeat(self, retries: int = 0) -> bool:
+        out = self._scatter(
+            lambda c, sid: (c.heartbeat(retries=retries)
+                            if hasattr(c, "heartbeat") else True)
+        )
+        return all(bool(v) for v in out)
+
+    def maybe_heartbeat(self) -> bool:
+        """Piggyback lease renewal: each shard sub-client rate-limits its
+        own heartbeat (every shard runs its own lease registry)."""
+        out = self._scatter(
+            lambda c, sid: (c.maybe_heartbeat()
+                            if hasattr(c, "maybe_heartbeat") else False)
+        )
+        return any(bool(v) for v in out)
+
+    def deregister(self) -> None:
+        self._scatter(
+            lambda c, sid: (c.deregister()
+                            if hasattr(c, "deregister") else None)
+        )
+
+    def set_timeout(self, seconds: float | None) -> None:
+        for c in self._clients:
+            if hasattr(c, "set_timeout"):
+                c.set_timeout(seconds)
+            elif hasattr(c, "_sock"):
+                c._sock.settimeout(seconds)
+
+    def verify_shard_map(self) -> None:
+        """Handshake: every sub-client must be wired to the shard it
+        represents, under THIS plan. Transports without a shard-info
+        channel (plain in-process proxies) pass vacuously."""
+        expect = self.plan
+
+        def check(c, sid):
+            info = None
+            if hasattr(c, "shard_map"):
+                info = c.shard_map()
+            elif hasattr(c, "shard_info"):
+                info = c.shard_info()
+            if info is None:
+                return  # unsharded/legacy server or in-process proxy
+            if (int(info.get("shard_id", -1)) != sid
+                    or int(info.get("num_shards", 0)) != expect.num_shards
+                    or info.get("ring") not in (None, expect.digest)):
+                raise ShardMapMismatchError(
+                    f"endpoint for shard {sid} advertises "
+                    f"{info.get('shard_id')}/{info.get('num_shards')} "
+                    f"(ring {str(info.get('ring'))[:8]}…), expected "
+                    f"{sid}/{expect.num_shards} "
+                    f"(ring {expect.digest[:8]}…)"
+                )
+
+        self._scatter(check)
+
+    # -- resilience observability (run_async_training aggregates these) -----
+
+    @property
+    def seq(self) -> int:
+        """Logical commits CONFIRMED on every shard (the exactly-once
+        oracle's per-worker count): the min over shards — a commit that
+        failed on one shard mid-scatter is not fully confirmed."""
+        vals = [int(getattr(c, "seq", 0)) for c in self._clients]
+        return min(vals) if vals else 0
+
+    @property
+    def retries(self) -> int:
+        return sum(int(getattr(c, "retries", 0)) for c in self._clients)
+
+    @property
+    def reconnects(self) -> int:
+        return sum(int(getattr(c, "reconnects", 0)) for c in self._clients)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        try:
+            self._scatter(lambda c, sid: c.close())
+        finally:
+            self._pool.shutdown(wait=True)

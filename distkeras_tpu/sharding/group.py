@@ -1,0 +1,462 @@
+"""Server-side sharded center: N PS shards + chain replication + failover.
+
+``ShardedPSGroup`` owns everything the single-PS wiring in
+``run_async_training`` used to own, per shard:
+
+- one parameter server per shard (in-process, socket, or native C++),
+  each holding its ``ShardPlan`` sub-center (a flat ``{path: leaf}``
+  dict) and running the UNCHANGED fold/dedup/lease/WAL machinery —
+  sharding multiplies servers, it does not fork their semantics;
+- per-shard WAL directories under one root (``root/shard-00``, …), so a
+  crashed shard restarts in place from its own ``(snapshot, wal)`` and
+  ``python -m distkeras_tpu.resilience.wal verify <root>`` audits the
+  whole center in one aggregate report;
+- **chain replication** per shard (socket transport): ``chain_length − 1``
+  replicas behind each primary, attached tail-first so the stream has no
+  gaps — the primary streams every pre-ACK record to its first replica,
+  which applies it AND forwards the same raw frame down-chain. This
+  subsumes the PR 5 single hot standby (a 1-shard group with
+  ``chain_length=2`` IS that topology);
+- per-shard failover: one ``PSFailoverSupervisor`` per shard, promoting
+  down the chain (or restarting from the shard's WAL), fencing the dead
+  shard's history with an epoch bump that repoints only THAT shard's
+  endpoint resolver. The **shard-map epoch** — the sum of per-shard
+  fencing epochs — rides the existing epoch token: any failover or
+  reshard bumps it, and the shard-map handshake carries it, so the
+  fencing machinery is one mechanism for both events.
+
+The group quacks like a single ``ParameterServer`` for the trainer tail
+(``get_model`` / ``get_ema`` / ``num_updates`` / ``stats`` / ``stop``),
+reassembling the full tree from the per-shard ACTIVE servers (a promoted
+replica, not the corpse it replaced).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from distkeras_tpu.sharding.client import ShardedPSClient
+from distkeras_tpu.sharding.ring import ShardPlan
+
+Pytree = Any
+
+_SHARD_DIR = "shard-{sid:02d}"
+_CHAIN_DIR = "chain-{j}"
+
+
+def shard_wal_dir(root: str | None, sid: int) -> str | None:
+    return None if root is None else os.path.join(
+        root, _SHARD_DIR.format(sid=sid)
+    )
+
+
+def chain_wal_dir(root: str | None, sid: int, j: int) -> str | None:
+    base = shard_wal_dir(root, sid)
+    return None if base is None else os.path.join(
+        base, _CHAIN_DIR.format(j=j)
+    )
+
+
+class ShardedPSGroup:
+    """N-shard parameter-server center with per-shard chains + failover."""
+
+    def __init__(self, center: Pytree, rule, num_workers: int,
+                 num_shards: int = 2, transport: str = "inprocess",
+                 host: str = "127.0.0.1",
+                 ema_decay: float | None = None,
+                 lease_timeout: float | None = None,
+                 wal_root: str | None = None, snapshot_every: int = 100,
+                 wal_group_window: int = 8,
+                 wal_group_interval: float = 0.25,
+                 chain_length: int = 1,
+                 vnodes: int = 64, bound: float = 1.25):
+        from distkeras_tpu import utils
+
+        if transport not in ("inprocess", "socket", "native"):
+            raise ValueError(
+                f"transport must be 'inprocess', 'socket', or 'native', "
+                f"got {transport!r}"
+            )
+        if chain_length < 1:
+            raise ValueError(
+                f"chain_length must be >= 1, got {chain_length}"
+            )
+        if chain_length > 1 and transport != "socket":
+            raise ValueError(
+                "chain replication needs transport='socket' (replicas are "
+                "socket servers; the in-process PS shares the trainer's "
+                "fate and the native PS has no replication stream)"
+            )
+        center = utils.tree_to_numpy(center)
+        self.plan = ShardPlan(center, num_shards, vnodes=vnodes, bound=bound)
+        self.rule = rule
+        self.num_workers = int(num_workers)
+        self.transport = transport
+        self.host = host
+        self.ema_decay = ema_decay
+        self.lease_timeout = lease_timeout
+        self.wal_root = None if wal_root is None else str(wal_root)
+        self.snapshot_every = int(snapshot_every)
+        self.wal_group_window = int(wal_group_window)
+        self.wal_group_interval = float(wal_group_interval)
+        self.chain_length = int(chain_length)
+        self.servers: list = []       # per-shard primary
+        self.chains: list[list] = []  # per-shard replicas (head first)
+        self.resolvers: list | None = None
+        self.supervisors: list = []
+        self._all_servers: list = []  # everything we built (for stop())
+        # initial sub-centers are kept: a shard's restart-in-place factory
+        # replays its WAL onto THIS template (same cost as the single-PS
+        # restart factory, which closes over the full initial center)
+        self._sub_centers = [
+            self.plan.shard_template(center, sid)
+            for sid in range(self.plan.num_shards)
+        ]
+        for sid in range(self.plan.num_shards):
+            sub = self._sub_centers[sid]
+            srv = self._build_server(sub, sid,
+                                     shard_wal_dir(self.wal_root, sid))
+            self.servers.append(srv)
+            self._all_servers.append(srv)
+            chain = []
+            for j in range(1, self.chain_length):
+                rep = self._build_replica(
+                    sub, sid, chain_wal_dir(self.wal_root, sid, j)
+                )
+                chain.append(rep)
+                self._all_servers.append(rep)
+            self.chains.append(chain)
+
+    # -- construction --------------------------------------------------------
+
+    def _build_server(self, sub_center: dict, sid: int,
+                      wal_dir: str | None):
+        info = self.plan.shard_info(sid)
+        if self.transport == "inprocess":
+            from distkeras_tpu.parameter_servers import ParameterServer
+
+            srv = ParameterServer(
+                sub_center, self.rule, self.num_workers,
+                ema_decay=self.ema_decay, lease_timeout=self.lease_timeout,
+                wal_dir=wal_dir, snapshot_every=self.snapshot_every,
+                wal_group_window=self.wal_group_window,
+                wal_group_interval=self.wal_group_interval,
+            )
+        elif self.transport == "socket":
+            from distkeras_tpu.parameter_servers import SocketParameterServer
+
+            srv = SocketParameterServer(
+                sub_center, self.rule, self.num_workers, host=self.host,
+                port=0, ema_decay=self.ema_decay,
+                lease_timeout=self.lease_timeout,
+                wal_dir=wal_dir, snapshot_every=self.snapshot_every,
+                wal_group_window=self.wal_group_window,
+                wal_group_interval=self.wal_group_interval,
+            )
+        else:
+            from distkeras_tpu.native_ps import NativeSocketParameterServer
+
+            srv = NativeSocketParameterServer(
+                sub_center, self.rule, self.num_workers, host=self.host,
+                port=0, ema_decay=self.ema_decay,
+                lease_timeout=self.lease_timeout,
+                wal_dir=wal_dir, snapshot_every=self.snapshot_every,
+                wal_group_window=self.wal_group_window,
+                wal_group_interval=self.wal_group_interval,
+            )
+        srv.shard_info = info
+        return srv
+
+    def _build_replica(self, sub_center: dict, sid: int,
+                       wal_dir: str | None):
+        from distkeras_tpu.parameter_servers import (
+            StandbySocketParameterServer,
+        )
+
+        rep = StandbySocketParameterServer(
+            sub_center, self.rule, self.num_workers, host=self.host,
+            port=0, ema_decay=self.ema_decay,
+            lease_timeout=self.lease_timeout,
+            wal_dir=wal_dir, snapshot_every=self.snapshot_every,
+            wal_group_window=self.wal_group_window,
+            wal_group_interval=self.wal_group_interval,
+        )
+        rep.shard_info = self.plan.shard_info(sid)
+        return rep
+
+    def initialize(self) -> None:
+        for srv in self._all_servers:
+            srv.initialize()
+
+    def start(self) -> None:
+        for srv in self._all_servers:
+            if hasattr(srv, "start"):
+                srv.start()
+        if self.transport == "native":
+            for sid, srv in enumerate(self.servers):
+                srv.set_shard_info(sid, self.plan.num_shards)
+        # chain attachment, TAIL FIRST: r_{k-1}→r_k before …, primary→r1
+        # last — every link exists before any record flows, so the stream
+        # down-chain has no gap (all servers start from the same template
+        # state; forwarding begins with the first streamed record).
+        for sid, chain in enumerate(self.chains):
+            for j in range(len(chain) - 1, 0, -1):
+                chain[j - 1].attach_standby(self.host, chain[j].port)
+            if chain:
+                self.servers[sid].attach_standby(self.host, chain[0].port)
+
+    # -- failover supervision ------------------------------------------------
+
+    def start_supervision(self, fault_plan=None,
+                          failover_timeout: float = 2.0) -> None:
+        """One ``PSFailoverSupervisor`` per shard (socket transport):
+        promote down the shard's chain, else restart from the shard's
+        WAL. A ``fault_plan`` carrying ``kill_ps_after_commits`` arms the
+        in-commit-path kill on the shard it names (``kill_shard_id``,
+        default 0) — the deterministic kill-one-shard chaos."""
+        if self.transport != "socket":
+            raise ValueError(
+                "per-shard failover supervision needs transport='socket'"
+            )
+        from distkeras_tpu.resilience.recovery import PSFailoverSupervisor
+        from distkeras_tpu.resilience.retry import PSEndpoint
+
+        self.resolvers = [
+            PSEndpoint(srv.host, srv.port, epoch=srv.fence_epoch)
+            for srv in self.servers
+        ]
+        for sid, srv in enumerate(self.servers):
+            factory = None
+            if self.wal_root is not None:
+                def factory(sid=sid):
+                    new = self._build_server(
+                        self._sub_centers[sid], sid,
+                        shard_wal_dir(self.wal_root, sid),
+                    )
+                    new.initialize()
+                    new.start()
+                    return new
+            sup = PSFailoverSupervisor(
+                self.resolvers[sid], srv,
+                standby=self.chains[sid] or None,
+                restart_factory=factory,
+                failover_timeout=float(failover_timeout),
+            )
+            sup.start()
+            self.supervisors.append(sup)
+        if fault_plan is not None and getattr(
+                fault_plan, "kill_ps_after_commits", None) is not None:
+            target = int(getattr(fault_plan, "kill_shard_id", 0) or 0)
+            if not 0 <= target < self.plan.num_shards:
+                raise ValueError(
+                    f"kill_shard_id {target} out of range for "
+                    f"{self.plan.num_shards} shards"
+                )
+            victim = self.servers[target]
+
+            def _kill_hook(version, _ps=victim, _plan=fault_plan):
+                if _plan.should_kill_ps(version):
+                    _plan.note_ps_kill()
+                    _ps._crash()
+
+            victim.post_commit_hook = _kill_hook
+
+    def stop_supervision(self) -> None:
+        for sup in self.supervisors:
+            sup.stop()
+
+    @property
+    def supervisor_error(self):
+        for sup in self.supervisors:
+            if sup.error is not None:
+                return sup.error
+        return None
+
+    def failover_stats(self) -> dict:
+        per = [sup.stats() for sup in self.supervisors]
+        return {
+            "failovers": sum(s["failovers"] for s in per),
+            "failover_latency_s": round(
+                sum(s["failover_latency_s"] for s in per), 4
+            ),
+            "wal_replay_s": round(
+                sum(s["wal_replay_s"] for s in per), 4
+            ),
+            "per_shard": per,
+        }
+
+    # -- the single-PS-compatible surface ------------------------------------
+
+    @property
+    def active_servers(self) -> list:
+        if self.supervisors:
+            return [sup.active for sup in self.supervisors]
+        return list(self.servers)
+
+    @property
+    def map_epoch(self) -> int:
+        """The shard-map epoch: the sum of per-shard fencing epochs —
+        monotone under every failover/reshard, and exactly the token the
+        per-shard commits already carry (split across resolvers)."""
+        if self.resolvers is not None:
+            return sum(r.epoch for r in self.resolvers)
+        return sum(int(srv.fence_epoch) for srv in self.servers)
+
+    @property
+    def recovered_(self) -> bool:
+        return any(getattr(s, "recovered_", False) for s in self.servers)
+
+    @property
+    def num_updates(self) -> int:
+        """Folds confirmed on EVERY shard (min across shards): the
+        cross-shard exactly-once oracle compares this against logical
+        commits — see ``stats()['num_updates']``/``['num_updates_max']``."""
+        vals = [int(s.num_updates) for s in self.active_servers]
+        return min(vals) if vals else 0
+
+    @num_updates.setter
+    def num_updates(self, v: int) -> None:
+        for s in self.active_servers:
+            s.num_updates = int(v)
+
+    def get_model(self) -> Pytree:
+        return self.plan.join([s.get_model() for s in self.active_servers])
+
+    def get_ema(self) -> Pytree | None:
+        if self.ema_decay is None:
+            return None
+        return self.plan.join([s.get_ema() for s in self.active_servers])
+
+    def stats(self) -> dict:
+        per = []
+        for sid, s in enumerate(self.active_servers):
+            d = dict(s.stats())
+            d["shard_id"] = sid
+            d["shard_nbytes"] = self.plan.shard_nbytes[sid]
+            per.append(d)
+        out = aggregate_ps_stats(per)
+        out["map_epoch"] = self.map_epoch
+        out["ring"] = self.plan.digest
+        return out
+
+    def make_client(self, worker_id: int,
+                    pull_compression: str | None = None,
+                    retry_policy=None,
+                    heartbeat_interval: float | None = None,
+                    resilient: bool = False,
+                    verify: bool = True) -> ShardedPSClient:
+        """One worker's fan-out client: a per-shard transport client
+        (resolver-aware when supervision is on), each optionally wrapped
+        in a ``ResilientPSClient`` carrying its OWN seqno stream — retry
+        exactly-once is a per-shard property. ``verify`` runs the
+        shard-map handshake against the plan before first use."""
+        subs = []
+        for sid in range(self.plan.num_shards):
+            mk = self._client_factory(sid, worker_id, pull_compression)
+            if resilient:
+                from distkeras_tpu.resilience.retry import ResilientPSClient
+
+                subs.append(ResilientPSClient(
+                    mk, worker_id, policy=retry_policy,
+                    heartbeat_interval=heartbeat_interval,
+                    resolver=(self.resolvers[sid]
+                              if self.resolvers is not None else None),
+                ))
+            else:
+                subs.append(mk())
+        client = ShardedPSClient(subs, self.plan, worker_id)
+        if verify and self.transport != "inprocess":
+            client.verify_shard_map()
+        return client
+
+    def _client_factory(self, sid: int, worker_id: int,
+                        pull_compression: str | None):
+        if self.transport == "inprocess":
+            from distkeras_tpu.workers import _BoundPS
+
+            return lambda: _BoundPS(self.servers[sid], worker_id,
+                                    pull_compression=pull_compression)
+        if self.transport == "socket":
+            from distkeras_tpu.parameter_servers import (
+                ParameterServerClient,
+            )
+
+            def mk(sid=sid):
+                if self.resolvers is not None:
+                    host, port, epoch = self.resolvers[sid].resolve()
+                else:
+                    host, port, epoch = (self.servers[sid].host,
+                                         self.servers[sid].port, None)
+                return ParameterServerClient(
+                    host, port, worker_id,
+                    pull_compression=pull_compression, epoch=epoch,
+                )
+
+            return mk
+        from distkeras_tpu.native_ps import NativePSClient
+
+        def mk_native(sid=sid):
+            srv = self.servers[sid]
+            return NativePSClient(
+                srv.host, srv.port, worker_id, srv.spec,
+                pull_compression=pull_compression,
+            )
+
+        return mk_native
+
+    def stop(self) -> None:
+        self.stop_supervision()
+        seen: set[int] = set()
+        servers = list(self._all_servers)
+        if self.supervisors:
+            servers.extend(sup.active for sup in self.supervisors)
+        for srv in servers:
+            if id(srv) in seen:
+                continue
+            seen.add(id(srv))
+            try:
+                srv.stop()
+            except OSError:
+                pass
+
+    # surface parity with the single-PS servers the trainer tail expects
+    def initialize_and_start(self) -> None:
+        self.initialize()
+        self.start()
+
+
+def aggregate_ps_stats(per_shard: list[dict]) -> dict:
+    """Roll N shard ``ps.stats()`` dicts into one summary + the raw list.
+
+    Shape contract (the "both shapes" rule in ``workers.py`` logging):
+    the roll-up reuses the single-PS key set — counters summed, rates
+    summed, gauges (``active_workers``/``evicted_workers``) maxed (every
+    shard leases the SAME worker set), lock means re-derived from totals
+    — and the untouched per-shard dicts live under ``per_shard``, so no
+    single-PS key ever collides with a shard's."""
+    summed = (
+        "pulls", "compressed_pulls", "commits", "bytes_in", "bytes_out",
+        "center_lock_acquires", "center_lock_wait_ns",
+        "center_lock_hold_ns", "dup_commits", "heartbeats",
+        "worker_retries", "fenced_commits", "wal_records", "wal_fsyncs",
+        "pulls_per_sec", "commits_per_sec",
+    )
+    maxed = ("active_workers", "evicted_workers", "elapsed_s",
+             "wal_group_max")
+    out: dict = {"num_shards": len(per_shard)}
+    for k in summed:
+        out[k] = sum(s.get(k, 0) for s in per_shard)
+    for k in maxed:
+        out[k] = max((s.get(k, 0) for s in per_shard), default=0)
+    updates = [int(s.get("num_updates", 0)) for s in per_shard]
+    # min = folds confirmed on every shard (the exactly-once oracle
+    # compares it to logical commits); max flags a mid-scatter gap
+    out["num_updates"] = min(updates) if updates else 0
+    out["num_updates_max"] = max(updates) if updates else 0
+    acq = out["center_lock_acquires"]
+    out["center_lock_mean_hold_ns"] = (
+        out["center_lock_hold_ns"] // acq if acq else 0
+    )
+    out["per_shard"] = list(per_shard)
+    return out

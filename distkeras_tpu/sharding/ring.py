@@ -1,0 +1,282 @@
+"""Consistent-hash partitioning of the parameter tree across PS shards.
+
+The reference design always assumed a sharded center — DOWNPOUR/DistBelief
+partition the model across parameter-server shards (Dean et al., NIPS'12)
+and Li et al.'s parameter-server architecture (OSDI'14) makes
+consistent-hash key partitioning the standard scale-out path — but until
+ISSUE 8 this repo's center was one process. This module is the partitioning
+layer: WHICH leaf lives on WHICH shard, decided once per model and stable
+across runs, processes, and (mostly) shard-count changes.
+
+Design points:
+
+- **Keys are leaf paths**, not leaf indices: the canonical
+  ``jax.tree_util`` key-path string of each leaf. Paths are stable under
+  model-structure-preserving changes and readable in logs/WAL reports.
+- **Hashing is pinned**: ``blake2b`` over the path string — never Python's
+  salted ``hash()`` — so the same model shards identically in every
+  process forever. A run's workers, its benchmark harness, and a restarted
+  shard server all derive the same assignment from the same template.
+- **Byte-weighted, bounded-load placement**: plain consistent hashing
+  balances *key counts*; a parameter tree is dominated by a few huge
+  leaves (one embedding can be 3/4 of the model), so we balance *bytes*:
+  leaves place in descending-size order onto their ring successor, walking
+  clockwise past shards whose byte load would exceed
+  ``bound × total/num_shards`` (consistent hashing with bounded loads,
+  Mirrokni et al. 2017). An oversized leaf (bigger than the cap) lands on
+  the first *empty* shard on its walk — one giant embedding claims a shard
+  instead of overflowing the whole ring.
+- **Minimal movement on resharding**: only the ring points of added/
+  removed shards change, so a leaf moves only when its successor walk
+  changes (≈1/N of leaves) or the tighter/looser cap re-routes an
+  overflow. The ring tests pin this against the naive ``hash % N``
+  strategy, which moves ~(N−1)/N of everything.
+
+``ShardPlan`` is the run-time artifact: paths + treedef + assignment, with
+``split``/``join`` to scatter a commit payload (raw tree or an encoded
+codec blob — the split respects ``__dk_leaf__`` nodes as units) across
+shards and gather pulled shard states back into the full tree.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from bisect import bisect_left
+from typing import Any, Iterator
+
+import numpy as np
+
+Pytree = Any
+
+
+def stable_hash(key: str) -> int:
+    """64-bit pinned hash of a string (blake2b — identical in every
+    process; Python's builtin ``hash`` is salted per interpreter)."""
+    return struct.unpack(
+        ">Q", hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest()
+    )[0]
+
+
+class HashRing:
+    """Consistent-hash ring over ``num_shards`` shards with virtual nodes.
+
+    ``vnodes`` ring points per shard smooth the arc lengths; 64 keeps the
+    max/min arc ratio tight enough that byte balance is dominated by the
+    bounded-load walk, not ring geometry.
+    """
+
+    def __init__(self, num_shards: int, vnodes: int = 64):
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.num_shards = int(num_shards)
+        self.vnodes = int(vnodes)
+        pts = sorted(
+            (stable_hash(f"shard:{sid}/vnode:{v}"), sid)
+            for sid in range(self.num_shards)
+            for v in range(self.vnodes)
+        )
+        self._hashes = [h for h, _ in pts]
+        self._owners = [sid for _, sid in pts]
+
+    def successors(self, h: int) -> Iterator[int]:
+        """Distinct shard ids clockwise from ring position ``h`` (every
+        shard appears exactly once — the bounded-load walk order)."""
+        n = len(self._hashes)
+        seen: set[int] = set()
+        i = bisect_left(self._hashes, h)
+        for k in range(n):
+            sid = self._owners[(i + k) % n]
+            if sid not in seen:
+                seen.add(sid)
+                yield sid
+                if len(seen) == self.num_shards:
+                    return
+
+    def assign(self, sizes: dict[str, int],
+               bound: float = 1.25) -> dict[str, int]:
+        """Byte-weighted bounded-load assignment: ``{path: shard_id}``.
+
+        Deterministic: leaves place in descending-byte order (path as the
+        tie-break), each onto the first shard of its successor walk whose
+        load stays under ``bound × total/num_shards`` — or the first EMPTY
+        shard for a leaf bigger than the cap itself. A final fix-up pass
+        guarantees every shard owns at least one leaf (moving the
+        smallest leaves off the fullest shards), so no shard ever serves
+        an empty tree; it requires ``num_shards <= len(sizes)``.
+        """
+        if bound <= 1.0:
+            raise ValueError(f"bound must be > 1, got {bound}")
+        if not sizes:
+            raise ValueError("cannot shard an empty tree")
+        if self.num_shards > len(sizes):
+            raise ValueError(
+                f"cannot spread {len(sizes)} leaves over "
+                f"{self.num_shards} shards (each shard must own >= 1 leaf)"
+            )
+        total = float(sum(sizes.values()))
+        cap = bound * total / self.num_shards
+        loads = [0.0] * self.num_shards
+        counts = [0] * self.num_shards
+        out: dict[str, int] = {}
+        for path, size in sorted(sizes.items(), key=lambda kv: (-kv[1], kv[0])):
+            placed = None
+            for sid in self.successors(stable_hash(f"leaf:{path}")):
+                if loads[sid] == 0.0 or loads[sid] + size <= cap:
+                    placed = sid
+                    break
+            if placed is None:
+                # every shard is past the cap (degenerate sizes): take the
+                # least loaded — deterministic, never fails
+                placed = min(range(self.num_shards),
+                             key=lambda s: (loads[s], s))
+            out[path] = placed
+            loads[placed] += size
+            counts[placed] += 1
+        for sid in range(self.num_shards):
+            if counts[sid]:
+                continue
+            donor = max(
+                (s for s in range(self.num_shards) if counts[s] > 1),
+                key=lambda s: (loads[s], -s),
+            )
+            path = min(
+                (p for p, s in out.items() if s == donor),
+                key=lambda p: (sizes[p], p),
+            )
+            out[path] = sid
+            loads[donor] -= sizes[path]
+            loads[sid] += sizes[path]
+            counts[donor] -= 1
+            counts[sid] += 1
+        return out
+
+
+def _is_codec_leaf(node) -> bool:
+    from distkeras_tpu.parallel.compression import _LEAF
+
+    return isinstance(node, dict) and _LEAF in node
+
+
+def _flatten_with_paths(tree: Pytree):
+    """``[(path_str, node)], treedef`` in canonical flatten order, with
+    encoded codec leaves (``__dk_leaf__`` dicts) kept whole — so a raw
+    tree and its encoded blob flatten to the SAME path list."""
+    import jax
+
+    pairs, treedef = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=_is_codec_leaf
+    )
+    return (
+        [(jax.tree_util.keystr(kp), node) for kp, node in pairs],
+        treedef,
+    )
+
+
+class ShardPlan:
+    """The frozen sharding of one model: paths, treedef, assignment.
+
+    Built once from the center template; every participant (shard
+    servers, every worker's client, the benchmark, the WAL verifier)
+    derives the identical plan from the identical template —
+    ``digest`` pins that agreement and travels in the shard-map
+    handshake, so a client wired to servers sharded under a DIFFERENT
+    plan fails fast instead of silently folding leaves into the wrong
+    shard.
+    """
+
+    def __init__(self, template: Pytree, num_shards: int,
+                 vnodes: int = 64, bound: float = 1.25):
+        pairs, self.treedef = _flatten_with_paths(template)
+        self.paths = [p for p, _ in pairs]
+        if len(set(self.paths)) != len(self.paths):
+            raise ValueError("duplicate leaf paths in the template tree")
+        self.sizes = {
+            p: int(np.asarray(node).nbytes) for p, node in pairs
+        }
+        self.ring = HashRing(num_shards, vnodes=vnodes)
+        self.assignment = self.ring.assign(self.sizes, bound=bound)
+        self.num_shards = int(num_shards)
+        self.shard_paths = [
+            [p for p in self.paths if self.assignment[p] == sid]
+            for sid in range(self.num_shards)
+        ]
+        self.shard_nbytes = [
+            sum(self.sizes[p] for p in paths) for paths in self.shard_paths
+        ]
+        h = hashlib.sha1()
+        for p in self.paths:
+            h.update(f"{p}={self.assignment[p]};".encode("utf-8"))
+        self.digest = h.hexdigest()
+
+    # -- scatter/gather ------------------------------------------------------
+
+    def _leaf_map(self, tree: Pytree) -> dict[str, Any]:
+        pairs, _ = _flatten_with_paths(tree)
+        got = [p for p, _ in pairs]
+        if got != self.paths:
+            raise ValueError(
+                f"tree structure does not match the shard plan "
+                f"({len(got)} leaves vs {len(self.paths)} expected)"
+            )
+        return dict(pairs)
+
+    def shard_template(self, tree: Pytree, sid: int) -> dict[str, Any]:
+        """Shard ``sid``'s sub-center: a flat ``{path: leaf}`` dict (a
+        perfectly ordinary pytree — the shard servers fold it with the
+        same leafwise ``MergeRule.fold`` as the full tree, which is what
+        makes an N-shard run bit-identical to the single-PS run)."""
+        leaf_map = self._leaf_map(tree)
+        return {p: leaf_map[p] for p in self.shard_paths[sid]}
+
+    def split(self, payload: Pytree) -> list:
+        """Scatter one commit payload into per-shard payloads.
+
+        Accepts the raw tree OR an encoded codec blob
+        (``{__dk_codec__: name, "tree": ...}``) — encoded leaf nodes are
+        split as units, so per-shard sub-blobs decode server-side exactly
+        like the whole blob would have (the codecs are leafwise).
+        """
+        from distkeras_tpu.parallel.compression import _MARK, is_encoded
+
+        wrap = None
+        if is_encoded(payload):
+            wrap = payload[_MARK]
+            payload = payload["tree"]
+        leaf_map = self._leaf_map(payload)
+        parts = [
+            {p: leaf_map[p] for p in self.shard_paths[sid]}
+            for sid in range(self.num_shards)
+        ]
+        if wrap is not None:
+            parts = [{_MARK: wrap, "tree": part} for part in parts]
+        return parts
+
+    def join(self, parts: list) -> Pytree:
+        """Gather per-shard ``{path: leaf}`` dicts (decoded) back into the
+        full tree in canonical leaf order."""
+        import jax
+
+        merged: dict[str, Any] = {}
+        for part in parts:
+            merged.update(part)
+        missing = [p for p in self.paths if p not in merged]
+        if missing:
+            raise ValueError(
+                f"shard reassembly is missing {len(missing)} leaves "
+                f"(first: {missing[0]!r}) — a shard reply was dropped or "
+                f"the plans disagree"
+            )
+        return jax.tree_util.tree_unflatten(
+            self.treedef, [merged[p] for p in self.paths]
+        )
+
+    def shard_info(self, sid: int) -> dict:
+        """The shard-map handshake record a shard server advertises."""
+        return {
+            "shard_id": int(sid),
+            "num_shards": self.num_shards,
+            "ring": self.digest,
+        }

@@ -556,6 +556,8 @@ class DistributedTrainer(Trainer):
                  ps_wal_group_interval: float = 0.25,
                  ps_standby: bool = False,
                  ps_failover_timeout: float | None = None,
+                 ps_num_shards: int = 1,
+                 ps_chain_length: int = 1,
                  prefetch: int = 1, ema_decay: float | None = None,
                  clipnorm=None, clipvalue=None, validation_data=None):
         super().__init__(keras_model, loss, worker_optimizer,
@@ -803,6 +805,48 @@ class DistributedTrainer(Trainer):
                 "ps_standby applies to the PS this trainer hosts; an "
                 "external ps_host owner runs its own standby"
             )
+        # Sharded center (distkeras_tpu/sharding; DESIGN.md "Sharded
+        # center & chain replication"):
+        # - ps_num_shards: partition the param tree across N PS shards by
+        #   byte-weighted consistent hashing over leaf paths; workers fan
+        #   pulls/commits to every shard in parallel. Bit-identical to the
+        #   single-PS run (same per-shard fold order and τ), with commit
+        #   throughput scaling with N.
+        # - ps_chain_length: total replicas per shard INCLUDING the
+        #   primary — chain replication (each link streams every pre-ACK
+        #   record to the next; per-shard failover promotes down the
+        #   chain). ps_chain_length=2 with ps_num_shards=1 is the PR 5
+        #   hot-standby topology, which this subsumes.
+        self.ps_num_shards = int(ps_num_shards)
+        if self.ps_num_shards < 1:
+            raise ValueError(
+                f"ps_num_shards must be >= 1, got {ps_num_shards}"
+            )
+        self.ps_chain_length = int(ps_chain_length)
+        if self.ps_chain_length < 1:
+            raise ValueError(
+                f"ps_chain_length must be >= 1, got {ps_chain_length}"
+            )
+        sharded = self.ps_num_shards > 1 or self.ps_chain_length > 1
+        if self.ps_chain_length > 1 and ps_transport != "socket":
+            raise ValueError(
+                "ps_chain_length > 1 requires ps_transport='socket' "
+                "(chain replicas are socket servers; the in-process PS "
+                "shares the trainer's fate and the native PS has no "
+                "replication stream)"
+            )
+        if sharded and ps_host is not None:
+            raise ValueError(
+                "ps_num_shards/ps_chain_length apply to the center this "
+                "trainer hosts; an external ps_host owner runs its own "
+                "sharded group"
+            )
+        if sharded and self.ps_standby:
+            raise ValueError(
+                "ps_standby is the pre-sharding single hot standby; with "
+                "ps_num_shards/ps_chain_length use ps_chain_length >= 2 "
+                "(chain replication subsumes it)"
+            )
         if fault_plan is not None and getattr(
                 fault_plan, "kill_ps_after_commits", None) is not None:
             # fail fast: a PS kill with no recovery path would crash the
@@ -821,22 +865,31 @@ class DistributedTrainer(Trainer):
                     "fault_plan.kill_ps_after_commits applies to the PS "
                     "this trainer hosts, not an external ps_host"
                 )
-            if ps_wal_dir is None and not self.ps_standby:
+            if ps_wal_dir is None and not self.ps_standby \
+                    and self.ps_chain_length <= 1:
                 raise ValueError(
                     "fault_plan.kill_ps_after_commits needs a recovery "
-                    "path: set ps_wal_dir (restart-in-place) and/or "
-                    "ps_standby=True (hot failover)"
+                    "path: set ps_wal_dir (restart-in-place), "
+                    "ps_standby=True, or ps_chain_length >= 2 (chain "
+                    "failover)"
+                )
+            ks = getattr(fault_plan, "kill_shard_id", None)
+            if ks is not None and ks >= self.ps_num_shards:
+                raise ValueError(
+                    f"fault_plan.kill_shard_id={ks} is out of range for "
+                    f"ps_num_shards={self.ps_num_shards}"
                 )
         if backend != "ps" and (
                 worker_restart_budget or retry_policy is not None
                 or heartbeat_interval is not None or lease_timeout is not None
                 or fault_plan is not None or ps_wal_dir is not None
-                or ps_standby):
+                or ps_standby or sharded):
             raise ValueError(
                 "the resilience knobs (worker_restart_budget, retry_policy, "
                 "heartbeat_interval, lease_timeout, fault_plan, ps_wal_dir, "
-                "ps_standby) apply to backend='ps' only (the collective "
-                "backend is one SPMD program)"
+                "ps_standby, ps_num_shards, ps_chain_length) apply to "
+                "backend='ps' only (the collective backend is one SPMD "
+                "program)"
             )
         self.resilience_stats_ = None
 
@@ -1089,6 +1142,13 @@ class DistributedTrainer(Trainer):
             raise ValueError(
                 "ps_host is incompatible with multi-process backend='ps' "
                 "(process 0 hosts the server automatically)"
+            )
+        if self.ps_num_shards > 1 or self.ps_chain_length > 1:
+            raise NotImplementedError(
+                "ps_num_shards/ps_chain_length under multi-process "
+                "backend='ps' are not supported yet (the shim points every "
+                "controller at ONE process-0 server; a sharded group needs "
+                "per-shard endpoint broadcast)"
             )
         W_local = self.num_workers // pc
         transport = "native" if self.ps_transport == "native" else "socket"

@@ -317,14 +317,29 @@ def run_async_training(trainer, ds, shuffle: bool):
         )
     kill_ps_chaos = (fault_plan is not None and getattr(
         fault_plan, "kill_ps_after_commits", None) is not None)
-    if transport == "socket" and (ps_standby or kill_ps_chaos) \
-            and not resilient:
+    # Sharded center (distkeras_tpu/sharding, ISSUE 8): partition the
+    # param tree across ps_num_shards servers by consistent hashing over
+    # leaf paths, with chain replication (ps_chain_length) per shard.
+    # ps_chain_length > 1 with ONE shard is the PR 5 standby topology —
+    # the sharded wiring subsumes it.
+    ps_num_shards = int(getattr(trainer, "ps_num_shards", 1))
+    ps_chain_length = int(getattr(trainer, "ps_chain_length", 1))
+    sharded = (ps_num_shards > 1 or ps_chain_length > 1) \
+        and external_host is None
+    shard_supervised = sharded and transport == "socket" and (
+        ps_chain_length > 1 or kill_ps_chaos or ps_wal_dir is not None)
+    if transport == "socket" \
+            and (ps_standby or kill_ps_chaos or shard_supervised) \
+            and retry_policy is None:
         # failover is only survivable through reconnecting clients: a
         # plain client dies with the primary's TCP connection. The
         # default policy's 6 attempts span ~1.5 s — tighter than the
         # detect-and-promote window — so the auto policy budgets for
-        # (failover_timeout + promotion) with room to spare. A caller-
-        # supplied retry_policy is trusted to do the same.
+        # (failover_timeout + promotion) with room to spare. Installed
+        # whenever no caller-supplied policy exists (a heartbeat-only
+        # resilient client would otherwise ride the 6-attempt default
+        # into a failover window and die); an explicit retry_policy is
+        # trusted to budget for the failover itself.
         resilient = True
         retry_policy = RetryPolicy(
             max_attempts=100, base_delay=0.05, max_delay=0.5,
@@ -360,7 +375,43 @@ def run_async_training(trainer, ds, shuffle: bool):
             "ema_decay with an external ps_host must be configured on the "
             "PS owner's server (the center lives there)"
         )
-    if external_host is not None:
+    sharded_group = None
+    if sharded:
+        # N-shard center: one group object owns the shard servers, their
+        # chains, per-shard WAL dirs under ps_wal_dir, and (socket) the
+        # per-shard failover supervisors; it quacks like a single PS for
+        # everything below (get_model/get_ema/num_updates/stats/stop).
+        from distkeras_tpu.sharding import ShardedPSGroup
+
+        sharded_group = ShardedPSGroup(
+            params, rule, W, num_shards=ps_num_shards,
+            transport=transport,
+            ema_decay=getattr(trainer, "ema_decay", None),
+            lease_timeout=lease_timeout, wal_root=ps_wal_dir,
+            snapshot_every=ps_snapshot_every,
+            wal_group_window=ps_wal_group_window,
+            wal_group_interval=ps_wal_group_interval,
+            chain_length=ps_chain_length,
+        )
+        sharded_group.initialize()
+        sharded_group.start()
+        if shard_supervised:
+            sharded_group.start_supervision(
+                fault_plan=fault_plan if kill_ps_chaos else None,
+                failover_timeout=float(ps_failover_timeout),
+            )
+        ps = sharded_group
+
+        def make_client(i):
+            # a fan-out client per worker: per-shard transport clients
+            # (resolver-aware under supervision), each with its OWN seqno
+            # stream when resilient — exactly-once is a per-shard property
+            return sharded_group.make_client(
+                offset + i, pull_compression=pull_comp,
+                retry_policy=retry_policy, heartbeat_interval=hb_interval,
+                resilient=resilient,
+            )
+    elif external_host is not None:
         # External PS (another process/host — the reference's driver-hosted
         # PS serving remote executors): this process contributes W workers;
         # the server owner holds the center and the global worker count.
@@ -458,7 +509,7 @@ def run_async_training(trainer, ds, shuffle: bool):
     # degrades to no-WAL — see NativeSocketParameterServer)
     ps_standby_server = None
     ps_supervisor = None
-    if transport == "socket" and ps is not None \
+    if transport == "socket" and ps is not None and sharded_group is None \
             and (ps_standby or kill_ps_chaos):
         from distkeras_tpu.resilience.recovery import PSFailoverSupervisor
 
@@ -519,7 +570,7 @@ def run_async_training(trainer, ds, shuffle: bool):
         )
         ps_supervisor.start()
 
-    if resilient:
+    if resilient and sharded_group is None:
         # reconnect-and-retry with per-worker commit seqnos (dedup'd
         # server-side) and piggyback lease heartbeats — resilience/retry.py
         clients = [
@@ -531,6 +582,8 @@ def run_async_training(trainer, ds, shuffle: bool):
             for i in range(W)
         ]
     else:
+        # sharded clients arrive fully wrapped (resilience lives per
+        # shard INSIDE the fan-out — see ShardedPSGroup.make_client)
         clients = [make_client(i) for i in range(W)]
 
     cols = trainer.features_col + [trainer.label_col]
@@ -673,6 +726,17 @@ def run_async_training(trainer, ds, shuffle: bool):
                 "the PS failover supervisor died while the workers "
                 "survived"
             ) from ps_supervisor.error
+    elif sharded_group is not None and shard_supervised:
+        # the group reads per-shard ACTIVE servers itself; only the
+        # supervision threads need retiring before the final reads
+        sharded_group.stop_supervision()
+        sup_err = sharded_group.supervisor_error
+        if sup_err is not None and not any(
+                w.error is not None for w in workers):
+            raise RuntimeError(
+                "a shard failover supervisor died while the workers "
+                "survived"
+            ) from sup_err
 
     # Resilience observability, stashed next to ps_stats_: the commit-
     # seqno oracle (logical commits issued vs folds applied — see the
@@ -692,8 +756,12 @@ def run_async_training(trainer, ds, shuffle: bool):
             ),
             "restarts": supervisor.stats()["restarts"] if supervisor else 0,
             "faults": fault_plan.stats() if fault_plan is not None else None,
-            "ps_failover": (ps_supervisor.stats()
-                            if ps_supervisor is not None else None),
+            "ps_failover": (
+                ps_supervisor.stats() if ps_supervisor is not None
+                else sharded_group.failover_stats()
+                if sharded_group is not None and shard_supervised
+                else None
+            ),
         }
 
     errors = [w.error for w in workers if w.error is not None]

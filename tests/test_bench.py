@@ -62,6 +62,25 @@ def test_ps_microbench_smoke():
         assert rec["center_lock_mean_hold_ns"] >= 0
 
 
+def test_ps_shard_bench_contract():
+    """--ps-bench's N-shard legs (ISSUE 8): every (transport, N) record
+    present with positive aggregate rates, the per-shard byte split
+    summing to the tree, and the host-ceiling field carried."""
+    out = bench.run_ps_shard_bench(n_params=16_384, workers=2,
+                                   seconds=0.2, shard_counts=(1, 2),
+                                   transports=("socket",))
+    assert set(out) == {"ps_shard_socket_n1", "ps_shard_socket_n2"}
+    for name, rec in out.items():
+        assert rec["pulls_per_sec"] > 0, name
+        assert rec["commits_per_sec"] > 0, name
+        assert rec["host_cores"] >= 1
+        assert len(rec["shard_nbytes"]) == rec["num_shards"]
+        assert rec["bytes_per_commit_per_shard"] == max(rec["shard_nbytes"])
+    # sharding divides the per-shard fold cost — the structural claim
+    assert (out["ps_shard_socket_n2"]["bytes_per_commit_per_shard"]
+            < out["ps_shard_socket_n1"]["bytes_per_commit_per_shard"])
+
+
 def test_ps_group_commit_sweep_contract():
     """--chaos-ps's flush-window sweep (ISSUE 7): every leg present with
     positive rates, the exactly-once oracle asserted per leg, the

@@ -1,0 +1,498 @@
+"""Sharded parameter-server center (ISSUE 8): hash ring, bit-identical
+N-shard folds, chain replication, kill-one-shard chaos, aggregate WAL
+verify, and stats aggregation."""
+
+import copy
+import json
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.parallel.merge_rules import (
+    ADAGMerge,
+    DownpourMerge,
+    DynSGDMerge,
+)
+from distkeras_tpu.parameter_servers import ParameterServer
+from distkeras_tpu.sharding import (
+    HashRing,
+    ShardedPSGroup,
+    ShardPlan,
+    stable_hash,
+)
+from tests.test_trainers import blobs_dataset, final_loss, model_spec
+
+
+def _tree(seed=0, layers=12, base=100, step=37):
+    rng = np.random.default_rng(seed)
+    return {
+        f"block_{i:02d}": rng.normal(size=(base + step * i,)
+                                     ).astype(np.float32)
+        for i in range(layers)
+    }
+
+
+def _model_tree(seed=0):
+    """An embedding-dominated tree with mixed containers + an int leaf —
+    the nasty realistic shape (one leaf holds most of the bytes)."""
+    rng = np.random.default_rng(seed)
+    return {
+        "emb": rng.normal(size=(3000,)).astype(np.float32),
+        "dense": {"w": rng.normal(size=(500,)).astype(np.float32),
+                  "b": rng.normal(size=(40,)).astype(np.float32)},
+        "head": [rng.normal(size=(100,)).astype(np.float32),
+                 np.arange(7, dtype=np.int32)],
+    }
+
+
+def _full(tree, value):
+    import jax
+
+    return jax.tree.map(
+        lambda l: (np.full(np.shape(l), value, np.float32)
+                   if np.issubdtype(np.asarray(l).dtype, np.floating)
+                   else np.zeros_like(l)),
+        tree,
+    )
+
+
+def _trees_equal(a, b):
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(x, y) for x, y in zip(la, lb)
+    )
+
+
+# -- the hash ring -----------------------------------------------------------
+
+
+def test_ring_pinned_hash_and_assignment():
+    """The ring is PINNED: blake2b path hashing (never the salted builtin)
+    and a frozen assignment digest — shard layout is stable across
+    processes and runs forever, which is what lets every participant
+    derive the plan independently."""
+    assert stable_hash("shard:0/vnode:0") == 6170415486835965795
+    assert stable_hash("leaf:x") == 11958087293876216794
+    plan = ShardPlan(
+        {f"block_{i:02d}": np.zeros(100 + 37 * i, np.float32)
+         for i in range(12)}, 4,
+    )
+    assert plan.digest == "787e1c9c7d880cfd31a28fc705cddd9e0a8e02b1"
+    # identical construction → identical plan (in-process determinism)
+    plan2 = ShardPlan(
+        {f"block_{i:02d}": np.zeros(100 + 37 * i, np.float32)
+         for i in range(12)}, 4,
+    )
+    assert plan2.assignment == plan.assignment
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+def test_ring_byte_weighted_balance(n_shards):
+    """Byte load per shard stays within the bounded-load cap (or one
+    oversized leaf — which must then sit alone-ish on its shard rather
+    than overflow a loaded one)."""
+    tree = _tree(layers=32)
+    sizes = {p: int(np.asarray(v).nbytes)
+             for p, v in ShardPlan(tree, 1)._leaf_map(tree).items()}
+    ring = HashRing(n_shards)
+    assign = ring.assign(sizes, bound=1.25)
+    total = sum(sizes.values())
+    biggest = max(sizes.values())
+    loads = [0] * n_shards
+    for p, sid in assign.items():
+        loads[sid] += sizes[p]
+    cap = max(1.25 * total / n_shards, biggest)
+    assert max(loads) <= cap + 1e-9
+    assert min(loads) > 0  # every shard serves at least one leaf
+
+
+def test_ring_minimal_movement_on_resize():
+    """Adding/removing one shard moves a bounded fraction of bytes —
+    far less than naive ``hash % N`` (which reshuffles ~(N−1)/N of
+    everything)."""
+    tree = _tree(layers=64, base=50, step=11)
+    sizes = {p: int(np.asarray(v).nbytes)
+             for p, v in ShardPlan(tree, 1)._leaf_map(tree).items()}
+    total = sum(sizes.values())
+    a4 = HashRing(4).assign(sizes)
+    for other_n in (3, 5):
+        other = HashRing(other_n).assign(sizes)
+        moved = sum(sizes[p] for p in sizes if a4[p] != other[p])
+        naive_moved = sum(
+            sizes[p] for p in sizes
+            if stable_hash(p) % 4 != stable_hash(p) % other_n
+        )
+        assert moved <= 0.55 * total, (
+            f"4->{other_n} moved {moved / total:.2f} of bytes"
+        )
+        assert moved < naive_moved, (
+            f"consistent hashing moved {moved / total:.2f}, naive "
+            f"{naive_moved / total:.2f}"
+        )
+
+
+def test_ring_rejects_more_shards_than_leaves():
+    with pytest.raises(ValueError, match="leaf"):
+        ShardPlan({"a": np.zeros(4, np.float32)}, 2)
+
+
+# -- plan scatter/gather -----------------------------------------------------
+
+
+def test_plan_split_join_roundtrip_raw_and_encoded():
+    from distkeras_tpu.parallel.compression import Int8Codec, maybe_decode
+
+    tree = _model_tree()
+    plan = ShardPlan(tree, 3)
+    # raw: split → join is the identity
+    parts = plan.split(tree)
+    assert len(parts) == 3
+    assert _trees_equal(plan.join(parts), tree)
+    # encoded: per-shard sub-blobs decode exactly like the whole blob
+    codec = Int8Codec(min_size=1)
+    blob = codec.encode(tree)
+    enc_parts = plan.split(blob)
+    joined = plan.join([maybe_decode(p) for p in enc_parts])
+    assert _trees_equal(joined, codec.decode(blob))
+    # structure mismatch is a typed failure, not silent corruption
+    with pytest.raises(ValueError, match="structure"):
+        plan.split({"wrong": np.zeros(3, np.float32)})
+
+
+# -- bit-identical N-shard folds ---------------------------------------------
+
+
+@pytest.mark.parametrize("rule", [ADAGMerge(), DownpourMerge(),
+                                  DynSGDMerge()],
+                         ids=["adag", "downpour", "dynsgd"])
+def test_sharded_folds_bit_identical_to_single_ps(rule):
+    """The acceptance oracle: a scripted interleaving of pulls/commits
+    (with real staleness variation for DynSGD) lands on EXACTLY the same
+    center bits through a 3-shard group as through one PS — same fold
+    order per shard, same per-shard τ."""
+    tree = _model_tree()
+    single = ParameterServer(copy.deepcopy(tree), rule, 2)
+    group = ShardedPSGroup(copy.deepcopy(tree), rule, 2, num_shards=3,
+                           transport="inprocess")
+    group.initialize()
+    group.start()
+    c0 = group.make_client(0)
+    c1 = group.make_client(1)
+    try:
+        single.pull(0), c0.pull()
+        single.pull(1), c1.pull()
+        single.commit(0, _full(tree, 0.1)), c0.commit(0, _full(tree, 0.1))
+        # worker 1 commits against a 1-update-stale pull: τ = 1
+        single.commit(1, _full(tree, 0.2)), c1.commit(1, _full(tree, 0.2))
+        single.pull(0), c0.pull()
+        single.commit(0, _full(tree, 0.3)), c0.commit(0, _full(tree, 0.3))
+        assert _trees_equal(single.get_model(), group.get_model())
+        s = group.stats()
+        assert s["num_updates"] == s["num_updates_max"] == 3
+        # every shard folded every commit (the τ-preserving invariant)
+        assert all(p["num_updates"] == 3 for p in s["per_shard"])
+    finally:
+        c0.close()
+        c1.close()
+        group.stop()
+        single.stop()
+
+
+def test_sharded_int8_pull_compression_bit_identical():
+    """Per-worker error-feedback residuals are per-leaf, so int8 pulls
+    through the sharded fan-out telescope exactly like the single PS."""
+    tree = _model_tree(seed=3)
+    single = ParameterServer(copy.deepcopy(tree), DownpourMerge(), 1)
+    group = ShardedPSGroup(copy.deepcopy(tree), DownpourMerge(), 1,
+                           num_shards=2, transport="inprocess")
+    group.initialize()
+    group.start()
+    c0 = group.make_client(0, pull_compression="int8")
+    from distkeras_tpu.parallel.compression import maybe_decode
+
+    try:
+        for k in range(3):
+            a = maybe_decode(single.pull(0, compressed=True))
+            b = c0.pull()
+            assert _trees_equal(a, b)
+            single.commit(0, _full(tree, 0.01 * (k + 1)))
+            c0.commit(0, _full(tree, 0.01 * (k + 1)))
+        assert _trees_equal(single.get_model(), group.get_model())
+    finally:
+        c0.close()
+        group.stop()
+        single.stop()
+
+
+def test_shard_map_handshake_rejects_miswired_client():
+    """A client wired to the wrong shard (or a different ring) fails fast
+    with the typed, non-retryable mismatch error."""
+    from distkeras_tpu.networking import ShardMapMismatchError
+
+    tree = _model_tree()
+    group = ShardedPSGroup(copy.deepcopy(tree), DownpourMerge(), 1,
+                           num_shards=2, transport="socket")
+    group.initialize()
+    group.start()
+    try:
+        # swap the two shards' advertised identities: the plan now
+        # disagrees with what the endpoints claim to hold
+        a, b = group.servers[0].shard_info, group.servers[1].shard_info
+        group.servers[0].shard_info = b
+        group.servers[1].shard_info = a
+        with pytest.raises(ShardMapMismatchError, match="shard"):
+            group.make_client(0)
+        # the RESILIENT path (what supervised sharded runs always use)
+        # must run the same handshake through the retry wrapper — a
+        # vacuous pass here would skip the guard on the real path
+        with pytest.raises(ShardMapMismatchError, match="shard"):
+            group.make_client(0, resilient=True)
+        group.servers[0].shard_info, group.servers[1].shard_info = a, b
+        for resilient in (False, True):  # correctly wired: both pass
+            c = group.make_client(0, resilient=resilient)
+            c.close()
+    finally:
+        group.stop()
+
+
+# -- chain replication -------------------------------------------------------
+
+
+def test_chain_replication_two_successive_failovers_bit_identical():
+    """chain_length=3: records stream primary → r1 → r2. Killing the
+    primary promotes r1 (state bit-identical so far); killing promoted r1
+    promotes r2 — which must hold everything, including folds streamed
+    AFTER the first failover. Exactly-once holds throughout."""
+    tree = _model_tree(seed=5)
+    single = ParameterServer(copy.deepcopy(tree), DownpourMerge(), 2)
+    group = ShardedPSGroup(copy.deepcopy(tree), DownpourMerge(), 2,
+                           num_shards=2, transport="socket",
+                           chain_length=3)
+    group.initialize()
+    group.start()
+    group.start_supervision(failover_timeout=0.3)
+    c0 = group.make_client(0, resilient=True)
+
+    def step(k):
+        single.pull(0), c0.pull()
+        v = 0.01 * (k + 1)
+        single.commit(0, _full(tree, v)), c0.commit(0, _full(tree, v))
+
+    def wait_failovers(n, budget=15.0):
+        t0 = time.monotonic()
+        while group.failover_stats()["failovers"] < n:
+            assert time.monotonic() - t0 < budget, "failover never happened"
+            time.sleep(0.05)
+
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for k in range(4):
+                step(k)
+            group.servers[1]._crash()
+            wait_failovers(1)
+            for k in range(4, 7):
+                step(k)
+            group.supervisors[1].active._crash()
+            wait_failovers(2)
+            for k in range(7, 9):
+                step(k)
+        assert _trees_equal(single.get_model(), group.get_model())
+        s = group.stats()
+        assert s["num_updates"] == s["num_updates_max"] == 9
+        assert c0.seq == 9  # logical == folded: exactly-once per shard
+        assert group.map_epoch == 2  # two failovers bumped the map epoch
+    finally:
+        c0.close()
+        group.stop()
+        single.stop()
+
+
+# -- trainer integration -----------------------------------------------------
+
+
+def test_trainer_sharded_socket_bit_identical_to_single():
+    """End-to-end acceptance: the same deterministic 1-worker training
+    run lands on bit-identical weights with ps_num_shards=2 as with the
+    single PS."""
+    import jax
+
+    import distkeras_tpu as dk
+
+    ds = blobs_dataset(n=512)
+
+    def run(**kw):
+        t = dk.ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+                    worker_optimizer="sgd", learning_rate=0.1,
+                    num_workers=1, batch_size=32, communication_window=2,
+                    num_epoch=2, backend="ps", ps_transport="socket", **kw)
+        return t, t.train(ds, shuffle=False)
+
+    t1, p1 = run()
+    t2, p2 = run(ps_num_shards=2)
+    assert _trees_equal(p1, p2)
+    s = t2.ps_stats_
+    assert s["num_shards"] == 2
+    assert len(s["per_shard"]) == 2
+    # both shapes must stream through the metrics path unchanged
+    json.dumps(t1.ps_stats_)
+    json.dumps(t2.ps_stats_)
+
+
+def test_trainer_kill_one_shard_exactly_once(tmp_path):
+    """The kill-one-shard chaos: shard 1's primary is crash-stopped
+    mid-run (in the commit path — deterministic in commit count); its
+    chain promotes while shard 0 keeps folding. The run completes,
+    converges, and every shard's lifetime fold count equals the logical
+    commit count — exactly-once across the failover."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.resilience import FaultPlan
+
+    ds = blobs_dataset(n=1024)
+    plan = FaultPlan(seed=0, kill_ps_after_commits=6, kill_shard_id=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t = dk.DOWNPOUR(
+            model_spec(), loss="sparse_softmax_cross_entropy",
+            worker_optimizer="sgd", learning_rate=0.02, num_workers=2,
+            batch_size=32, communication_window=2, num_epoch=2,
+            backend="ps", ps_transport="socket", ps_num_shards=2,
+            ps_chain_length=2, ps_wal_dir=str(tmp_path / "wal"),
+            fault_plan=plan, heartbeat_interval=0.2,
+            ps_failover_timeout=0.5,
+        )
+        t.train(ds, shuffle=True)
+    rs = t.resilience_stats_
+    assert rs["faults"]["ps_kills"] == 1
+    assert rs["ps_failover"]["failovers"] >= 1
+    # min == max == logical: every shard folded every commit exactly once
+    assert t.ps_stats_["num_updates"] == t.ps_stats_["num_updates_max"] \
+        == rs["logical_commits"]
+    assert final_loss(t) < 0.6
+
+
+def test_trainer_validates_shard_knobs():
+    import distkeras_tpu as dk
+
+    kw = dict(loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+              num_workers=2, backend="ps")
+    with pytest.raises(ValueError, match="socket"):
+        dk.ADAG(model_spec(), ps_chain_length=2, **kw)
+    with pytest.raises(ValueError, match="chain"):
+        dk.ADAG(model_spec(), ps_transport="socket", ps_num_shards=2,
+                ps_standby=True, **kw)
+    with pytest.raises(ValueError, match="ps_num_shards"):
+        dk.ADAG(model_spec(), ps_num_shards=0, **kw)
+    with pytest.raises(ValueError, match="backend"):
+        dk.ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+                worker_optimizer="sgd", num_workers=2, ps_num_shards=2)
+
+
+# -- sharded WAL verify ------------------------------------------------------
+
+
+def test_wal_verify_sharded_root(tmp_path):
+    """``wal verify`` on a sharded root: one aggregate report covering
+    every shard (and chain) directory, with summed record totals."""
+    root = tmp_path / "wal"
+    tree = _model_tree(seed=7)
+    group = ShardedPSGroup(copy.deepcopy(tree), DownpourMerge(), 1,
+                           num_shards=2, transport="inprocess",
+                           wal_root=str(root))
+    group.initialize()
+    group.start()
+    c = group.make_client(0)
+    for k in range(4):
+        c.pull()
+        c.commit(0, _full(tree, 0.1))
+    c.close()
+    group.stop()
+    out = subprocess.run(
+        [sys.executable, "-m", "distkeras_tpu.resilience.wal", "verify",
+         str(root)],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["ok"] and rep["sharded"]
+    assert rep["num_wal_dirs"] == 2
+    assert rep["record_totals"]["commit"] == 8   # 4 commits × 2 shards
+    assert rep["record_totals"]["pull"] == 8
+    # a plain (unsharded) dir keeps the original report shape
+    from distkeras_tpu.resilience.wal import verify_tree
+
+    sub = verify_tree(str(root / "shard-00"))
+    assert sub["ok"] and "sharded" not in sub
+
+
+# -- stats aggregation -------------------------------------------------------
+
+
+def test_sharded_stats_rollup_shapes():
+    from distkeras_tpu.sharding import aggregate_ps_stats
+
+    tree = _model_tree(seed=9)
+    group = ShardedPSGroup(copy.deepcopy(tree), ADAGMerge(), 2,
+                           num_shards=3, transport="inprocess")
+    group.initialize()
+    group.start()
+    c0 = group.make_client(0)
+    try:
+        c0.pull()
+        c0.commit(0, _full(tree, 0.1))
+        s = group.stats()
+        # roll-up keeps the single-PS key set (summed/maxed) and the raw
+        # per-shard dicts under their own key — no collisions
+        assert s["pulls"] == 3 and s["commits"] == 3
+        assert s["num_shards"] == 3 and len(s["per_shard"]) == 3
+        assert s["num_updates"] == 1 and s["num_updates_max"] == 1
+        assert s["ring"] == group.plan.digest
+        for key in ("center_lock_mean_hold_ns", "pulls_per_sec",
+                    "active_workers", "wal_records"):
+            assert key in s
+        json.dumps(s)  # the metrics stream serializes it as-is
+        # aggregate math is pure (reusable by tools): sums are sums
+        again = aggregate_ps_stats(s["per_shard"])
+        assert again["commits"] == s["commits"]
+    finally:
+        c0.close()
+        group.stop()
+
+
+def test_native_sharded_parity_and_shard_info():
+    """Native shard servers: bit-identical folds through the group and
+    the SHARD_INFO handshake reports the configured shard record."""
+    pytest.importorskip("ctypes")
+    from distkeras_tpu.native import load_dkps
+
+    if load_dkps(required=False) is None:
+        pytest.skip("no C++ toolchain for dkps")
+    tree = {"a": np.ones(64, np.float32) * 0.5,
+            "b": np.ones(32, np.float32) * 2.0,
+            "c": np.ones(16, np.float32)}
+    single = ParameterServer(copy.deepcopy(tree), DynSGDMerge(), 2)
+    group = ShardedPSGroup(copy.deepcopy(tree), DynSGDMerge(), 2,
+                           num_shards=2, transport="native")
+    group.initialize()
+    group.start()
+    c0 = group.make_client(0)
+    c1 = group.make_client(1)
+    try:
+        single.pull(0), c0.pull()
+        single.pull(1), c1.pull()
+        single.commit(0, _full(tree, 0.25)), c0.commit(0, _full(tree, 0.25))
+        single.commit(1, _full(tree, 0.5)), c1.commit(1, _full(tree, 0.5))
+        assert _trees_equal(single.get_model(), group.get_model())
+        info = c0._clients[0].shard_info()
+        assert info["shard_id"] == 0 and info["num_shards"] == 2
+    finally:
+        c0.close()
+        c1.close()
+        group.stop()
+        single.stop()
