@@ -35,6 +35,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 import jax
@@ -1418,6 +1419,160 @@ def run_ps_chaos_bench(n_params=1_000_000, workers=4, seconds=4.0,
         ps.stop()
 
 
+def run_ps_elastic_bench(n_params=200_000, workers=3, join_workers=2,
+                         seconds=4.5, pace_s=0.01, seed=0):
+    """Elastic-membership leg (--chaos, ISSUE 9): a join + preempt sweep
+    at FIXED offered load. Each worker runs pull → commit → sleep(pace_s),
+    so its offered rate is ~constant and aggregate throughput should
+    track pool size; the sweep is three equal phases — base pool, pool +
+    live-joined workers (the `join` wire action), pool drained back down
+    (drain events + the `drain` wire action). The acceptance line:
+    per-phase throughput tracks pool size within ±1 worker's contribution
+    (phase-A per-worker rate is the unit). Honesty fields: `host_cores`
+    (fewer cores than peak pool serializes the workers — the per-worker
+    rate sags and tracking is host-ceiling-capped, flagged rather than
+    failed) and the exactly-once dedup oracle, asserted as always."""
+    import os as _os
+
+    from distkeras_tpu.parallel.merge_rules import DownpourMerge
+    from distkeras_tpu.parameter_servers import (
+        ParameterServerClient,
+        SocketParameterServer,
+    )
+    from distkeras_tpu.resilience import ResilientPSClient, RetryPolicy
+
+    center = _ps_bench_tree(n_params)
+    delta = {
+        "emb": np.full_like(center["emb"], 1e-6),
+        "dense": {"w": np.full_like(center["dense"]["w"], 1e-6),
+                  "b": np.full_like(center["dense"]["b"], 1e-6)},
+    }
+    peak = workers + join_workers
+    log(f"[ps-elastic] socket join/preempt sweep: {workers}→{peak}→"
+        f"{workers} workers, {n_params / 1e6:.1f}M params, "
+        f"pace {pace_s * 1e3:.0f}ms")
+    ps = SocketParameterServer(center, DownpourMerge(), workers,
+                               lease_timeout=30.0)
+    ps.initialize()
+    ps.start()
+    policy = RetryPolicy(base_delay=0.01, max_delay=0.2, deadline=60.0,
+                         seed=seed)
+    phase = [0]
+    counters = [0, 0, 0]
+    clock = [0.0, 0.0, 0.0]
+    lock = threading.Lock()
+    global_stop = threading.Event()
+    clients: dict[int, ResilientPSClient] = {}
+    drain_events: dict[int, threading.Event] = {}
+    threads: dict[int, threading.Thread] = {}
+    errors: list = []
+
+    def make(i):
+        return ResilientPSClient(
+            lambda: ParameterServerClient("127.0.0.1", ps.port, i),
+            i, policy=policy,
+        )
+
+    def hammer(i):
+        c = clients[i]
+        evt = drain_events[i]
+        try:
+            while not global_stop.is_set() and not evt.is_set():
+                c.pull()
+                c.commit(i, delta)
+                with lock:
+                    counters[phase[0]] += 1
+                time.sleep(pace_s)
+        except BaseException as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    def launch(i, joiner):
+        clients[i] = make(i)
+        if joiner:
+            clients[i].join()  # the live-join wire action
+        drain_events[i] = threading.Event()
+        t = threading.Thread(target=hammer, args=(i,), daemon=True)
+        threads[i] = t
+        t.start()
+
+    def run_phase(k, dur):
+        with lock:
+            phase[0] = k
+        t0 = time.perf_counter()
+        time.sleep(dur)
+        clock[k] = time.perf_counter() - t0
+
+    dur = seconds / 3.0
+    try:
+        for i in range(workers):
+            launch(i, joiner=False)
+        run_phase(0, dur)
+        joiner_ids = list(range(workers, peak))
+        for i in joiner_ids:
+            launch(i, joiner=True)
+        run_phase(1, dur)
+        # preempt sweep: drain the joiners back out (finish the in-flight
+        # round, then the drain wire action retires the dedup seqno)
+        for i in joiner_ids:
+            drain_events[i].set()
+        for i in joiner_ids:
+            threads[i].join(timeout=30)
+            clients[i].drain(timeout=False)
+        run_phase(2, dur)
+    finally:
+        global_stop.set()
+        for t in threads.values():
+            t.join(timeout=30)
+    assert not errors, errors
+
+    pools = [workers, peak, workers]
+    rates = [counters[k] / max(clock[k], 1e-9) for k in range(3)]
+    unit = rates[0] / workers  # one worker's contribution, phase-A basis
+    tracking = all(
+        abs(rates[k] - unit * pools[k]) <= unit for k in range(3)
+    )
+    host_cores = _os.cpu_count() or 1
+    logical = sum(c.seq for c in clients.values())
+    s = ps.stats()
+    rec = {
+        "config": "ps_elastic_socket",
+        "params": n_params,
+        "workers_base": workers,
+        "workers_joined": len(joiner_ids),
+        "pace_s": pace_s,
+        "phases": [
+            {"name": n, "pool": pools[k],
+             "rounds_per_sec": round(rates[k], 2),
+             "per_worker_rounds_per_sec": round(rates[k] / pools[k], 2)}
+            for k, n in enumerate(("base", "joined", "drained"))
+        ],
+        "unit_rounds_per_sec": round(unit, 2),
+        "tracking_within_one_worker": tracking,
+        # honesty: with fewer cores than the peak pool the workers
+        # serialize and per-worker rate sags — the tracking claim's
+        # regime is host_cores >= peak pool (or a real multi-host pool)
+        "host_cores": host_cores,
+        "host_ceiling_limited": (not tracking) and host_cores < peak,
+        "logical_commits": logical,
+        "applied_commits": s["commits"],
+        "dedup_exact_once": s["commits"] == logical,
+        "pool_stats": {k: s[k] for k in (
+            "pool_size", "joined_workers", "preempted_workers",
+            "drain_timeouts")},
+    }
+    if not rec["dedup_exact_once"] or (
+            not tracking and not rec["host_ceiling_limited"]):
+        rec["invalid"] = True
+    try:
+        for c in clients.values():
+            c.close()
+    except OSError:
+        pass
+    ps.stop()
+    log(json.dumps(rec))
+    return {"ps_elastic_socket": rec}
+
+
 def run_ps_failover_bench(n_params=1_000_000, workers=4, seconds=4.0,
                           seed=0):
     """PS survivability benchmark (--chaos-ps): the mixed pull+commit
@@ -2096,6 +2251,12 @@ def main():
             legs.update(run_ps_chaos_bench(n_params=args.chaos_params,
                                            workers=args.ps_bench_workers,
                                            seconds=args.ps_bench_seconds))
+            # ISSUE 9: the elastic leg — join + preempt sweep at fixed
+            # offered load; throughput must track pool size within ±1
+            # worker's contribution (host-ceiling honesty in the record)
+            legs.update(run_ps_elastic_bench(
+                workers=max(2, args.ps_bench_workers - 1),
+                seconds=args.ps_bench_seconds))
         if args.chaos_ps:
             legs.update(run_ps_failover_bench(
                 n_params=args.chaos_params,

@@ -142,6 +142,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dkps_client_heartbeat.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     lib.dkps_client_deregister.restype = ctypes.c_int
     lib.dkps_client_deregister.argtypes = [ctypes.c_void_p]
+    lib.dkps_server_set_pool_size.restype = None
+    lib.dkps_server_set_pool_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.dkps_client_join.restype = ctypes.c_int
+    lib.dkps_client_join.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.dkps_client_drain.restype = ctypes.c_int
+    lib.dkps_client_drain.argtypes = [ctypes.c_void_p, ctypes.c_uint8]
     lib.dkps_client_close.restype = None
     lib.dkps_client_close.argtypes = [ctypes.c_void_p]
     return lib

@@ -60,7 +60,16 @@
 //              the failover-safe commit — folded only when the client's
 //              fencing epoch matches the server's, so a zombie
 //              primary's (or a fenced server's) late folds are rejected
-//              instead of absorbed into a superseded history)
+//              instead of absorbed into a superseded history),
+//              12=JOIN (elastic live-join admission, parity with the
+//              Python PS's "join" action: lease the worker quietly —
+//              heartbeats stays a pure heartbeat count — and grow the
+//              pool gauge; the joiner's next PULL records its
+//              pull_version so DynSGD prices its first commit at the
+//              true small tau),
+//              13=DRAIN (u8 timeout flag: preemption drain — clean
+//              deregister retiring the dedup seqno, plus the elastic
+//              counters; timeout=1 records a deadline-lapsed drain)
 //   reply:     PULL -> u64 center_version + n*4 bytes; COMMIT -> u8 ack;
 //              PULL_INT8 -> u64 version + u32 nblocks + nblocks*f32 scales
 //              + n int8 bytes; HEARTBEAT -> u8 (1 = renewed, 2 =
@@ -86,6 +95,7 @@
 #include <mutex>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include <arpa/inet.h>
@@ -348,6 +358,19 @@ struct Server {
   // dkps_server_set_shard before traffic, read per SHARD_INFO request.
   std::atomic<uint32_t> shard_id{0};
   std::atomic<uint32_t> num_shards{0};
+
+  // Elastic-membership accounting (resilience/elastic.py; parity with
+  // the Python PS's join_worker/drain_worker): the pool gauge starts at
+  // the configured worker count (dkps_server_set_pool_size) and tracks
+  // joins minus drains; the other three are lifetime totals. Telemetry,
+  // not durable state — like the op counters they restart on recovery.
+  std::atomic<int64_t> st_pool{0};
+  std::atomic<uint64_t> st_joined{0}, st_preempted{0}, st_drain_to{0};
+  // join/drain idempotence (under lease_mu; parity with the Python PS):
+  // a lost-ACK replay of the JOIN/DRAIN wire action must not
+  // double-count the membership event. A wid's join counts once until
+  // it drains, its drain once until it re-joins; eviction clears both.
+  std::unordered_set<uint32_t> joined_wids, drained_wids;
 
   // -- write-ahead log with GROUP COMMIT (ISSUE 7; same frame format as
   // resilience/wal.py, so Python's recover_ps_state replays a native-
@@ -704,6 +727,12 @@ struct Server {
           ++it;
         }
       }
+      for (uint32_t wid : dead) {
+        // membership hygiene (parity with the Python _on_evict): an
+        // evicted wid's join/drain idempotence records retire with it
+        joined_wids.erase(wid);
+        drained_wids.erase(wid);
+      }
       st_evicted += dead.size();
     }
     if (!dead.empty()) {
@@ -751,6 +780,42 @@ struct Server {
     std::lock_guard<std::mutex> g(mu);
     last_seq.erase(wid);
     if (wal_on) wal_append_dereg_locked(wid);
+  }
+
+  // elastic live-join (JOIN, action 12): lease the worker QUIETLY (no
+  // heartbeat counted — parity with WorkerRegistry.register) and grow
+  // the pool gauge. Returns the post-join pool size.
+  int64_t join_wid(uint32_t wid) {
+    const uint64_t deadline =
+        now_ns() + static_cast<uint64_t>(lease_timeout_s * 1e9);
+    {
+      std::lock_guard<std::mutex> g(lease_mu);
+      Lease& l = leases[wid];
+      l.deadline_ns = deadline;
+      drained_wids.erase(wid);
+      if (!joined_wids.insert(wid).second)
+        return st_pool.load();  // lost-ACK replay: already counted
+    }
+    st_joined += 1;
+    return st_pool += 1;
+  }
+
+  // preemption drain (DRAIN, action 13): a clean deregister plus the
+  // elastic counters; timed_out records a deadline-lapsed drain.
+  void drain_wid(uint32_t wid, bool timed_out) {
+    deregister(wid);
+    {
+      std::lock_guard<std::mutex> g(lease_mu);
+      if (!drained_wids.insert(wid).second)
+        return;  // lost-ACK replay: this drain already counted
+      joined_wids.erase(wid);
+    }
+    st_preempted += 1;
+    if (timed_out) st_drain_to += 1;
+    int64_t pool = st_pool.load();
+    while (pool > 0 &&
+           !st_pool.compare_exchange_weak(pool, pool - 1)) {
+    }
   }
 
   // Contention/throughput counters (parity with the Python PS's stats():
@@ -1160,6 +1225,26 @@ struct Server {
         deregister(conn_wid_);
         uint8_t ack = 1;
         if (!send_all(fd, &ack, 1)) break;
+      } else if (action == 12) {  // JOIN: elastic live-join admission
+        // reply: u8 ack + u64 num_updates + u64 pool_size (parity with
+        // the Python "join" action's {pool_size, num_updates} record)
+        const int64_t pool = join_wid(conn_wid_);
+        uint64_t updates;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          updates = num_updates;
+        }
+        uint8_t ack = 1;
+        uint64_t pool_u = pool < 0 ? 0 : static_cast<uint64_t>(pool);
+        if (!send_all(fd, &ack, 1)) break;
+        if (!send_all(fd, &updates, 8)) break;
+        if (!send_all(fd, &pool_u, 8)) break;
+      } else if (action == 13) {  // DRAIN: preemption drain
+        uint8_t timed_out;
+        if (!recv_all(fd, &timed_out, 1)) break;
+        drain_wid(conn_wid_, timed_out != 0);
+        uint8_t ack = 1;
+        if (!send_all(fd, &ack, 1)) break;
       } else if (action == 11) {  // SHARD_INFO: shard-map handshake
         // reply: u32 shard_id, u32 num_shards (0 = unsharded), u64
         // fence_epoch — the sharded client verifies it is wired to the
@@ -1419,11 +1504,12 @@ void dkps_server_record_pull(void* h, uint32_t wid) {
 }
 
 // Contention/throughput counters (parity with the Python PS's stats()).
-// Fills out[17]: pulls, compressed_pulls, commits, bytes_in, bytes_out,
+// Fills out[21]: pulls, compressed_pulls, commits, bytes_in, bytes_out,
 // center_lock_acquires, center_lock_wait_ns, center_lock_hold_ns,
 // dup_commits, active_workers, evicted_workers, heartbeats,
 // worker_retries, fenced_commits, wal_records, wal_fsyncs,
-// wal_group_max. Runs a FORCED expiry pass first (a stats read must see
+// wal_group_max, pool_size, joined_workers, preempted_workers,
+// drain_timeouts. Runs a FORCED expiry pass first (a stats read must see
 // already-lapsed leases as evicted — no rate-limit window); the counter
 // reads stay lock-free atomics and may lag in-flight ops by one —
 // telemetry semantics, same as the Python side.
@@ -1452,6 +1538,19 @@ void dkps_server_stats(void* h, uint64_t* out) {
   out[14] = s->wal.st_records.load();
   out[15] = s->wal.st_fsyncs.load();
   out[16] = s->wal.st_group_max.load();
+  const int64_t pool = s->st_pool.load();
+  out[17] = pool < 0 ? 0 : static_cast<uint64_t>(pool);
+  out[18] = s->st_joined.load();
+  out[19] = s->st_preempted.load();
+  out[20] = s->st_drain_to.load();
+}
+
+// Elastic pool gauge base (resilience/elastic.py): the wrapper sets the
+// configured worker count at initialize() — the C ABI has no num_workers
+// of its own (the fold scale is baked into the mode) — and JOIN/DRAIN
+// adjust it from there.
+void dkps_server_set_pool_size(void* h, int64_t n) {
+  static_cast<Server*>(h)->st_pool.store(n);
 }
 
 // -- durable-state restore (crash recovery; the Python wrapper replays
@@ -1688,6 +1787,36 @@ int dkps_client_heartbeat(void* h, uint32_t retries) {
       !recv_all(c->fd, &ack, 1) || (ack != 1 && ack != 2))
     return -1;
   return ack == 1 ? 1 : 0;
+}
+
+// elastic live-join (action 12): lease this worker mid-run. Fills
+// *out_updates / *out_pool with the server's current fold count and
+// post-join pool gauge. Returns 0 on success, -1 on transport failure.
+int dkps_client_join(void* h, uint64_t* out_updates, uint64_t* out_pool) {
+  auto* c = static_cast<Client*>(h);
+  uint8_t action = 12;
+  uint8_t ack = 0;
+  uint64_t updates = 0, pool = 0;
+  if (!send_all(c->fd, &action, 1) || !recv_all(c->fd, &ack, 1) ||
+      ack != 1 || !recv_all(c->fd, &updates, 8) ||
+      !recv_all(c->fd, &pool, 8))
+    return -1;
+  if (out_updates) *out_updates = updates;
+  if (out_pool) *out_pool = pool;
+  return 0;
+}
+
+// preemption drain (action 13): clean deregister + elastic counters;
+// timed_out != 0 records a deadline-lapsed drain. 0 on success.
+int dkps_client_drain(void* h, uint8_t timed_out) {
+  auto* c = static_cast<Client*>(h);
+  char header[2];
+  header[0] = 13;
+  header[1] = static_cast<char>(timed_out ? 1 : 0);
+  uint8_t ack = 0;
+  if (!send_all(c->fd, header, 2) || !recv_all(c->fd, &ack, 1) || ack != 1)
+    return -1;
+  return 0;
 }
 
 // deregister (action 8): clean exit — drop the lease, no eviction counted

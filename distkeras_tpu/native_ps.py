@@ -202,6 +202,10 @@ class NativeSocketParameterServer:
             fence = max(fence, int(state["fence_epoch"]))
         if fence:
             self._lib.dkps_server_fence(h, fence)
+        # elastic pool gauge base (stats parity with the Python PS, whose
+        # _pool_size starts at num_workers; the C ABI has no worker count
+        # of its own — the fold scale is baked into the mode)
+        self._lib.dkps_server_set_pool_size(h, self.num_workers)
         if self.wal_dir is not None:
             self._attach_wal(state)
         self._t_start = time.monotonic()  # stats() rate denominator
@@ -355,11 +359,12 @@ class NativeSocketParameterServer:
         the time since ``initialize()``."""
         from distkeras_tpu.parameter_servers import build_ps_stats
 
-        raw = (ctypes.c_uint64 * 17)()
+        raw = (ctypes.c_uint64 * 21)()
         self._lib.dkps_server_stats(self._handle, raw)
         (pulls, cpulls, commits, bytes_in, bytes_out, acq, wait, hold,
          dups, active, evicted, heartbeats, retries, fenced,
-         wal_records, wal_fsyncs, wal_group_max) = (
+         wal_records, wal_fsyncs, wal_group_max, pool, joined,
+         preempted, drain_to) = (
             int(v) for v in raw)
         return build_ps_stats(
             pulls, cpulls, commits, bytes_in, bytes_out, acq, wait, hold,
@@ -368,7 +373,9 @@ class NativeSocketParameterServer:
             heartbeats=heartbeats, worker_retries=retries,
             fenced_commits=fenced, num_updates=self.num_updates,
             wal_records=wal_records, wal_fsyncs=wal_fsyncs,
-            wal_group_max=wal_group_max,
+            wal_group_max=wal_group_max, pool_size=pool,
+            joined_workers=joined, preempted_workers=preempted,
+            drain_timeouts=drain_to,
         )
 
     # -- fencing (protocol parity with the Python PS) ------------------------
@@ -525,6 +532,25 @@ class NativePSClient:
         """Clean exit: drop this worker's lease without an eviction."""
         if self._lib.dkps_client_deregister(self._handle) != 0:
             raise ConnectionError("dkps deregister failed (server gone?)")
+
+    def join(self) -> dict:
+        """Elastic live-join admission (JOIN, action 12) — surface
+        parity with ``ParameterServerClient.join``."""
+        updates = ctypes.c_uint64(0)
+        pool = ctypes.c_uint64(0)
+        if self._lib.dkps_client_join(
+                self._handle, ctypes.byref(updates), ctypes.byref(pool)
+        ) != 0:
+            raise ConnectionError("dkps join failed (server gone?)")
+        return {"ok": True, "num_updates": int(updates.value),
+                "pool_size": int(pool.value)}
+
+    def drain(self, timeout: bool = False) -> None:
+        """Preemption drain (DRAIN, action 13): clean deregister plus
+        the server's elastic counters."""
+        if self._lib.dkps_client_drain(
+                self._handle, 1 if timeout else 0) != 0:
+            raise ConnectionError("dkps drain failed (server gone?)")
 
     def fence(self, epoch: int) -> int:
         """Admin (FENCE, action 9): raise the server's fencing epoch;

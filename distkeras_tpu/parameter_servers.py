@@ -202,6 +202,24 @@ class ParameterServer:
         self._n_commits = 0
         self._bytes_in = 0
         self._bytes_out = 0
+        # elastic-membership accounting (resilience/elastic.py): the pool
+        # gauge starts at the configured worker count; live joins grow
+        # it, preemption drains shrink it (clean or deadline-lapsed —
+        # the latter also counted in drain_timeouts). Telemetry, not
+        # durable state: like the op counters, a recovered server's
+        # counts restart while the dedup/lease state replays exactly.
+        self._pool_size = int(num_workers)
+        self._n_joined = 0
+        self._n_preempted = 0
+        self._n_drain_timeouts = 0
+        # join/drain idempotence (all under _stats_lock): the wire
+        # actions ride lossy links, so a lost-ACK replay must not
+        # double-count a membership event — same hazard the commit path
+        # dedups with seqnos. A wid's join counts once until it drains;
+        # its drain counts once until it re-joins; eviction clears both
+        # (the sets stay bounded across worker generations).
+        self._joined_wids: set[int] = set()
+        self._drained_wids: set[int] = set()
         self._t_start = time.monotonic()
         self._center_nbytes = sum(
             np.asarray(l).nbytes for l in _tree_leaves(self.center)
@@ -729,6 +747,44 @@ class ParameterServer:
                     _wal.encode_record(_wal.REC_DEREG, (int(worker_id),))
                 )
 
+    # -- elastic membership (resilience/elastic.py) --------------------------
+
+    def join_worker(self, worker_id: int) -> dict:
+        """Live-join admission: lease the worker (quietly — ``heartbeats``
+        stays a pure heartbeat count) and grow the pool gauge. The
+        joiner's very next ``pull`` records its pull-version, so its
+        first DynSGD commit is priced at the true small τ. Returns the
+        admission record the wire action answers with."""
+        self._registry.register(worker_id)
+        with self._stats_lock:
+            self._drained_wids.discard(worker_id)
+            if worker_id not in self._joined_wids:
+                # a lost-ACK replay of the join must not double-count
+                self._joined_wids.add(worker_id)
+                self._n_joined += 1
+                self._pool_size += 1
+            pool = self._pool_size
+        with self._lock:
+            updates = self.num_updates
+        return {"pool_size": pool, "num_updates": updates}
+
+    def drain_worker(self, worker_id: int, timeout: bool = False) -> None:
+        """Preemption drain: a clean deregister (lease dropped without an
+        eviction, dedup seqno retired through the PR 5 bounded-table
+        path) plus the elastic counters — ``timeout=True`` records a
+        drain whose deadline lapsed (the force-drain path; eviction
+        remains the backstop for the abandoned worker)."""
+        self.deregister_worker(worker_id)
+        with self._stats_lock:
+            if worker_id in self._drained_wids:
+                return  # lost-ACK replay: this drain already counted
+            self._drained_wids.add(worker_id)
+            self._joined_wids.discard(worker_id)
+            self._n_preempted += 1
+            if timeout:
+                self._n_drain_timeouts += 1
+            self._pool_size = max(0, self._pool_size - 1)
+
     def _on_evict(self, worker_ids: list[int]) -> None:
         """Lease expiry → forget the workers' pull versions, so DynSGD
         treats any zombie commit as maximally stale (τ = num_updates) —
@@ -747,6 +803,13 @@ class ParameterServer:
                 self._log_locked(_wal.encode_record(
                     _wal.REC_EVICT, ([int(w) for w in worker_ids],)
                 ))
+        with self._stats_lock:
+            # membership hygiene: an evicted wid's join/drain idempotence
+            # records retire with it (a returning worker re-registers),
+            # keeping the sets bounded under long elastic churn
+            for wid in worker_ids:
+                self._joined_wids.discard(wid)
+                self._drained_wids.discard(wid)
 
     def fence(self, epoch: int) -> int:
         """Raise the fencing epoch (monotone): commits carrying an older
@@ -886,6 +949,11 @@ class ParameterServer:
           dedup refused to double-fold), ``active_workers`` /
           ``evicted_workers`` / ``heartbeats`` / ``worker_retries`` (the
           lease registry — see resilience/heartbeat.py).
+        - elastic-membership counters (resilience/elastic.py):
+          ``pool_size`` (gauge: configured workers + joins − drains),
+          ``joined_workers`` / ``preempted_workers`` (lifetime join /
+          drain totals), ``drain_timeouts`` (drains whose deadline
+          lapsed into the force-drain path).
         """
         elapsed = time.monotonic() - self._t_start
         with self._stats_lock:
@@ -894,6 +962,10 @@ class ParameterServer:
             commits = self._n_commits
             bytes_in, bytes_out = self._bytes_in, self._bytes_out
             dups = self._n_dup_commits
+            pool = self._pool_size
+            joined = self._n_joined
+            preempted = self._n_preempted
+            drain_to = self._n_drain_timeouts
         hb = self._registry.stats()
         wal = self._wal
         return build_ps_stats(
@@ -909,6 +981,8 @@ class ParameterServer:
             wal_records=0 if wal is None else wal.wal_records,
             wal_fsyncs=0 if wal is None else wal.wal_fsyncs,
             wal_group_max=0 if wal is None else wal.wal_group_max,
+            pool_size=pool, joined_workers=joined,
+            preempted_workers=preempted, drain_timeouts=drain_to,
         )
 
 
@@ -920,7 +994,9 @@ def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
                    heartbeats: int = 0, worker_retries: int = 0,
                    fenced_commits: int = 0, num_updates: int = 0,
                    wal_records: int = 0, wal_fsyncs: int = 0,
-                   wal_group_max: int = 0) -> dict:
+                   wal_group_max: int = 0, pool_size: int = 0,
+                   joined_workers: int = 0, preempted_workers: int = 0,
+                   drain_timeouts: int = 0) -> dict:
     """The ONE stats-dict builder both PS transports share (Python counters
     here, C++ atomics via ``native_ps.NativeSocketParameterServer.stats``):
     key set and derived-value math are pinned by construction, so the
@@ -960,6 +1036,14 @@ def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
         "wal_records": wal_records,
         "wal_fsyncs": wal_fsyncs,
         "wal_group_max": wal_group_max,
+        # elastic membership (resilience/elastic.py): the pool gauge
+        # (configured workers + joins − drains) and the lifetime
+        # join/drain totals; drain_timeouts counts deadline-lapsed
+        # drains — the force-drain fallback path
+        "pool_size": pool_size,
+        "joined_workers": joined_workers,
+        "preempted_workers": preempted_workers,
+        "drain_timeouts": drain_timeouts,
     }
 
 
@@ -1126,6 +1210,19 @@ class SocketParameterServer(ParameterServer):
                     networking.send_data(conn, {"ok": True, "known": known})
                 elif action == "deregister":
                     self.deregister_worker(msg["worker_id"])
+                    networking.send_data(conn, {"ok": True})
+                elif action == "join":
+                    # elastic live-join admission (resilience/elastic.py):
+                    # lease the joiner and answer with the pool gauge +
+                    # current version (its next pull prices its DynSGD τ)
+                    rec = self.join_worker(msg["worker_id"])
+                    rec["ok"] = True
+                    networking.send_data(conn, rec)
+                elif action == "drain":
+                    # preemption drain: clean deregister + elastic
+                    # counters; timeout=True marks a lapsed deadline
+                    self.drain_worker(msg["worker_id"],
+                                      timeout=bool(msg.get("timeout")))
                     networking.send_data(conn, {"ok": True})
                 elif action == "replicate_stream":
                     # hot-standby replication (StandbySocketParameterServer
@@ -1607,6 +1704,33 @@ class ParameterServerClient:
         networking.send_data(
             self._sock,
             {"action": "deregister", "worker_id": self.worker_id},
+        )
+        networking.recv_data(self._sock)  # ack
+
+    def join(self) -> dict:
+        """Elastic live-join admission (resilience/elastic.py): lease
+        this worker mid-run and read the pool gauge + current center
+        version. The caller pulls right after — that pull initializes
+        its server-side pull-version, so DynSGD prices its first commit
+        at the true small τ."""
+        networking.send_data(
+            self._sock, {"action": "join", "worker_id": self.worker_id}
+        )
+        reply = networking.recv_data(self._sock)
+        if not reply.get("ok"):
+            raise networking.ProtocolError(
+                f"join refused: {reply.get('error', reply)}", retryable=True
+            )
+        return reply
+
+    def drain(self, timeout: bool = False) -> None:
+        """Preemption drain: clean deregister (dedup seqno retired) plus
+        the server's elastic counters; ``timeout=True`` reports a drain
+        whose deadline lapsed (the coordinator's force-drain path)."""
+        networking.send_data(
+            self._sock,
+            {"action": "drain", "worker_id": self.worker_id,
+             "timeout": bool(timeout)},
         )
         networking.recv_data(self._sock)  # ack
 

@@ -23,13 +23,25 @@ PR 3's decontended PS hot path:
   :class:`CommitLog` write-ahead log + fsync'd snapshots, crash-restart
   replay (``recover_ps_state``), and the record stream the hot standby
   applies.
+- :mod:`~distkeras_tpu.resilience.elastic` — elastic membership:
+  :class:`ShardAssigner` (dynamic window-block data assignment,
+  exactly-once per epoch across joins/drains),
+  :class:`ElasticCoordinator` (live worker join, preemption-aware
+  bounded-deadline drain), and :class:`ElasticPolicy` (the rounds/s +
+  τ-tail-straggler autoscaler).
 
 Trainer-level knobs: ``retry_policy``, ``heartbeat_interval``,
 ``lease_timeout``, ``worker_restart_budget``, ``fault_plan``,
-``ps_wal_dir``, ``ps_snapshot_every``, ``ps_standby`` (see
+``ps_wal_dir``, ``ps_snapshot_every``, ``ps_standby``, ``elastic``,
+``autoscale_target``, ``preempt_drain_timeout``, ``max_pool_size`` (see
 ``DistributedTrainer``).
 """
 
+from distkeras_tpu.resilience.elastic import (  # noqa: F401
+    ElasticCoordinator,
+    ElasticPolicy,
+    ShardAssigner,
+)
 from distkeras_tpu.resilience.faults import (  # noqa: F401
     FaultInjectedError,
     FaultPlan,
@@ -54,6 +66,9 @@ from distkeras_tpu.resilience.wal import (  # noqa: F401
 )
 
 __all__ = [
+    "ElasticCoordinator",
+    "ElasticPolicy",
+    "ShardAssigner",
     "FaultInjectedError",
     "FaultPlan",
     "WorkerKilled",

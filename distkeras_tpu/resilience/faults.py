@@ -70,6 +70,19 @@ class FaultPlan:
       :class:`WorkerKilled` when it reaches that window (once; a
       restarted worker passing the same index survives).
 
+    Elastic-membership faults (consulted by the ``ElasticCoordinator`` —
+    resilience/elastic.py — through the worker window loop, so they ride
+    the same deterministic (worker_id, window_index) seam as ``kill_at``):
+
+    - ``join_worker_at_window``: ``{observer_worker_id: window_index}`` —
+      at the observer's first window boundary AT OR AFTER that index,
+      ONE new worker live-joins the pool (fresh id, live-join
+      handshake). Fires once per entry.
+    - ``preempt_worker_at_window``: ``{victim_worker_id: window_index}``
+      — at the victim's first window boundary at or after that index it
+      receives a preemption notice and starts a bounded-deadline drain.
+      Fires once per entry.
+
     Parameter-server faults (consulted by the trainer-side
     ``PSFailoverSupervisor`` — resilience/recovery.py):
 
@@ -97,7 +110,9 @@ class FaultPlan:
                  kill_at: dict[int, int] | None = None,
                  max_faults: int | None = None,
                  kill_ps_after_commits: int | None = None,
-                 kill_shard_id: int | None = None):
+                 kill_shard_id: int | None = None,
+                 join_worker_at_window: dict[int, int] | None = None,
+                 preempt_worker_at_window: dict[int, int] | None = None):
         for name, p in (("drop_send", drop_send), ("drop_recv", drop_recv),
                         ("delay", delay)):
             if not 0.0 <= p <= 1.0:
@@ -122,15 +137,21 @@ class FaultPlan:
         self.kill_shard_id = (
             None if kill_shard_id is None else int(kill_shard_id)
         )
+        self.join_worker_at_window = dict(join_worker_at_window or {})
+        self.preempt_worker_at_window = dict(preempt_worker_at_window or {})
         self._rng = np.random.Generator(np.random.Philox(self.seed))
         self._lock = threading.Lock()
         self._ops = 0
         self._killed: set[int] = set()
+        self._joined: set[int] = set()
+        self._preempted: set[int] = set()
         self._ps_killed = False
         self._n_drops = 0
         self._n_delays = 0
         self._n_partition_drops = 0
         self._n_kills = 0
+        self._n_joins = 0
+        self._n_preempts = 0
         self._n_ps_kills = 0
 
     # -- wire hook (installed into networking._fault_hook) -------------------
@@ -181,6 +202,41 @@ class FaultPlan:
             f"injected kill: worker {worker_id} at window {window_index}"
         )
 
+    # -- elastic-membership hooks (ElasticCoordinator) -----------------------
+
+    def take_join(self, worker_id: int, window_index: int) -> bool:
+        """True exactly once, at ``worker_id``'s first window boundary AT
+        OR AFTER its configured trigger (``>=``, not ``==``: a worker
+        slowed by concurrent wire chaos must still fire the event at its
+        next boundary instead of skipping past it): the coordinator
+        should live-join one new worker now. Deterministic in the
+        worker's own completed-window count — a restarted worker
+        replaying windows does not re-trigger."""
+        step = self.join_worker_at_window.get(worker_id)
+        if step is None or window_index < step:
+            return False
+        with self._lock:
+            if worker_id in self._joined:
+                return False
+            self._joined.add(worker_id)
+            self._n_joins += 1
+        return True
+
+    def take_preempt(self, worker_id: int, window_index: int) -> bool:
+        """True exactly once, at ``worker_id``'s first window boundary at
+        or after its configured preemption point (same ``>=`` semantics
+        as :meth:`take_join`): the worker should receive a preemption
+        notice and start its bounded-deadline drain."""
+        step = self.preempt_worker_at_window.get(worker_id)
+        if step is None or window_index < step:
+            return False
+        with self._lock:
+            if worker_id in self._preempted:
+                return False
+            self._preempted.add(worker_id)
+            self._n_preempts += 1
+        return True
+
     # -- parameter-server hook (PSFailoverSupervisor) ------------------------
 
     def should_kill_ps(self, num_updates: int) -> bool:
@@ -227,5 +283,15 @@ class FaultPlan:
                 "partition_drops": self._n_partition_drops,
                 "delays": self._n_delays,
                 "kills": self._n_kills,
+                "joins": self._n_joins,
+                "preempts": self._n_preempts,
                 "ps_kills": self._n_ps_kills,
             }
+
+    @property
+    def has_elastic_events(self) -> bool:
+        """Whether the plan carries join/preempt membership events (they
+        need an elastic trainer — the fixed-pool loop never consults
+        them, so running them there would silently test nothing)."""
+        return bool(self.join_worker_at_window
+                    or self.preempt_worker_at_window)

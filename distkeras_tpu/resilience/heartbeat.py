@@ -94,6 +94,18 @@ class WorkerRegistry:
         self.expire()
         return not fresh
 
+    def register(self, worker_id: int) -> None:
+        """Insert (or refresh) a lease WITHOUT counting a heartbeat — the
+        elastic live-join path: the joiner holds a lease from the moment
+        it is admitted, but ``heartbeats`` stays a pure count of
+        heartbeat ops."""
+        now = self._clock()
+        with self._lock:
+            lease = self._leases.get(worker_id)
+            if lease is None:
+                lease = self._leases[worker_id] = Lease(worker_id, 0.0)
+            lease.deadline = now + self.lease_timeout
+
     def deregister(self, worker_id: int) -> None:
         """Clean exit: drop the lease without counting an eviction (the
         worker's reported retries stay in the run total)."""

@@ -322,6 +322,34 @@ class ResilientPSClient:
             return False
         return True
 
+    def join(self) -> dict | None:
+        """Elastic live-join admission, under the retry policy (a join
+        racing a shard failover reconnects and re-registers). Returns
+        the server's admission record, or None when the transport has no
+        join channel (plain legacy servers: the lease then starts with
+        the first heartbeat instead)."""
+        def op():
+            inner = self._client
+            join = getattr(inner, "join", None)
+            return None if join is None else join()
+
+        return self._run(op)
+
+    def drain(self, timeout: bool = False) -> None:
+        """Preemption drain (clean deregister + the server's elastic
+        counters), under the retry policy. Falls back to a plain
+        deregister on transports without a drain channel."""
+        def op():
+            inner = self._client
+            drain = getattr(inner, "drain", None)
+            if drain is not None:
+                return drain(timeout=timeout)
+            dereg = getattr(inner, "deregister", None)
+            if dereg is not None:
+                dereg()
+
+        self._run(op)
+
     def shard_map(self) -> dict | None:
         """Forward the shard-map handshake to the wrapped transport
         client (under the retry policy). Without this, a sharded center's

@@ -121,6 +121,23 @@ class ShardedPSClient:
                             if hasattr(c, "deregister") else None)
         )
 
+    def join(self) -> dict | None:
+        """Elastic live-join: register on EVERY shard (the pool is one
+        global membership; each shard tracks the same joins, exactly
+        like the lease set). Returns shard 0's admission record."""
+        out = self._scatter(
+            lambda c, sid: (c.join() if hasattr(c, "join") else None)
+        )
+        return out[0] if out else None
+
+    def drain(self, timeout: bool = False) -> None:
+        """Preemption drain fanned to every shard: each retires this
+        worker's dedup seqno and counts the drain in its own stats."""
+        self._scatter(
+            lambda c, sid: (c.drain(timeout=timeout)
+                            if hasattr(c, "drain") else None)
+        )
+
     def set_timeout(self, seconds: float | None) -> None:
         for c in self._clients:
             if hasattr(c, "set_timeout"):

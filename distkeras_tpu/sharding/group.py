@@ -442,8 +442,12 @@ def aggregate_ps_stats(per_shard: list[dict]) -> dict:
         "worker_retries", "fenced_commits", "wal_records", "wal_fsyncs",
         "pulls_per_sec", "commits_per_sec",
     )
+    # elastic-membership counters are maxed like the lease gauges: every
+    # shard sees the SAME global joins/drains through the fan-out, so
+    # summing would multiply one membership event by the shard count
     maxed = ("active_workers", "evicted_workers", "elapsed_s",
-             "wal_group_max")
+             "wal_group_max", "pool_size", "joined_workers",
+             "preempted_workers", "drain_timeouts")
     out: dict = {"num_shards": len(per_shard)}
     for k in summed:
         out[k] = sum(s.get(k, 0) for s in per_shard)

@@ -558,6 +558,10 @@ class DistributedTrainer(Trainer):
                  ps_failover_timeout: float | None = None,
                  ps_num_shards: int = 1,
                  ps_chain_length: int = 1,
+                 elastic: bool = False,
+                 autoscale_target=None,
+                 preempt_drain_timeout: float = 5.0,
+                 max_pool_size: int | None = None,
                  prefetch: int = 1, ema_decay: float | None = None,
                  clipnorm=None, clipvalue=None, validation_data=None):
         super().__init__(keras_model, loss, worker_optimizer,
@@ -846,6 +850,73 @@ class DistributedTrainer(Trainer):
                 "ps_standby is the pre-sharding single hot standby; with "
                 "ps_num_shards/ps_chain_length use ps_chain_length >= 2 "
                 "(chain replication subsumes it)"
+            )
+        # Elastic membership (distkeras_tpu/resilience/elastic.py;
+        # DESIGN.md "Elastic membership & autoscaling"):
+        # - elastic=True: the PS worker pool is DYNAMIC — data shards are
+        #   window blocks leased from a shared assigner (exactly-once per
+        #   epoch across membership changes), new workers live-join
+        #   mid-run, and a preempted worker drains cleanly (finish the
+        #   in-flight window, flush the commit, hand its blocks back,
+        #   deregister retiring its dedup seqno) instead of dying into a
+        #   restart budget.
+        # - autoscale_target: rounds/s the autoscaler tracks (or a full
+        #   ElasticPolicy) — under target it live-joins workers up to
+        #   max_pool_size, over target (or for persistent τ-tail
+        #   stragglers) it drains one.
+        # - preempt_drain_timeout: seconds a preempted worker gets to
+        #   drain before being force-drained (blocks released on its
+        #   behalf, drain reported with timeout=True, lease eviction as
+        #   backstop).
+        # - max_pool_size: autoscaler/join ceiling (default 2×workers).
+        self.elastic = bool(elastic)
+        self.autoscale_target = autoscale_target
+        self.preempt_drain_timeout = float(preempt_drain_timeout)
+        self.max_pool_size = (
+            None if max_pool_size is None else int(max_pool_size)
+        )
+        if self.elastic and backend != "ps":
+            raise ValueError(
+                "elastic=True applies to backend='ps' only (the "
+                "collective backend is one fixed SPMD program)"
+            )
+        if self.elastic and ps_host is not None:
+            raise ValueError(
+                "elastic=True manages the pool this trainer hosts; an "
+                "external ps_host owner runs its own elastic coordinator"
+            )
+        if self.elastic and worker_restart_budget:
+            raise ValueError(
+                "elastic=True and worker_restart_budget are mutually "
+                "exclusive: elastic membership replaces restart-in-place "
+                "(a preempted/dead worker's blocks go back to the pool; "
+                "scale-up goes through the live-join path)"
+            )
+        if not self.elastic:
+            if autoscale_target is not None:
+                raise ValueError(
+                    "autoscale_target requires elastic=True (the "
+                    "autoscaler grows/shrinks the pool through the "
+                    "live-join and drain paths)"
+                )
+            if max_pool_size is not None:
+                raise ValueError("max_pool_size requires elastic=True")
+        if isinstance(autoscale_target, (int, float)) \
+                and autoscale_target <= 0:
+            raise ValueError(
+                f"autoscale_target must be positive, got "
+                f"{autoscale_target}"
+            )
+        if self.preempt_drain_timeout <= 0:
+            raise ValueError(
+                f"preempt_drain_timeout must be positive, got "
+                f"{preempt_drain_timeout}"
+            )
+        if self.max_pool_size is not None \
+                and self.max_pool_size < self.num_workers:
+            raise ValueError(
+                f"max_pool_size ({max_pool_size}) must be >= num_workers "
+                f"({self.num_workers})"
             )
         if fault_plan is not None and getattr(
                 fault_plan, "kill_ps_after_commits", None) is not None:

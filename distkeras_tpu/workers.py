@@ -71,7 +71,9 @@ class AsyncWorker:
                  barrier: threading.Barrier | None = None,
                  ckpt_pred=None,
                  restore: dict | None = None, start_epoch: int = 0,
-                 tolerant: bool = False, codec=None, fault_plan=None):
+                 tolerant: bool = False, codec=None, fault_plan=None,
+                 assigner=None, drain_event: threading.Event | None = None,
+                 coordinator=None, joiner: bool = False):
         self.worker_id = worker_id
         self.device = device
         self.window_fn = window_fn
@@ -109,6 +111,20 @@ class AsyncWorker:
         # liveness tracks actual training progress (no extra threads).
         self.fault_plan = fault_plan
         self._windows_done = 0
+        # Elastic membership (resilience/elastic.py): with an `assigner`
+        # the worker ignores its static shard and leases window-sized
+        # blocks from the shared per-epoch pool instead — the loop that
+        # lets workers join and drain mid-run without dropping or
+        # double-training a single example. `drain_event` is the
+        # preemption notice (checked at window boundaries: finish the
+        # in-flight window, commit, hand blocks back, exit);
+        # `coordinator.on_window` fires the fault plan's seeded
+        # join/preempt events; `joiner=True` runs the live-join
+        # handshake (the `join` wire action) before the first pull.
+        self.assigner = assigner
+        self.drain_event = drain_event
+        self.coordinator = coordinator
+        self.joiner = bool(joiner)
 
     def _compress(self, tree):
         """→ (wire payload, transmitted tree); updates the residual."""
@@ -125,7 +141,14 @@ class AsyncWorker:
               shuffle: bool, seed: int) -> None:
         """Reference signature spirit: ``Worker.train(index, iterator)``."""
         try:
-            self._train(index, shard_cols, num_epoch, shuffle, seed)
+            if self.assigner is not None:
+                # elastic membership: shard_cols is the FULL column set;
+                # the shared assigner hands out window blocks instead of
+                # a static per-worker shard (epochs/shuffle/seed live in
+                # the assigner, built once by run_async_training)
+                self._train_elastic(shard_cols)
+            else:
+                self._train(index, shard_cols, num_epoch, shuffle, seed)
         except BaseException as e:  # surface thread failures to the driver
             self.error = e
             if self.barrier is not None:
@@ -181,39 +204,9 @@ class AsyncWorker:
                 )
                 batches = jax.device_put(batches, self.device)
                 params, nt, opt, loss = self.window_fn(params, nt, opt, batches)
-
-                if elastic:
-                    # pull a FRESH center at exchange time (reference EASGD
-                    # semantics), commit the elastic difference, keep own
-                    # variable moved toward the center — by the TRANSMITTED
-                    # difference, so worker and center stay symmetric under
-                    # lossy compression
-                    center = self.ps.pull(self.worker_id)
-                    host_params = utils.tree_to_numpy(params)
-                    diff = self.rule.worker_commit(host_params, center)
-                    blob, sent = self._compress(diff)
-                    self.ps.commit(self.worker_id, blob)
-                    params = jax.device_put(
-                        jax.tree.map(lambda p, d: p - d, host_params, sent),
-                        self.device,
-                    )
-                else:
-                    # commit window delta; re-base onto the fresh center
-                    delta = jax.tree.map(
-                        lambda p, c: np.asarray(p) - c,
-                        utils.tree_to_numpy(params), center,
-                    )
-                    blob, _ = self._compress(delta)
-                    self.ps.commit(self.worker_id, blob)
-                    center = self.ps.pull(self.worker_id)
-                    params = jax.device_put(center, self.device)
-
-                with self.lock:
-                    self.history.append({
-                        "loss": float(loss),
-                        "epoch": epoch,
-                        "worker": self.worker_id,
-                    })
+                params, center = self._exchange_window(
+                    params, center, loss, epoch, elastic
+                )
                 self._windows_done += 1
                 if maybe_heartbeat is not None:
                     maybe_heartbeat()  # rate-limited lease renewal
@@ -239,6 +232,116 @@ class AsyncWorker:
                     self.barrier = None
         self.final_nt = utils.tree_to_numpy(nt)
 
+    def _exchange_window(self, params, center, loss, epoch: int,
+                         elastic: bool):
+        """The per-window PS exchange, shared by the fixed-pool and
+        elastic loops (one code path for the commit math). Returns the
+        re-based ``(params, center)``."""
+        if elastic:
+            # pull a FRESH center at exchange time (reference EASGD
+            # semantics), commit the elastic difference, keep own
+            # variable moved toward the center — by the TRANSMITTED
+            # difference, so worker and center stay symmetric under
+            # lossy compression
+            center = self.ps.pull(self.worker_id)
+            host_params = utils.tree_to_numpy(params)
+            diff = self.rule.worker_commit(host_params, center)
+            blob, sent = self._compress(diff)
+            self.ps.commit(self.worker_id, blob)
+            params = jax.device_put(
+                jax.tree.map(lambda p, d: p - d, host_params, sent),
+                self.device,
+            )
+        else:
+            # commit window delta; re-base onto the fresh center
+            delta = jax.tree.map(
+                lambda p, c: np.asarray(p) - c,
+                utils.tree_to_numpy(params), center,
+            )
+            blob, _ = self._compress(delta)
+            self.ps.commit(self.worker_id, blob)
+            center = self.ps.pull(self.worker_id)
+            params = jax.device_put(center, self.device)
+
+        with self.lock:
+            self.history.append({
+                "loss": float(loss),
+                "epoch": epoch,
+                "worker": self.worker_id,
+            })
+        return params, center
+
+    def _train_elastic(self, cols: tuple) -> None:
+        """Elastic membership loop (resilience/elastic.py): lease window
+        blocks from the shared assigner until the run is out of work, a
+        preemption notice drains this worker, or a fault fires.
+
+        The live-join handshake is this method's preamble: ``join`` (the
+        wire action — lease admitted, pool/joined counters) followed by
+        the first ``pull``, which initializes this worker's server-side
+        pull-version so its first DynSGD commit carries the true small τ
+        — never the maximal-staleness price a version-less worker would
+        pay. The fresh seqno stream comes with the fresh client. Block
+        completion is confirmed AFTER the window's commit ACK, so a
+        clean drain hands back only genuinely untrained blocks."""
+        elastic_rule = isinstance(self.rule, ElasticAverageMerge)
+        maybe_heartbeat = getattr(self.ps, "maybe_heartbeat", None)
+        if self.joiner:
+            join = getattr(self.ps, "join", None)
+            if join is not None:
+                join()
+        if maybe_heartbeat is not None:
+            maybe_heartbeat()
+        center = self.ps.pull(self.worker_id)
+        params = jax.device_put(center, self.device)
+        nt = jax.device_put(self.nt, self.device)
+        opt = jax.jit(self.optimizer.init)(params)
+        drain = self.drain_event
+        stop = drain.is_set if drain is not None else None
+        try:
+            while True:
+                if drain is not None and drain.is_set():
+                    break  # preemption notice: in-flight window already
+                    # committed and confirmed — exit at the boundary
+                task = self.assigner.claim(self.worker_id, stop=stop)
+                if task is None:
+                    break
+                epoch, block, idx = task
+                if self.fault_plan is not None:
+                    self.fault_plan.maybe_kill(
+                        self.worker_id, self._windows_done
+                    )
+                batches = tuple(
+                    c[idx].reshape(
+                        (self.window, self.batch_size) + c.shape[1:]
+                    )
+                    for c in cols
+                )
+                batches = jax.device_put(batches, self.device)
+                params, nt, opt, loss = self.window_fn(
+                    params, nt, opt, batches
+                )
+                params, center = self._exchange_window(
+                    params, center, loss, epoch, elastic_rule
+                )
+                # the commit ACKed (durable when a WAL is on): the block
+                # is trained — confirm it before anything can drain us
+                self.assigner.complete(self.worker_id, epoch, block)
+                self._windows_done += 1
+                if maybe_heartbeat is not None:
+                    maybe_heartbeat()
+                if self.coordinator is not None:
+                    # seeded join/preempt chaos rides the same
+                    # (worker, completed-window-count) seam as kill_at
+                    self.coordinator.on_window(
+                        self.worker_id, self._windows_done
+                    )
+        finally:
+            # hand any leased-but-unconfirmed block back — the drain
+            # path for clean exits, the safety net for deaths
+            self.assigner.release(self.worker_id)
+        self.final_nt = utils.tree_to_numpy(nt)
+
 
 def run_async_training(trainer, ds, shuffle: bool):
     """Drive the PS backend for a DistributedTrainer (reference: the
@@ -252,12 +355,27 @@ def run_async_training(trainer, ds, shuffle: bool):
     params, nt = spec.init_np(trainer.seed)
     W = trainer.num_workers
 
+    # Elastic membership (resilience/elastic.py): dynamic pool — blocks
+    # leased from a shared assigner, live joins, preemption drains, the
+    # autoscaler. The fixed-pool machinery (static shards, epoch
+    # barriers, restart supervisor) is replaced by the coordinator.
+    elastic_mode = bool(getattr(trainer, "elastic", False))
+
     # Checkpoint/resume (parity with the collective backend): restore the PS
     # center + per-worker (params, opt, nt) saved at an epoch barrier.
     ckpt_dir = getattr(trainer, "checkpoint_dir", None)
     start_epoch = 0
     restores: list[dict | None] = [None] * W
     restored_updates = 0
+    if ckpt_dir and elastic_mode and not getattr(trainer, "resume", False):
+        import warnings
+
+        warnings.warn(
+            "elastic runs do not write epoch-barrier checkpoints (the "
+            "barrier assumes a fixed pool); checkpoint_dir is resume-only "
+            "under elastic=True",
+            stacklevel=2,
+        )
     if ckpt_dir and getattr(trainer, "resume", False):
         from distkeras_tpu import checkpoint as ckpt
 
@@ -265,7 +383,13 @@ def run_async_training(trainer, ds, shuffle: bool):
             payload, step = ckpt.restore_checkpoint(ckpt_dir)
             saved_workers = payload["workers"]
             params = payload["center"]
-            if len(saved_workers) == W:
+            if elastic_mode:
+                # elastic resume, always: the pool is dynamic, so the
+                # checkpointed center is the model and EVERY worker
+                # starts with fresh state from it — the same
+                # warn_elastic_resume contract both backends share
+                ckpt.warn_elastic_resume(len(saved_workers), W)
+            elif len(saved_workers) == W:
                 restores = list(saved_workers)
             else:
                 # elastic resume (same semantics as the collective
@@ -293,6 +417,14 @@ def run_async_training(trainer, ds, shuffle: bool):
         # a missed-5-heartbeats default: prompt eviction without flapping
         lease_timeout = 5.0 * float(hb_interval)
     fault_plan = getattr(trainer, "fault_plan", None)
+    if fault_plan is not None and not elastic_mode \
+            and getattr(fault_plan, "has_elastic_events", False):
+        raise ValueError(
+            "fault_plan carries join/preempt membership events but the "
+            "trainer is not elastic — set elastic=True (a fixed-pool run "
+            "never consults them, so the chaos would silently test "
+            "nothing)"
+        )
     # PS durability + failover knobs (resilience/wal.py, DESIGN.md):
     # ps_wal_dir turns on the write-ahead commit log (crash-restart
     # recovery); ps_standby adds a warm replica streaming applied commits;
@@ -570,27 +702,34 @@ def run_async_training(trainer, ds, shuffle: bool):
         )
         ps_supervisor.start()
 
-    if resilient and sharded_group is None:
-        # reconnect-and-retry with per-worker commit seqnos (dedup'd
-        # server-side) and piggyback lease heartbeats — resilience/retry.py
-        clients = [
-            ResilientPSClient(
-                lambda i=i: make_client(i), offset + i,
+    def build_client(i):
+        """One worker's FULLY-WIRED client (any id — the elastic
+        coordinator mints clients for live joiners too): the sharded
+        fan-out arrives wrapped from the group; otherwise the resilient
+        wrapper (reconnect + seqno dedup + heartbeats) goes on here."""
+        if sharded_group is not None:
+            # resilience lives per shard INSIDE the fan-out — see
+            # ShardedPSGroup.make_client
+            return make_client(i)
+        if resilient:
+            # reconnect-and-retry with per-worker commit seqnos (dedup'd
+            # server-side) + piggyback lease heartbeats — retry.py
+            return ResilientPSClient(
+                lambda: make_client(i), offset + i,
                 policy=retry_policy, heartbeat_interval=hb_interval,
                 resolver=ps_resolver,
             )
-            for i in range(W)
-        ]
-    else:
-        # sharded clients arrive fully wrapped (resilience lives per
-        # shard INSIDE the fan-out — see ShardedPSGroup.make_client)
-        clients = [make_client(i) for i in range(W)]
+        return make_client(i)
+
+    clients = [] if elastic_mode else [build_client(i) for i in range(W)]
 
     cols = trainer.features_col + [trainer.label_col]
-    shards = ds.worker_shards(
-        W, trainer.batch_size, trainer.communication_window, cols,
-        seed=trainer.seed if shuffle else None, cover_all=shuffle,
-    )  # tuple of [W, rows_pw, …]
+    shards = None
+    if not elastic_mode:
+        shards = ds.worker_shards(
+            W, trainer.batch_size, trainer.communication_window, cols,
+            seed=trainer.seed if shuffle else None, cover_all=shuffle,
+        )  # tuple of [W, rows_pw, …]
 
     if restored_updates and ps is not None \
             and not getattr(ps, "recovered_", False):
@@ -609,7 +748,7 @@ def run_async_training(trainer, ds, shuffle: bool):
     barrier = None
     snap_client = None
     ckpt_pred = None
-    if ckpt_dir:
+    if ckpt_dir and not elastic_mode:
         from distkeras_tpu import checkpoint as ckpt
 
         every = int(getattr(trainer, "checkpoint_every", 1))
@@ -659,26 +798,98 @@ def run_async_training(trainer, ds, shuffle: bool):
 
         barrier = threading.Barrier(W, action=_checkpoint_action)
 
-    workers = [
-        AsyncWorker(
-            i, devices[i % len(devices)], window_fn, optimizer,
-            clients[i], rule, trainer.communication_window,
-            trainer.batch_size, nt, history, hlock,
-            barrier=barrier, ckpt_pred=ckpt_pred,
-            restore=restores[i], start_epoch=start_epoch,
-            tolerant=getattr(trainer, "tolerate_worker_failures", False),
-            codec=codec, fault_plan=fault_plan,
+    supervisor = None
+    coordinator = None
+    restart_budget = int(getattr(trainer, "worker_restart_budget", 0))
+    if elastic_mode:
+        # Elastic pool (resilience/elastic.py): the coordinator owns the
+        # worker set — initial workers, live joiners (fault-plan events
+        # or the autoscaler), preemption drains against a deadline — and
+        # the shared ShardAssigner owns the data: window blocks leased
+        # per epoch, confirmed after the window's commit, handed back on
+        # drain. Every example trains exactly once per epoch across any
+        # clean membership schedule (the oracle in tests/test_elastic).
+        from distkeras_tpu.resilience.elastic import (
+            ElasticCoordinator,
+            ElasticPolicy,
+            ShardAssigner,
         )
-        for i in range(W)
-    ]
+
+        cols_full = tuple(np.asarray(ds[c]) for c in cols)
+        assigner = ShardAssigner(
+            len(ds), trainer.communication_window, trainer.batch_size,
+            trainer.num_epoch, seed=trainer.seed, shuffle=shuffle,
+            start_epoch=start_epoch,
+        )
+        max_pool = getattr(trainer, "max_pool_size", None)
+        if max_pool is None:
+            max_pool = 2 * W  # joins need headroom; unbounded is a footgun
+        target = getattr(trainer, "autoscale_target", None)
+        if isinstance(target, ElasticPolicy):
+            policy = target
+        elif target is not None:
+            policy = ElasticPolicy(
+                target_rounds_per_sec=float(target),
+                max_workers=int(max_pool),
+            )
+        else:
+            policy = None
+
+        def _spawn(worker_id, is_joiner):
+            client = build_client(worker_id)
+            w = AsyncWorker(
+                worker_id, devices[worker_id % len(devices)], window_fn,
+                optimizer, client, rule, trainer.communication_window,
+                trainer.batch_size, nt, history, hlock,
+                tolerant=getattr(trainer, "tolerate_worker_failures",
+                                 False),
+                codec=codec, fault_plan=fault_plan,
+                assigner=assigner, drain_event=threading.Event(),
+                coordinator=coordinator, joiner=is_joiner,
+            )
+            t = threading.Thread(
+                target=w.train,
+                args=(worker_id, cols_full, trainer.num_epoch, shuffle,
+                      trainer.seed),
+                daemon=True, name=f"distkeras-elastic-{worker_id}",
+            )
+            t.start()
+            return w, client, t
+
+        coordinator = ElasticCoordinator(
+            assigner, _spawn, make_drain_client=build_client,
+            fault_plan=fault_plan, policy=policy,
+            drain_timeout=float(
+                getattr(trainer, "preempt_drain_timeout", 5.0)
+            ),
+            max_pool_size=int(max_pool),
+        )
+        coordinator.start(list(range(W)))
+        coordinator.run()
+        workers = coordinator.all_workers()
+        clients = coordinator.all_clients()
+    else:
+        workers = [
+            AsyncWorker(
+                i, devices[i % len(devices)], window_fn, optimizer,
+                clients[i], rule, trainer.communication_window,
+                trainer.batch_size, nt, history, hlock,
+                barrier=barrier, ckpt_pred=ckpt_pred,
+                restore=restores[i], start_epoch=start_epoch,
+                tolerant=getattr(trainer, "tolerate_worker_failures",
+                                 False),
+                codec=codec, fault_plan=fault_plan,
+            )
+            for i in range(W)
+        ]
 
     def _args_of(i):
         return (i, tuple(col[i] for col in shards), trainer.num_epoch,
                 shuffle, trainer.seed)
 
-    restart_budget = int(getattr(trainer, "worker_restart_budget", 0))
-    supervisor = None
-    if restart_budget > 0:
+    if elastic_mode:
+        pass  # the coordinator already drove the run to completion
+    elif restart_budget > 0:
         # restart-with-budget recovery (resilience/recovery.py): a dead
         # worker relaunches from its latest snapshot (or the on-disk
         # checkpoint's entry, or a fresh center pull) up to K times
@@ -743,7 +954,8 @@ def run_async_training(trainer, ds, shuffle: bool):
     # chaos tests), client retry/reconnect totals, supervisor restarts,
     # and what the fault plan actually injected.
     trainer.resilience_stats_ = None
-    if resilient or supervisor is not None or fault_plan is not None:
+    if resilient or supervisor is not None or fault_plan is not None \
+            or coordinator is not None:
         trainer.resilience_stats_ = {
             "logical_commits": sum(
                 int(getattr(c, "seq", 0)) for c in clients
@@ -762,15 +974,28 @@ def run_async_training(trainer, ds, shuffle: bool):
                 if sharded_group is not None and shard_supervised
                 else None
             ),
+            # elastic membership: joins/drains/timeouts + the assigner's
+            # exactly-once ledger (resilience/elastic.py)
+            "elastic": (coordinator.stats() if coordinator is not None
+                        else None),
         }
 
-    errors = [w.error for w in workers if w.error is not None]
+    def _surfaced_error(w):
+        # a timeout-drained worker was given up on — whatever its
+        # abandoned thread raised afterward is expected fallout
+        # (recorded in the elastic stats), not a run failure
+        if coordinator is not None:
+            return coordinator.worker_error(w)
+        return w.error
+
+    errors = [e for w in workers
+              if (e := _surfaced_error(w)) is not None]
     if errors:
         # a BrokenBarrierError is a symptom of a peer's failure — surface the
         # root cause first (and BEFORE any final PS round-trip: a dead
         # external PS must not mask the workers' own errors)
         errors.sort(key=lambda e: isinstance(e, threading.BrokenBarrierError))
-        survivors = sum(1 for w in workers if w.error is None)
+        survivors = sum(1 for w in workers if _surfaced_error(w) is None)
         fatal = (not getattr(trainer, "tolerate_worker_failures", False)
                  or survivors == 0)  # tolerated, but nobody survived
         if fatal:
@@ -792,7 +1017,7 @@ def run_async_training(trainer, ds, shuffle: bool):
         import warnings
 
         warnings.warn(
-            f"{len(errors)} of {W} PS workers failed "
+            f"{len(errors)} of {len(workers)} PS workers failed "
             f"({type(errors[0]).__name__}: {errors[0]}); center trained by "
             f"the {survivors} survivors",
             stacklevel=2,
@@ -892,6 +1117,14 @@ class _BoundPS:
 
     def deregister(self) -> None:
         self._ps.deregister_worker(self.worker_id)
+
+    def join(self) -> dict:
+        rec = self._ps.join_worker(self.worker_id)
+        rec["ok"] = True
+        return rec
+
+    def drain(self, timeout: bool = False) -> None:
+        self._ps.drain_worker(self.worker_id, timeout=timeout)
 
     def close(self):
         pass

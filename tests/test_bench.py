@@ -106,6 +106,31 @@ def test_ps_group_commit_sweep_contract():
     assert rec["durable_fraction_w8"] == rec["legs"]["w8"]["durable_fraction"]
 
 
+def test_ps_elastic_bench_contract():
+    """--chaos's elastic leg (ISSUE 9): the join + preempt sweep record
+    carries the three phases with positive rates, the live join/drain
+    pool counters, the ±1-worker tracking verdict with its host-ceiling
+    honesty fields, and the exactly-once dedup oracle."""
+    out = bench.run_ps_elastic_bench(n_params=16_384, workers=2,
+                                     join_workers=1, seconds=0.9,
+                                     pace_s=0.01)
+    rec = out["ps_elastic_socket"]
+    assert [p["name"] for p in rec["phases"]] == [
+        "base", "joined", "drained"]
+    assert [p["pool"] for p in rec["phases"]] == [2, 3, 2]
+    for p in rec["phases"]:
+        assert p["rounds_per_sec"] > 0, p
+    assert rec["dedup_exact_once"]
+    assert rec["pool_stats"]["joined_workers"] == 1
+    assert rec["pool_stats"]["preempted_workers"] == 1
+    assert rec["pool_stats"]["drain_timeouts"] == 0
+    assert rec["pool_stats"]["pool_size"] == 2  # back to base after drain
+    assert rec["host_cores"] >= 1
+    assert isinstance(rec["tracking_within_one_worker"], bool)
+    # a failed tracking verdict is only acceptable when host-ceiling-capped
+    assert rec["tracking_within_one_worker"] or rec["host_ceiling_limited"]
+
+
 def test_analytic_flop_models():
     # hand-checked reference points (training = 3× forward)
     assert bench.mlp_flops((784, 500, 300, 10)) == 3 * 2 * (

@@ -1,0 +1,619 @@
+"""Elastic scale-out: live join, preemption drain, autoscaler (ISSUE 9).
+
+The acceptance oracle threaded through this file: under seeded mid-run
+JOINS and PREEMPTIONS a PS run must (a) complete, (b) converge below the
+no-fault first-epoch loss, (c) train every example exactly once per epoch
+across every membership boundary (the ShardAssigner ledger), and (d) fold
+every logical commit exactly once per shard (``num_updates`` == logical
+commits — joiners' fresh seqno streams and drained workers' retired
+seqnos included). Pool membership must be visible in ``ps.stats()``
+(``pool_size`` / ``joined_workers`` / ``preempted_workers`` /
+``drain_timeouts``) on every transport.
+"""
+
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.parallel.merge_rules import DownpourMerge, DynSGDMerge
+from distkeras_tpu.parameter_servers import (
+    ParameterServer,
+    ParameterServerClient,
+    SocketParameterServer,
+)
+from distkeras_tpu.resilience import (
+    ElasticCoordinator,
+    ElasticPolicy,
+    FaultPlan,
+    RetryPolicy,
+    ShardAssigner,
+)
+from tests.test_trainers import blobs_dataset, model_spec
+
+
+def epoch_mean_loss(trainer, epoch):
+    """Mean loss over one epoch's windows. Elastic histories are hogwild
+    ACROSS epochs: a drained straggler's early-epoch window can be the
+    last record appended (its commit sat in retries while the survivors
+    finished), so 'last N records' is not a convergence metric here."""
+    return float(np.mean([
+        r["loss"] for r in trainer.get_history()
+        if "loss" in r and r.get("epoch") == epoch
+    ]))
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: deterministic join/preempt events
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_join_preempt_fire_once_each():
+    plan = FaultPlan(join_worker_at_window={0: 2},
+                     preempt_worker_at_window={1: 4})
+    assert plan.has_elastic_events
+    assert not plan.take_join(0, 1)       # not yet
+    assert not plan.take_join(1, 2)       # wrong worker
+    assert plan.take_join(0, 2)           # fires
+    assert not plan.take_join(0, 2)       # once only (a replay is safe)
+    assert not plan.take_preempt(1, 2)
+    assert plan.take_preempt(1, 4)
+    assert not plan.take_preempt(1, 4)
+    s = plan.stats()
+    assert s["joins"] == 1 and s["preempts"] == 1
+    assert not FaultPlan(kill_at={0: 1}).has_elastic_events
+
+
+def test_fault_plan_event_ordering_is_window_deterministic():
+    """Events key on (worker, completed-window count) — the same seam as
+    kill_at — so replaying the window sequence replays the event order
+    exactly."""
+    plan = FaultPlan(join_worker_at_window={0: 1},
+                     preempt_worker_at_window={0: 3})
+    order = []
+    for w in range(1, 5):
+        if plan.take_join(0, w):
+            order.append(("join", w))
+        if plan.take_preempt(0, w):
+            order.append(("preempt", w))
+    assert order == [("join", 1), ("preempt", 3)]
+
+
+# ---------------------------------------------------------------------------
+# ShardAssigner: the exactly-once-per-epoch oracle
+# ---------------------------------------------------------------------------
+
+
+def test_assigner_fixed_pool_exactly_once_with_full_coverage():
+    a = ShardAssigner(n_rows=64, window=2, batch_size=4, num_epoch=2,
+                      seed=3, shuffle=True)
+    assert a.blocks_per_epoch == 8
+    seen: dict[int, list] = {0: [], 1: []}
+    while True:
+        task = a.claim(0)
+        if task is None:
+            break
+        e, b, idx = task
+        seen[e].append(idx)
+        a.complete(0, e, b)
+    o = a.oracle()
+    assert o["exactly_once"] and o["blocks_done"] == 16
+    for e in (0, 1):
+        rows = np.concatenate(seen[e])
+        assert len(rows) == len(set(rows.tolist())) == 64  # no dup, no drop
+        np.testing.assert_array_equal(np.sort(rows), np.arange(64))
+    # shuffle: the two epochs draw different orders from (seed, epoch)
+    assert not np.array_equal(np.concatenate(seen[0]),
+                              np.concatenate(seen[1]))
+
+
+def test_assigner_exactly_once_across_join_and_drain():
+    """The membership-change oracle: worker 0 starts, worker 1 joins
+    mid-epoch, worker 0 is drained holding an in-flight block — the
+    block goes back and worker 1 finishes it. No example dropped or
+    duplicated."""
+    a = ShardAssigner(n_rows=48, window=1, batch_size=8, num_epoch=1)
+    covered = []
+    e0, b0, idx0 = a.claim(0)
+    # worker 0 trains one block to completion...
+    a.complete(0, e0, b0)
+    covered.append(idx0)
+    # ...claims another, then is drained BEFORE confirming it
+    _, b_hold, _ = a.claim(0)
+    assert a.release(0) == 1              # the unconfirmed block goes back
+    assert a.oracle()["released_blocks"] == 1
+    # worker 1 joins and drains the rest of the pool — including b_hold
+    blocks_seen = set()
+    while True:
+        task = a.claim(1)
+        if task is None:
+            break
+        e, b, idx = task
+        blocks_seen.add(b)
+        covered.append(idx)
+        a.complete(1, e, b)
+    assert b_hold in blocks_seen          # the handed-back range retrained
+    o = a.oracle()
+    assert o["exactly_once"], o
+    rows = np.concatenate(covered)
+    np.testing.assert_array_equal(np.sort(rows), np.arange(48))
+
+
+def test_assigner_claim_blocks_until_release_then_drains():
+    """A worker whose pool is all in-flight WAITS (the holder might drain
+    and hand blocks back) instead of dropping work or spinning out."""
+    a = ShardAssigner(n_rows=8, window=1, batch_size=8, num_epoch=1)
+    assert a.blocks_per_epoch == 1
+    a.claim(0)                            # worker 0 holds the only block
+    got = []
+
+    def waiter():
+        got.append(a.claim(1))
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.15)
+    assert not got                        # parked, not None
+    a.release(0)                          # worker 0 drains
+    t.join(timeout=5)
+    assert got and got[0] is not None     # the waiter inherited the block
+    e, b, _ = got[0]
+    a.complete(1, e, b)
+    assert a.claim(1) is None             # now genuinely done
+    assert a.oracle()["exactly_once"]
+
+
+def test_assigner_stale_completion_after_forced_release():
+    """A timeout-drained worker's late complete() is refused and counted:
+    the block belongs to its new owner, and the ledger honestly reports
+    the at-least-once window."""
+    a = ShardAssigner(n_rows=16, window=1, batch_size=8, num_epoch=1)
+    e, b, _ = a.claim(0)
+    a.release(0)                          # forced release (drain deadline)
+    assert a.complete(0, e, b) is False   # the zombie's confirm bounces
+    e1, b1, _ = a.claim(1)
+    assert (e1, b1) == (e, b)
+    a.complete(1, e1, b1)
+    task = a.claim(1)
+    a.complete(1, task[0], task[1])
+    o = a.oracle()
+    assert o["stale_completions"] == 1 and not o["exactly_once"]
+
+
+def test_assigner_respects_start_epoch():
+    a = ShardAssigner(n_rows=16, window=1, batch_size=8, num_epoch=3,
+                      start_epoch=2)
+    epochs = set()
+    while True:
+        task = a.claim(0)
+        if task is None:
+            break
+        epochs.add(task[0])
+        a.complete(0, task[0], task[1])
+    assert epochs == {2}
+
+
+# ---------------------------------------------------------------------------
+# ElasticPolicy: the autoscaler's decisions
+# ---------------------------------------------------------------------------
+
+
+def test_policy_grows_under_target_and_shrinks_over_it():
+    p = ElasticPolicy(target_rounds_per_sec=10.0, max_workers=4,
+                      cooldown_s=0.0)
+    assert p.observe(0.0, {0: 0, 1: 0}) == []        # baseline sample
+    # 4 rounds/s total < 8.5 → join
+    assert p.observe(1.0, {0: 2, 1: 2}) == [("join", None)]
+    # 20 rounds/s total > 13 → release the slowest
+    assert p.observe(2.0, {0: 14, 1: 10, 2: 0}) == [("release", 2)]
+    assert [d["action"] for d in p.decisions] == ["join", "release"]
+
+
+def test_policy_releases_persistent_straggler_only_after_patience():
+    p = ElasticPolicy(patience=2, cooldown_s=0.0)    # no target: τ-tail only
+    p.observe(0.0, {0: 0, 1: 0, 2: 0})
+    assert p.observe(1.0, {0: 10, 1: 10, 2: 0}) == []   # 1 slow obs: wait
+    assert p.observe(2.0, {0: 20, 1: 20, 2: 0}) == [("release", 2)]
+    # a recovered worker resets its patience counter
+    p2 = ElasticPolicy(patience=2, cooldown_s=0.0)
+    p2.observe(0.0, {0: 0, 1: 0})
+    p2.observe(1.0, {0: 10, 1: 0})
+    p2.observe(2.0, {0: 20, 1: 10})                   # caught back up
+    assert p2.observe(3.0, {0: 30, 1: 10}) == []      # counter restarted
+
+
+def test_policy_cooldown_and_max_workers():
+    p = ElasticPolicy(target_rounds_per_sec=100.0, max_workers=2,
+                      cooldown_s=10.0)
+    p.observe(0.0, {0: 0})
+    assert p.observe(1.0, {0: 1}) == [("join", None)]
+    assert p.observe(2.0, {0: 2, 1: 0}) == []         # in cooldown
+    assert p.observe(13.0, {0: 3, 1: 1}) == []        # at max_workers
+    with pytest.raises(ValueError, match="max_workers"):
+        ElasticPolicy(min_workers=3, max_workers=2)
+
+
+# ---------------------------------------------------------------------------
+# The join/drain protocol + pool stats, per transport
+# ---------------------------------------------------------------------------
+
+
+def test_join_and_drain_counters_inprocess():
+    ps = ParameterServer({"w": np.zeros(2, np.float32)}, DownpourMerge(), 2)
+    s = ps.stats()
+    assert s["pool_size"] == 2 and s["joined_workers"] == 0
+    rec = ps.join_worker(5)
+    assert rec["pool_size"] == 3
+    assert ps._registry.active() == [5]   # leased, quietly
+    assert ps.stats()["heartbeats"] == 0  # join is NOT a heartbeat
+    ps.drain_worker(5)
+    s = ps.stats()
+    assert s["pool_size"] == 2
+    assert s["joined_workers"] == 1 and s["preempted_workers"] == 1
+    assert s["drain_timeouts"] == 0 and s["evicted_workers"] == 0
+    ps.drain_worker(7, timeout=True)      # the force-drain path
+    s = ps.stats()
+    assert s["drain_timeouts"] == 1 and s["preempted_workers"] == 2
+
+
+def test_join_and_drain_are_lost_ack_replay_safe():
+    """The membership analogue of commit seqno dedup: join/drain ride
+    lossy links, and a retried action whose ACK died must not
+    double-count the event — until the wid's membership actually flips
+    again (drain → join → drain all recount)."""
+    ps = ParameterServer({"w": np.zeros(2, np.float32)}, DownpourMerge(), 2)
+    ps.join_worker(4)
+    ps.join_worker(4)                     # replay: no double-count
+    s = ps.stats()
+    assert s["joined_workers"] == 1 and s["pool_size"] == 3
+    ps.drain_worker(4)
+    ps.drain_worker(4)                    # replay: no double-count
+    s = ps.stats()
+    assert s["preempted_workers"] == 1 and s["pool_size"] == 2
+    ps.join_worker(4)                     # a REAL re-join counts again
+    ps.drain_worker(4)
+    s = ps.stats()
+    assert s["joined_workers"] == 2 and s["preempted_workers"] == 2
+    assert s["pool_size"] == 2
+
+
+def test_join_and_drain_over_socket_wire_retires_dedup_seqno():
+    ps = SocketParameterServer({"w": np.zeros(2, np.float32)},
+                               DownpourMerge(), 1)
+    ps.initialize()
+    ps.start()
+    try:
+        c = ParameterServerClient("127.0.0.1", ps.port, 3)
+        rec = c.join()
+        assert rec["ok"] and rec["pool_size"] == 2
+        c.commit(3, {"w": np.ones(2, np.float32)}, seq=9)
+        assert 3 in ps._last_seq
+        c.drain(timeout=False)
+        assert 3 not in ps._last_seq      # the PR 5 bounded-table path
+        s = ps.stats()
+        assert s["pool_size"] == 1
+        assert s["joined_workers"] == 1 and s["preempted_workers"] == 1
+        c.close()
+    finally:
+        ps.stop()
+
+
+def test_native_join_drain_protocol_parity():
+    """The C++ transport speaks JOIN/DRAIN (actions 12/13) with the same
+    pool accounting and the same stats key set as the Python PS."""
+    from distkeras_tpu.native import load_dkps
+
+    if load_dkps() is None:
+        pytest.skip("no C++ toolchain to build libdkps")
+    from distkeras_tpu.native_ps import (
+        NativePSClient,
+        NativeSocketParameterServer,
+    )
+
+    center = {"w": np.zeros(4, np.float32)}
+    ps = NativeSocketParameterServer(center, DownpourMerge(), 2)
+    ps.initialize()
+    ps.start()
+    try:
+        c = NativePSClient("127.0.0.1", ps.port, 6, ps.spec)
+        rec = c.join()
+        assert rec["pool_size"] == 3 and rec["num_updates"] == 0
+        assert ps.stats()["heartbeats"] == 0      # quiet admission
+        c.commit(6, {"w": np.ones(4, np.float32)}, seq=1)
+        c.drain(timeout=False)
+        s = ps.stats()
+        assert s["pool_size"] == 2
+        assert s["joined_workers"] == 1 and s["preempted_workers"] == 1
+        assert s["drain_timeouts"] == 0
+        py = ParameterServer(center, DownpourMerge(), 2)
+        assert set(s) == set(py.stats())          # key-set parity
+        c.close()
+    finally:
+        ps.stop()
+
+
+def test_joiner_dynsgd_tau_priced_from_join_pull_never_zero_version():
+    """The live-join staleness contract: the joiner pulls AT JOIN, so its
+    first commit is priced at the true small τ — not the maximal
+    staleness a version-less worker would be charged."""
+    ps = ParameterServer({"w": np.zeros(1, np.float32)}, DynSGDMerge(), 2)
+    for _ in range(4):                    # incumbent trains: center = 16
+        ps.pull(0)
+        ps.commit(0, {"w": np.array([4.0], np.float32)})
+    ps.join_worker(1)
+    ps.pull(1)                            # pull-version initialized: 4
+    ps.commit(1, {"w": np.array([5.0], np.float32)})   # τ = 0 → +5/1
+    np.testing.assert_allclose(ps.get_model()["w"], 16.0 + 5.0)
+    # contrast — a worker that NEVER pulled is priced at τ = num_updates
+    ps2 = ParameterServer({"w": np.zeros(1, np.float32)}, DynSGDMerge(), 2)
+    for _ in range(4):
+        ps2.pull(0)
+        ps2.commit(0, {"w": np.array([4.0], np.float32)})
+    ps2.commit(1, {"w": np.array([5.0], np.float32)})  # τ = 4 → +5/5
+    np.testing.assert_allclose(ps2.get_model()["w"], 16.0 + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# ElasticCoordinator: the drain state machine (stub workers)
+# ---------------------------------------------------------------------------
+
+
+class _StubClient:
+    def __init__(self):
+        self.drains: list[bool] = []
+        self.closed = False
+
+    def drain(self, timeout=False):
+        self.drains.append(bool(timeout))
+
+    def close(self):
+        self.closed = True
+
+
+def _stub_spawn_factory(bodies):
+    """spawn() over plain threads: bodies[wid](worker) is the 'training
+    loop'."""
+    def spawn(wid, joiner):
+        class W:
+            drain_event = threading.Event()
+            error = None
+            _windows_done = 0
+
+        w = W()
+        c = _StubClient()
+        t = threading.Thread(target=bodies[wid], args=(w,), daemon=True)
+        t.start()
+        return w, c, t
+
+    return spawn
+
+
+def test_coordinator_clean_drain_reports_and_settles():
+    a = ShardAssigner(n_rows=8, window=1, batch_size=8, num_epoch=1)
+
+    def cooperative(w):
+        w.drain_event.wait(10)            # exits promptly on the notice
+
+    co = ElasticCoordinator(
+        a, _stub_spawn_factory({0: cooperative}), drain_timeout=5.0,
+        poll_interval=0.02,
+    )
+    co.start([0])
+    assert co.request_preempt(0)
+    assert not co.request_preempt(0)      # idempotent while draining
+    co.run()
+    s = co.stats()
+    assert s["preempted"] == 1 and s["drain_timeouts"] == 0
+    assert co.clients[0].drains == [False]
+    assert not co.clients[0].closed       # common shutdown path owns close
+
+
+def test_coordinator_drain_deadline_falls_back_to_force_drain():
+    a = ShardAssigner(n_rows=8, window=1, batch_size=8, num_epoch=1)
+    a.claim(0)                            # the wedged worker holds a block
+    unwedge = threading.Event()
+    admin = _StubClient()
+
+    def wedged(w):
+        unwedge.wait(30)                  # ignores the drain notice
+
+    co = ElasticCoordinator(
+        a, _stub_spawn_factory({0: wedged}),
+        make_drain_client=lambda wid: admin,
+        drain_timeout=0.2, poll_interval=0.02,
+    )
+    co.start([0])
+    co.request_preempt(0)
+    co.run()                              # abandoned thread excluded
+    s = co.stats()
+    assert s["drain_timeouts"] == 1 and s["preempted"] == 1
+    assert admin.drains == [True]         # reported with timeout=True
+    assert admin.closed
+    assert co.clients[0].closed           # torn out from under the wedge
+    # the wedged worker's shard range went back to the pool
+    assert a.oracle()["blocks_in_flight"] == 0
+    assert a.claim(1) is not None
+    # whatever the abandoned worker raises later is not a run failure
+    co.workers[0].error = RuntimeError("post-abandon fallout")
+    assert co.worker_error(co.workers[0]) is None
+    unwedge.set()
+
+
+# ---------------------------------------------------------------------------
+# Trainer integration
+# ---------------------------------------------------------------------------
+
+
+def _kw(**extra):
+    kw = dict(loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+              learning_rate=0.05, num_workers=2, batch_size=16,
+              communication_window=2, num_epoch=2, backend="ps")
+    kw.update(extra)
+    return kw
+
+
+def test_elastic_trainer_live_join_and_clean_preempt():
+    """A join and a preemption on the in-process transport: the joiner
+    contributes history, the drained worker leaves cleanly, the
+    exactly-once ledger and the pool counters all agree."""
+    ds = blobs_dataset(n=1024)
+    # threshold 1 fires unconditionally: a live worker always completes
+    # >= 1 window (it holds a claimed block its peers wait on), while a
+    # higher threshold can starve under 1-core thread scheduling
+    plan = FaultPlan(seed=3, join_worker_at_window={0: 1},
+                     preempt_worker_at_window={1: 1})
+    t = dk.DOWNPOUR(model_spec(), **_kw(elastic=True, fault_plan=plan,
+                                        heartbeat_interval=0.1))
+    t.train(ds, shuffle=True)
+    el = t.resilience_stats_["elastic"]
+    assert el["joined"] == 1 and el["preempted"] == 1
+    assert el["drain_timeouts"] == 0
+    assert el["assigner"]["exactly_once"], el["assigner"]
+    s = t.ps_stats_
+    assert s["joined_workers"] == 1 and s["preempted_workers"] == 1
+    assert s["pool_size"] == 2            # 2 + 1 join − 1 drain
+    # every logical commit folded exactly once (joiner + drainee incl.)
+    assert s["commits"] == t.resilience_stats_["logical_commits"]
+    workers_seen = {r.get("worker") for r in t.get_history() if "loss" in r}
+    assert 2 in workers_seen              # the joiner trained for real
+    assert epoch_mean_loss(t, 1) < 0.6
+
+
+def test_elastic_autoscaler_joins_toward_target():
+    """An unreachably-high rounds/s target makes the autoscaler grow the
+    pool through the live-join path up to max_pool_size."""
+    ds = blobs_dataset(n=2048)
+    policy = ElasticPolicy(target_rounds_per_sec=1e6, max_workers=3,
+                           cooldown_s=0.0)
+    t = dk.DOWNPOUR(model_spec(), **_kw(elastic=True,
+                                        autoscale_target=policy,
+                                        max_pool_size=3))
+    t.train(ds, shuffle=True)
+    el = t.resilience_stats_["elastic"]
+    assert el["joined"] >= 1
+    assert any(d["reason"] == "under_target"
+               for d in el["policy_decisions"])
+    assert el["assigner"]["exactly_once"]
+    assert t.ps_stats_["joined_workers"] == el["joined"]
+
+
+def test_elastic_resume_reconciles_with_warn_elastic_resume(tmp_path):
+    """The checkpoint.py reconcile: an elastic trainer resuming any
+    checkpoint takes the elastic-resume path (center carries over, fresh
+    per-worker state, warn_elastic_resume fired) and trains only the
+    remaining epochs — exactly once each."""
+    ds = blobs_dataset(n=512)
+    t1 = dk.DOWNPOUR(model_spec(), **_kw(num_epoch=1,
+                                         checkpoint_dir=str(tmp_path)))
+    t1.train(ds, shuffle=True)
+    t2 = dk.DOWNPOUR(model_spec(), **_kw(num_workers=4, num_epoch=2,
+                                         elastic=True,
+                                         checkpoint_dir=str(tmp_path),
+                                         resume=True))
+    with pytest.warns(UserWarning, match="elastic resume"):
+        t2.train(ds, shuffle=True)
+    el = t2.resilience_stats_["elastic"]
+    assert el["assigner"]["epochs"] == 1  # only epoch 1 remained
+    assert el["assigner"]["exactly_once"]
+    epochs = {r["epoch"] for r in t2.get_history() if "loss" in r}
+    assert epochs == {1}
+    # and an elastic run does not WRITE barrier checkpoints
+    t3 = dk.DOWNPOUR(model_spec(), **_kw(elastic=True, num_epoch=1,
+                                         checkpoint_dir=str(tmp_path)))
+    with pytest.warns(UserWarning, match="resume-only"):
+        t3.train(ds, shuffle=True)
+
+
+def test_elastic_knob_validation():
+    with pytest.raises(ValueError, match="backend='ps'"):
+        dk.ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+                worker_optimizer="sgd", num_workers=2, elastic=True)
+    with pytest.raises(ValueError, match="autoscale_target requires"):
+        dk.ADAG(model_spec(), **_kw(autoscale_target=10.0))
+    with pytest.raises(ValueError, match="max_pool_size requires"):
+        dk.ADAG(model_spec(), **_kw(max_pool_size=4))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        dk.ADAG(model_spec(), **_kw(elastic=True, worker_restart_budget=1))
+    with pytest.raises(ValueError, match="preempt_drain_timeout"):
+        dk.ADAG(model_spec(), **_kw(elastic=True, preempt_drain_timeout=0))
+    with pytest.raises(ValueError, match="must be >= num_workers"):
+        dk.ADAG(model_spec(), **_kw(elastic=True, max_pool_size=1))
+    # a plan carrying membership events needs an elastic trainer
+    plan = FaultPlan(join_worker_at_window={0: 1})
+    t = dk.ADAG(model_spec(), **_kw(fault_plan=plan))
+    with pytest.raises(ValueError, match="join/preempt"):
+        t.train(blobs_dataset(n=512), shuffle=True)
+
+
+# ---------------------------------------------------------------------------
+# The chaos integration test (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cls_name,shards", [
+    ("ADAG", 1), ("DOWNPOUR", 2), ("DynSGD", 1),
+])
+def test_elastic_chaos_converges_exactly_once(cls_name, shards, tmp_path):
+    """ADAG/DOWNPOUR/DynSGD under elastic chaos — a seeded mid-run JOIN
+    and PREEMPTION plus wire drops/delays, socket transport, WAL on, the
+    2-shard leg included — must complete, converge below the no-fault
+    first-epoch loss, satisfy the every-example-exactly-once ledger, and
+    fold every logical commit exactly once PER SHARD (no double-folds
+    from joiners or drained workers)."""
+    cls = getattr(dk, cls_name)
+    ds = blobs_dataset(n=1024)
+
+    # no-fault baseline: its FIRST-epoch loss is the convergence bar
+    base = cls(model_spec(), **_kw())
+    base.train(ds, shuffle=True)
+    first_epoch = epoch_mean_loss(base, 0)
+
+    # threshold-1 events (>= semantics) fire unconditionally: a live
+    # worker always completes >= 1 window (it holds a claimed block its
+    # peers wait on), even when the wire chaos concentrates its retry
+    # stalls on the event's observer
+    plan = FaultPlan(seed=13, drop_recv=0.03, delay=0.03, delay_s=0.002,
+                     max_faults=40,
+                     join_worker_at_window={0: 1},
+                     preempt_worker_at_window={1: 1})
+    t = cls(model_spec(), **_kw(
+        ps_transport="socket", ps_num_shards=shards,
+        ps_wal_dir=str(tmp_path / "wal"), elastic=True, fault_plan=plan,
+        retry_policy=RetryPolicy(base_delay=0.005, max_delay=0.1,
+                                 deadline=60),
+        heartbeat_interval=0.05,
+    ))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with plan:
+            t.train(ds, shuffle=True)
+
+    # (a) completed with the membership chaos actually injected
+    st = plan.stats()
+    assert st["joins"] == 1 and st["preempts"] == 1
+    assert st["drops"] > 0                # the wire chaos bit too
+    rs = t.resilience_stats_
+    el = rs["elastic"]
+    assert el["joined"] == 1 and el["preempted"] == 1
+    assert el["drain_timeouts"] == 0      # the drain beat its deadline
+    # (b) converged: the chaos run's FINAL epoch beats the clean run's
+    # first epoch (per-epoch means — see epoch_mean_loss)
+    chaos_final = epoch_mean_loss(t, 1)
+    assert chaos_final < first_epoch, (chaos_final, first_epoch)
+    # (c) every example exactly once per epoch across the join/drain
+    assert el["assigner"]["exactly_once"], el["assigner"]
+    # (d) exactly-once folds per shard: lifetime fold count == logical
+    # commits, on EVERY shard (min == max for the sharded leg)
+    s = t.ps_stats_
+    assert s["num_updates"] == rs["logical_commits"]
+    if shards > 1:
+        assert s["num_updates"] == s["num_updates_max"]
+    # pool membership visible through the stats roll-up
+    assert s["joined_workers"] == 1 and s["preempted_workers"] == 1
+    assert s["drain_timeouts"] == 0
+    # the joiner contributed post-join history
+    workers_seen = {r.get("worker") for r in t.get_history() if "loss" in r}
+    assert 2 in workers_seen

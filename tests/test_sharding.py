@@ -314,6 +314,53 @@ def test_chain_replication_two_successive_failovers_bit_identical():
         single.stop()
 
 
+def test_sharded_live_join_exactly_once_per_shard():
+    """Elastic live-join against a 2-shard group (ISSUE 9): the joiner's
+    fan-out client passes verify_shard_map on EVERY shard, its join
+    registers on every shard's pool, and its commits land exactly once
+    per shard (num_updates min == max == total logical commits)."""
+    tree = _model_tree(seed=3)
+    group = ShardedPSGroup(copy.deepcopy(tree), DownpourMerge(), 1,
+                           num_shards=2, transport="socket")
+    group.initialize()
+    group.start()
+    c0 = group.make_client(0, resilient=True)
+    c1 = None
+    try:
+        for _ in range(3):
+            c0.pull()
+            c0.commit(0, _full(tree, 0.1))
+        # a NEW worker joins mid-run: fresh fan-out client (shard map
+        # verified against the plan at construction), fresh per-shard
+        # seqno streams, live-join admission on every shard
+        c1 = group.make_client(1, resilient=True)
+        c1.verify_shard_map()             # explicit: every shard agrees
+        rec = c1.join()
+        assert rec["pool_size"] == 2
+        c1.pull()                         # τ base initialized per shard
+        for _ in range(2):
+            c1.pull()
+            c1.commit(1, _full(tree, 0.1))
+        s = group.stats()
+        # membership rolled up (maxed, not summed — every shard saw the
+        # SAME join through the fan-out)
+        assert s["pool_size"] == 2 and s["joined_workers"] == 1
+        # exactly-once per shard: every shard folded all 5 commits
+        assert s["num_updates"] == s["num_updates_max"] == 5
+        assert c0.seq == 3 and c1.seq == 2
+        # the joiner drains back out: per-shard dedup seqno retired
+        c1.drain(timeout=False)
+        s = group.stats()
+        assert s["preempted_workers"] == 1 and s["pool_size"] == 1
+        for srv in group.servers:
+            assert 1 not in srv._last_seq
+    finally:
+        c0.close()
+        if c1 is not None:
+            c1.close()
+        group.stop()
+
+
 # -- trainer integration -----------------------------------------------------
 
 
