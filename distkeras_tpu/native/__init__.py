@@ -74,6 +74,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dkps_server_restore_worker.restype = None
     lib.dkps_server_restore_worker.argtypes = [
         ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64,
     ]
     lib.dkps_server_destroy.restype = None
     lib.dkps_server_destroy.argtypes = [ctypes.c_void_p]
@@ -125,6 +126,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.dkps_client_fence.restype = ctypes.c_int64
     lib.dkps_client_fence.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.dkps_client_exchange.restype = ctypes.c_int64
+    lib.dkps_client_exchange.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint8, ctypes.c_uint64, ctypes.c_uint64,
+        f32p, f32p, ctypes.POINTER(ctypes.c_uint64),
+    ]
     lib.dkps_server_set_shard.restype = None
     lib.dkps_server_set_shard.argtypes = [
         ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,

@@ -69,14 +69,25 @@
 //              true small tau),
 //              13=DRAIN (u8 timeout flag: preemption drain — clean
 //              deregister retiring the dedup seqno, plus the elastic
-//              counters; timeout=1 records a deadline-lapsed drain)
+//              counters; timeout=1 records a deadline-lapsed drain),
+//              14=EXCHANGE (u8 flags [bit0 seq, bit1 epoch, bit2 int8
+//              reply, bit3 lag] + optional u64 epoch + optional u64 seq
+//              + n*4 payload: the FUSED commit+pull — one round trip
+//              folds the commit and answers with the fresh post-fold
+//              center, halving the per-window wire cost of the classic
+//              commit-then-pull pair; `lag` prices DynSGD tau from the
+//              worker's PREVIOUS pull version, the pipelined worker's
+//              honest one-window staleness)
 //   reply:     PULL -> u64 center_version + n*4 bytes; COMMIT -> u8 ack;
 //              PULL_INT8 -> u64 version + u32 nblocks + nblocks*f32 scales
 //              + n int8 bytes; HEARTBEAT -> u8 (1 = renewed, 2 =
 //              (re-)registered); COMMIT_SEQ -> u8 (1 = folded, 2 =
 //              duplicate, dropped); DEREGISTER -> u8 ack; FENCE -> u8
 //              ack + u64 epoch-now; COMMIT_SEQ_E -> u8 (1 = folded, 2 =
-//              duplicate, 3 = FENCED — not folded) + u64 server epoch
+//              duplicate, 3 = FENCED — not folded) + u64 server epoch;
+//              EXCHANGE -> u8 (1/2/3 as COMMIT_SEQ_E) + u64 server epoch
+//              + unless fenced: u64 version + the PULL (or PULL_INT8)
+//              reply payload
 //
 // Concurrency model matches the reference: accept loop + one handler thread
 // per connection + one mutex around the center. The difference is what runs
@@ -303,6 +314,13 @@ struct Server {
   std::mutex mu;
   uint64_t num_updates = 0;
   std::unordered_map<uint32_t, uint64_t> pull_versions;
+  // The PREVIOUS recorded pull version per worker (ISSUE 10): every
+  // pull-version record shifts cur -> prev. A pipelined fused EXCHANGE
+  // (action 14, lag flag) prices DynSGD tau from prev — the delta it
+  // commits was computed from the center returned one exchange ago, and
+  // that deliberate extra window of staleness must be priced. Under mu;
+  // replay reconstructs it with the identical shift rule.
+  std::unordered_map<uint32_t, uint64_t> prev_pull_versions;
   // Per-worker compressed-pull quantization residual (error feedback): the
   // part of center+e the int8 wire dropped, re-added to that worker's next
   // compressed pull so its received stream telescopes to the true center
@@ -739,6 +757,7 @@ struct Server {
       std::lock_guard<std::mutex> g(mu);
       for (uint32_t wid : dead) {
         pull_versions.erase(wid);
+        prev_pull_versions.erase(wid);
         // retire the commit-dedup entry too (parity with the Python
         // _on_evict): long elastic runs with many worker generations
         // must not grow last_seq without bound
@@ -776,9 +795,15 @@ struct Server {
       leases.erase(wid);
     }
     // retire the seqno fence too (fresh clients start a new epoch; the
-    // fence would only grow the map) — lease_mu released before mu
+    // fence would only grow the map) — lease_mu released before mu.
+    // Pull-version slots (cur AND prev) retire with the clean exit: a
+    // same-id successor's first pull must not shift this generation's
+    // version into prev, where a lag-priced exchange would read it
+    // (parity with the Python deregister_worker).
     std::lock_guard<std::mutex> g(mu);
     last_seq.erase(wid);
+    pull_versions.erase(wid);
+    prev_pull_versions.erase(wid);
     if (wal_on) wal_append_dereg_locked(wid);
   }
 
@@ -828,6 +853,7 @@ struct Server {
   // snapshot, commit fold) — admin reads (get_center etc.) stay
   // unlogged, same as the Python side.
   std::atomic<uint64_t> st_pulls{0}, st_cpulls{0}, st_commits{0};
+  std::atomic<uint64_t> st_fused{0};  // fused EXCHANGE ops served
   std::atomic<uint64_t> st_bytes_in{0}, st_bytes_out{0};
   std::atomic<uint64_t> st_lock_acquires{0}, st_lock_wait_ns{0},
       st_lock_hold_ns{0};
@@ -863,6 +889,68 @@ struct Server {
     }
   };
 
+  // Block-quantize center snapshot `c` plus the worker's EF residual
+  // `err` (updated in place) into qbuf/pscales — the ONE int8 pull
+  // encode, shared by PULL_INT8 and the fused EXCHANGE reply so the two
+  // wires cannot drift on the tie rule, the subnormal guard, or the
+  // residual math. Call under the worker's PullErr mutex.
+  void encode_int8_blocks(const float* c, std::vector<float>& err,
+                          std::vector<int8_t>& qbuf,
+                          std::vector<float>& pscales) {
+    const uint64_t nb = pull_blocks(n);
+    if (err.size() != n) err.assign(n, 0.0f);
+    for (uint64_t b = 0; b < nb; ++b) {
+      const uint64_t lo = b * kPullBlock;
+      const uint64_t hi = std::min(lo + kPullBlock, n);
+      float amax = 0.0f;
+      for (uint64_t i = lo; i < hi; ++i) {
+        const float v = c[i] + err[i];
+        err[i] = v;  // stage v; residual subtracted below
+        const float a = v < 0 ? -v : v;
+        amax = a > amax ? a : amax;
+      }
+      const float scale = amax > 0 ? amax / 127.0f : 0.0f;
+      pscales[b] = scale;
+      // Subnormal-scale guard (parity with the Python encode's
+      // degenerate path): for a tiny block, 1/scale overflows to inf
+      // and a zero element would make qf = 0·inf = NaN, which the
+      // clamp passes through into an undefined int8 cast. Sending
+      // zeros keeps the whole block in the residual instead — the EF
+      // stream still telescopes, with defined behavior.
+      const float inv = scale >= FLT_MIN ? 1.0f / scale : 0.0f;
+      for (uint64_t i = lo; i < hi; ++i) {
+        const float v = err[i];
+        float qf = v * inv;
+        qf = qf < -127.0f ? -127.0f : (qf > 127.0f ? 127.0f : qf);
+        // branchless round-half-away (std::lround is a per-element
+        // libm call that blocks auto-vectorization; EF absorbs the
+        // half-ulp tie-rule difference vs rint)
+        qf += qf >= 0.0f ? 0.5f : -0.5f;
+        const int8_t q = static_cast<int8_t>(qf);
+        qbuf[i] = q;
+        err[i] = v - scale * static_cast<float>(q);
+      }
+    }
+  }
+
+  // Undo one encode whose reply never reached the client: restore
+  // err_old = v − c from err = v − scale·q (qbuf/pscales/c must be
+  // exactly what the encode saw). Without this, a reconnecting worker's
+  // EF stream would silently absorb one phantom pull — bounded (≤ half
+  // a step per element) but avoidable. Same PullErr mutex as the encode.
+  void rollback_int8_blocks(const float* c, std::vector<float>& err,
+                            const std::vector<int8_t>& qbuf,
+                            const std::vector<float>& pscales) {
+    const uint64_t nb = pull_blocks(n);
+    for (uint64_t b = 0; b < nb; ++b) {
+      const uint64_t lo = b * kPullBlock;
+      const uint64_t hi = std::min(lo + kPullBlock, n);
+      const float scale = pscales[b];
+      for (uint64_t i = lo; i < hi; ++i)
+        err[i] += scale * static_cast<float>(qbuf[i]) - c[i];
+    }
+  }
+
   // EMA fold after a commit landed in the center — call under mu
   void ema_fold_locked() {
     if (ema_decay < 0) return;
@@ -879,18 +967,37 @@ struct Server {
     return it != pull_versions.end() ? it->second : 0;
   }
 
-  // fold scale for one commit from conn_wid_'s staleness — call under mu
-  float fold_scale_locked() {
-    float s = static_cast<float>(fixed_scale);
-    if (mode == MODE_INV_STALENESS) {
-      uint64_t pv = 0;
-      auto it = pull_versions.find(conn_wid_);
-      if (it != pull_versions.end()) pv = it->second;
-      uint64_t tau = num_updates - pv;
-      s = static_cast<float>(1.0 / (static_cast<double>(tau) + 1.0));
+  // the pull version one commit from conn_wid_ is priced from — call
+  // under mu. `lag` (the pipelined fused exchange) reads the PREVIOUS
+  // recorded version, falling back to the current one when no previous
+  // record exists yet (a worker's first exchange after its initial pull,
+  // or after a recovery that predates its prev record).
+  uint64_t priced_pv_locked(bool lag) {
+    if (lag) {
+      auto it = prev_pull_versions.find(conn_wid_);
+      if (it != prev_pull_versions.end()) return it->second;
     }
-    return s;
+    auto it = pull_versions.find(conn_wid_);
+    return it != pull_versions.end() ? it->second : 0;
   }
+
+  float scale_from_pv_locked(uint64_t pv) {
+    if (mode != MODE_INV_STALENESS) return static_cast<float>(fixed_scale);
+    uint64_t tau = num_updates - pv;
+    return static_cast<float>(1.0 / (static_cast<double>(tau) + 1.0));
+  }
+
+  // record conn_wid_'s pull version at the current update count, with
+  // the cur -> prev shift every pull-version record performs — call
+  // under mu (PULL, PULL_INT8, and the EXCHANGE fused pull half)
+  void record_pull_locked() {
+    auto it = pull_versions.find(conn_wid_);
+    if (it != pull_versions.end()) prev_pull_versions[conn_wid_] = it->second;
+    pull_versions[conn_wid_] = num_updates;
+  }
+
+  // fold scale for one commit from conn_wid_'s staleness — call under mu
+  float fold_scale_locked() { return scale_from_pv_locked(priced_pv_locked(false)); }
 
   void handle(int fd) {
     std::vector<float> buf(n);
@@ -902,6 +1009,10 @@ struct Server {
     std::vector<float> pscales;  // compressed-pull per-block scales
     std::vector<float> wbuf;     // durable int8 commits: dequantized
                                  // payload staged off-lock for the WAL
+    std::vector<float> obuf;     // EXCHANGE reply scratch: the commit
+                                 // payload in `buf` stays pinned for the
+                                 // zero-copy WAL wait, so the fused pull
+                                 // snapshot needs its own buffer
     for (;;) {
       uint8_t action;
       if (!recv_all(fd, &action, 1)) break;
@@ -914,7 +1025,7 @@ struct Server {
           version = num_updates;
           // staleness bookkeeping, exactly the Python PS's pull():
           // tau at the next commit = center updates since this pull
-          pull_versions[conn_wid_] = num_updates;
+          record_pull_locked();
           if (wal_on) wal_append_pull_locked(conn_wid_, num_updates);
           std::memcpy(buf.data(), center.data(), n * sizeof(float));
         }
@@ -935,65 +1046,20 @@ struct Server {
         {
           StatGuard g(this);
           version = num_updates;
-          pull_versions[conn_wid_] = num_updates;  // same staleness
+          record_pull_locked();                    // same staleness
           if (wal_on) wal_append_pull_locked(conn_wid_, num_updates);
           pe = &pull_errors[conn_wid_];            // bookkeeping as PULL
           std::memcpy(buf.data(), center.data(), n * sizeof(float));
         }
         std::lock_guard<std::mutex> wg(pe->m);
-        std::vector<float>& err = pe->err;
-        if (err.size() != n) err.assign(n, 0.0f);
-        const float* c = buf.data();
-        for (uint64_t b = 0; b < nb; ++b) {
-          const uint64_t lo = b * kPullBlock;
-          const uint64_t hi = std::min(lo + kPullBlock, n);
-          float amax = 0.0f;
-          for (uint64_t i = lo; i < hi; ++i) {
-            const float v = c[i] + err[i];
-            err[i] = v;  // stage v; residual subtracted below
-            const float a = v < 0 ? -v : v;
-            amax = a > amax ? a : amax;
-          }
-          const float scale = amax > 0 ? amax / 127.0f : 0.0f;
-          pscales[b] = scale;
-          // Subnormal-scale guard (parity with the Python encode's
-          // degenerate path): for a tiny block, 1/scale overflows to inf
-          // and a zero element would make qf = 0·inf = NaN, which the
-          // clamp passes through into an undefined int8 cast. Sending
-          // zeros keeps the whole block in the residual instead — the EF
-          // stream still telescopes, with defined behavior.
-          const float inv = scale >= FLT_MIN ? 1.0f / scale : 0.0f;
-          for (uint64_t i = lo; i < hi; ++i) {
-            const float v = err[i];
-            float qf = v * inv;
-            qf = qf < -127.0f ? -127.0f : (qf > 127.0f ? 127.0f : qf);
-            // branchless round-half-away (std::lround is a per-element
-            // libm call that blocks auto-vectorization; EF absorbs the
-            // half-ulp tie-rule difference vs rint)
-            qf += qf >= 0.0f ? 0.5f : -0.5f;
-            const int8_t q = static_cast<int8_t>(qf);
-            qbuf[i] = q;
-            err[i] = v - scale * static_cast<float>(q);
-          }
-        }
+        encode_int8_blocks(buf.data(), pe->err, qbuf, pscales);
         uint32_t nb32 = static_cast<uint32_t>(nb);
         if (!send_all(fd, &version, 8) || !send_all(fd, &nb32, 4) ||
             !send_all(fd, pscales.data(), nb * sizeof(float)) ||
             !send_all(fd, qbuf.data(), n)) {
-          // Dropped reply: the client never received this blob, so roll
-          // the residual back to its pre-pull state (err_old = v − c;
-          // err currently holds v − scale·q and qbuf/pscales/buf still
-          // hold q, the scales, and the center snapshot). Without this,
-          // a reconnecting worker's EF stream would silently absorb one
-          // phantom pull — bounded (≤ half a step per element) but
-          // avoidable. Still under the worker mutex (wg).
-          for (uint64_t b = 0; b < nb; ++b) {
-            const uint64_t lo = b * kPullBlock;
-            const uint64_t hi = std::min(lo + kPullBlock, n);
-            const float scale = pscales[b];
-            for (uint64_t i = lo; i < hi; ++i)
-              err[i] += scale * static_cast<float>(qbuf[i]) - c[i];
-          }
+          // dropped reply: the client never received this blob — roll
+          // the residual back to its pre-pull state (still under wg)
+          rollback_int8_blocks(buf.data(), pe->err, qbuf, pscales);
           break;
         }
         st_cpulls += 1;
@@ -1245,6 +1311,108 @@ struct Server {
         drain_wid(conn_wid_, timed_out != 0);
         uint8_t ack = 1;
         if (!send_all(fd, &ack, 1)) break;
+      } else if (action == 14) {  // EXCHANGE: fused commit + pull
+        // One round trip folds the commit and answers with the fresh
+        // post-fold center (ISSUE 10) — the wire fusion of COMMIT_SEQ_E
+        // + PULL(_INT8). flags: bit0 seq, bit1 epoch, bit2 int8 reply,
+        // bit3 lag (price tau from the PREVIOUS pull version — the
+        // pipelined worker's delta is one exchange stale). A duplicate
+        // seq skips the fold but still gets the pull half; a fenced
+        // exchange gets neither.
+        uint8_t flags;
+        if (!recv_all(fd, &flags, 1)) break;
+        const bool has_seq = flags & 1, has_epoch = flags & 2;
+        const bool want_int8 = flags & 4, lag = flags & 8;
+        uint64_t epoch = 0, seq = 0;
+        if (has_epoch && !recv_all(fd, &epoch, 8)) break;
+        if (has_seq && !recv_all(fd, &seq, 8)) break;
+        if (!recv_all(fd, buf.data(), n * sizeof(float))) break;
+        const uint32_t pcrc =
+            wal_on ? adler32_buf(buf.data(), n * sizeof(float)) : 0;
+        std::vector<char> staged;  // window 0: payload copy, OFF the mutex
+        if (wal_on && wal.window == 0) {
+          const char* pb = reinterpret_cast<const char*>(buf.data());
+          staged.assign(pb, pb + n * sizeof(float));
+        }
+        if (obuf.size() != n) obuf.resize(n);
+        const uint64_t nb = pull_blocks(n);
+        if (want_int8) {
+          if (qbuf.size() != n) qbuf.resize(n);
+          if (pscales.size() != nb) pscales.resize(nb);
+        }
+        bool dup = false, fenced = false;
+        uint64_t server_epoch, version = 0, tok = 0;
+        PullErr* pe = nullptr;
+        {
+          StatGuard g(this);
+          server_epoch = fence_epoch;
+          fenced = has_epoch && epoch != fence_epoch;
+          if (!fenced) {
+            if (has_seq) {
+              uint64_t& last = last_seq[conn_wid_];
+              dup = seq <= last;
+              if (!dup) last = seq;
+            }
+            if (!dup) {
+              const uint64_t pv = priced_pv_locked(lag);
+              const float s = scale_from_pv_locked(pv);
+              float* c = center.data();
+              const float* d = buf.data();
+              for (uint64_t i = 0; i < n; ++i) c[i] += d[i] * s;
+              ema_fold_locked();
+              num_updates += 1;
+              if (wal_on)
+                tok = wal_append_commit_locked(
+                    conn_wid_, has_seq ? static_cast<int64_t>(seq) : -1,
+                    pv, num_updates, s, d, n, pcrc,
+                    wal.window == 0 ? &staged : nullptr);
+            }
+            // fused pull half — applied AND duplicate commits get it (a
+            // lost-ACK replay still needs a fresh center, and recording
+            // its version is exactly what a retried pull would do)
+            record_pull_locked();
+            version = num_updates;
+            if (wal_on) wal_append_pull_locked(conn_wid_, num_updates);
+            if (want_int8) pe = &pull_errors[conn_wid_];
+            std::memcpy(obuf.data(), center.data(), n * sizeof(float));
+          }
+        }
+        if (fenced) {
+          st_fenced += 1;
+        } else if (dup) {
+          st_dups += 1;
+        } else {
+          st_commits += 1;
+        }
+        st_bytes_in += n * sizeof(float);
+        if (tok && wal.window >= 1 && !wal_wait(tok)) break;  // crashed
+        uint8_t ack = fenced ? 3 : (dup ? 2 : 1);
+        if (!send_all(fd, &ack, 1)) break;
+        if (!send_all(fd, &server_epoch, 8)) break;
+        if (fenced) continue;
+        if (!send_all(fd, &version, 8)) break;
+        if (!want_int8) {
+          if (!send_all(fd, obuf.data(), n * sizeof(float))) break;
+          st_pulls += 1;
+          st_bytes_out += n * sizeof(float);
+          st_fused += 1;
+        } else {
+          // block-quantize obuf + this worker's EF residual — the SAME
+          // encode/rollback helpers as PULL_INT8, so the fused and
+          // standalone compressed-pull wires cannot drift
+          std::lock_guard<std::mutex> wg(pe->m);
+          encode_int8_blocks(obuf.data(), pe->err, qbuf, pscales);
+          uint32_t nb32 = static_cast<uint32_t>(nb);
+          if (!send_all(fd, &nb32, 4) ||
+              !send_all(fd, pscales.data(), nb * sizeof(float)) ||
+              !send_all(fd, qbuf.data(), n)) {
+            rollback_int8_blocks(obuf.data(), pe->err, qbuf, pscales);
+            break;
+          }
+          st_cpulls += 1;
+          st_bytes_out += nb * sizeof(float) + n;
+          st_fused += 1;
+        }
       } else if (action == 11) {  // SHARD_INFO: shard-map handshake
         // reply: u32 shard_id, u32 num_shards (0 = unsharded), u64
         // fence_epoch — the sharded client verifies it is wired to the
@@ -1282,6 +1450,8 @@ struct Server {
 
   void record_pull_version(uint32_t wid) {
     std::lock_guard<std::mutex> g(mu);
+    auto it = pull_versions.find(wid);
+    if (it != pull_versions.end()) prev_pull_versions[wid] = it->second;
     pull_versions[wid] = num_updates;
   }
 };
@@ -1504,12 +1674,12 @@ void dkps_server_record_pull(void* h, uint32_t wid) {
 }
 
 // Contention/throughput counters (parity with the Python PS's stats()).
-// Fills out[21]: pulls, compressed_pulls, commits, bytes_in, bytes_out,
+// Fills out[22]: pulls, compressed_pulls, commits, bytes_in, bytes_out,
 // center_lock_acquires, center_lock_wait_ns, center_lock_hold_ns,
 // dup_commits, active_workers, evicted_workers, heartbeats,
 // worker_retries, fenced_commits, wal_records, wal_fsyncs,
 // wal_group_max, pool_size, joined_workers, preempted_workers,
-// drain_timeouts. Runs a FORCED expiry pass first (a stats read must see
+// drain_timeouts, fused_exchanges. Runs a FORCED expiry pass first (a stats read must see
 // already-lapsed leases as evicted — no rate-limit window); the counter
 // reads stay lock-free atomics and may lag in-flight ops by one —
 // telemetry semantics, same as the Python side.
@@ -1543,6 +1713,7 @@ void dkps_server_stats(void* h, uint64_t* out) {
   out[18] = s->st_joined.load();
   out[19] = s->st_preempted.load();
   out[20] = s->st_drain_to.load();
+  out[21] = s->st_fused.load();
 }
 
 // Elastic pool gauge base (resilience/elastic.py): the wrapper sets the
@@ -1567,17 +1738,21 @@ int dkps_server_set_ema(void* h, const float* in) {
   return 0;
 }
 
-// Per-worker recovered state: last applied commit seqno (-1 = none) and
-// recorded pull version (-1 = none) — the dedup fence and the DynSGD
-// staleness base must survive a restart, or a replayed pre-crash commit
-// double-folds / gets mispriced.
+// Per-worker recovered state: last applied commit seqno (-1 = none),
+// recorded pull version (-1 = none), and the PREVIOUS pull version
+// (-1 = none; the pipelined exchange's lag-pricing base) — the dedup
+// fence and the DynSGD staleness bases must survive a restart, or a
+// replayed pre-crash commit double-folds / gets mispriced.
 void dkps_server_restore_worker(void* h, uint32_t wid, int64_t last_seq,
-                                int64_t pull_version) {
+                                int64_t pull_version,
+                                int64_t prev_pull_version) {
   auto* s = static_cast<Server*>(h);
   std::lock_guard<std::mutex> g(s->mu);
   if (last_seq >= 0) s->last_seq[wid] = static_cast<uint64_t>(last_seq);
   if (pull_version >= 0)
     s->pull_versions[wid] = static_cast<uint64_t>(pull_version);
+  if (prev_pull_version >= 0)
+    s->prev_pull_versions[wid] = static_cast<uint64_t>(prev_pull_version);
 }
 
 // fencing-epoch admin (parity with ParameterServer.fence / fence_epoch);
@@ -1842,6 +2017,61 @@ int64_t dkps_client_pull_int8(void* h, float* out) {
   if (!send_all(c->fd, &action, 1) || !recv_all(c->fd, &version, 8) ||
       !recv_all(c->fd, &nb, 4) || nb != expect_nb)
     return -1;
+  std::vector<float> scales(nb);
+  std::vector<int8_t> q(c->n);
+  if (!recv_all(c->fd, scales.data(), nb * sizeof(float)) ||
+      !recv_all(c->fd, q.data(), c->n))
+    return -1;
+  for (uint64_t b = 0; b < nb; ++b) {
+    const uint64_t lo = b * kPullBlock;
+    const uint64_t hi = std::min(lo + kPullBlock, c->n);
+    const float s = scales[b];
+    for (uint64_t i = lo; i < hi; ++i)
+      out[i] = s * static_cast<float>(q[i]);
+  }
+  return static_cast<int64_t>(version);
+}
+
+// fused exchange (action 14): fold the commit and read the fresh
+// post-fold center in ONE round trip. flags: bit0 carry `seq` (dedup),
+// bit1 carry `epoch` (fencing), bit2 int8 pull reply, bit3 lag (price
+// tau from the previous pull version — the pipelined worker's honest
+// staleness). Returns the post-fold center version (>= 0; duplicate
+// folds return the fresh center too), -2 = FENCED (not folded; the
+// server's epoch lands in *server_epoch), -1 = transport failure.
+int64_t dkps_client_exchange(void* h, uint8_t flags, uint64_t epoch,
+                             uint64_t seq, const float* commit, float* out,
+                             uint64_t* server_epoch) {
+  auto* c = static_cast<Client*>(h);
+  char header[1 + 1 + 8 + 8];
+  size_t hl = 0;
+  header[hl++] = 14;
+  header[hl++] = static_cast<char>(flags);
+  if (flags & 2) {
+    std::memcpy(header + hl, &epoch, 8);
+    hl += 8;
+  }
+  if (flags & 1) {
+    std::memcpy(header + hl, &seq, 8);
+    hl += 8;
+  }
+  uint8_t ack = 0;
+  uint64_t sepoch = 0, version = 0;
+  if (!send_all(c->fd, header, hl) ||
+      !send_all(c->fd, commit, c->n * sizeof(float)) ||
+      !recv_all(c->fd, &ack, 1) || !recv_all(c->fd, &sepoch, 8) ||
+      (ack != 1 && ack != 2 && ack != 3))
+    return -1;
+  if (server_epoch) *server_epoch = sepoch;
+  if (ack == 3) return -2;
+  if (!recv_all(c->fd, &version, 8)) return -1;
+  if (!(flags & 4)) {
+    if (!recv_all(c->fd, out, c->n * sizeof(float))) return -1;
+    return static_cast<int64_t>(version);
+  }
+  uint32_t nb;
+  const uint64_t expect_nb = pull_blocks(c->n);
+  if (!recv_all(c->fd, &nb, 4) || nb != expect_nb) return -1;
   std::vector<float> scales(nb);
   std::vector<int8_t> q(c->n);
   if (!recv_all(c->fd, scales.data(), nb * sizeof(float)) ||

@@ -234,12 +234,15 @@ class NativeSocketParameterServer:
         self._lib.dkps_server_set_num_updates(
             self._handle, int(state["num_updates"])
         )
-        wids = set(state["pull_versions"]) | set(state["last_seq"])
+        prev = state.get("prev_pull_versions", {})
+        wids = set(state["pull_versions"]) | set(state["last_seq"]) \
+            | set(prev)
         for wid in wids:
             self._lib.dkps_server_restore_worker(
                 self._handle, int(wid),
                 int(state["last_seq"].get(wid, -1)),
                 int(state["pull_versions"].get(wid, -1)),
+                int(prev.get(wid, -1)),
             )
         if self.ema_decay is not None and state.get("ema") is not None:
             ema_vec = np.ascontiguousarray(self.spec.flatten(state["ema"]))
@@ -359,12 +362,12 @@ class NativeSocketParameterServer:
         the time since ``initialize()``."""
         from distkeras_tpu.parameter_servers import build_ps_stats
 
-        raw = (ctypes.c_uint64 * 21)()
+        raw = (ctypes.c_uint64 * 22)()
         self._lib.dkps_server_stats(self._handle, raw)
         (pulls, cpulls, commits, bytes_in, bytes_out, acq, wait, hold,
          dups, active, evicted, heartbeats, retries, fenced,
          wal_records, wal_fsyncs, wal_group_max, pool, joined,
-         preempted, drain_to) = (
+         preempted, drain_to, fused) = (
             int(v) for v in raw)
         return build_ps_stats(
             pulls, cpulls, commits, bytes_in, bytes_out, acq, wait, hold,
@@ -375,7 +378,7 @@ class NativeSocketParameterServer:
             wal_records=wal_records, wal_fsyncs=wal_fsyncs,
             wal_group_max=wal_group_max, pool_size=pool,
             joined_workers=joined, preempted_workers=preempted,
-            drain_timeouts=drain_to,
+            drain_timeouts=drain_to, fused_exchanges=fused,
         )
 
     # -- fencing (protocol parity with the Python PS) ------------------------
@@ -519,6 +522,49 @@ class NativePSClient:
             return
         if self._lib.dkps_client_commit(self._handle, _f32p(vec)) != 0:
             raise ConnectionError("dkps commit failed (server gone?)")
+
+    def exchange(self, worker_id: int | None, payload: Pytree,
+                 seq: int | None = None, lag: bool = False) -> Pytree:
+        """Fused commit + pull (EXCHANGE, action 14): one round trip
+        folds ``payload`` and returns the fresh post-fold center — the
+        pull reply rides the same compressed wire when
+        ``pull_compression='int8'``. Codec-encoded (segmented int8)
+        commits have no fused frame; they fall back to the 2-RTT
+        ``commit(); pull()`` pair, which keeps the semantics while the
+        raw-f32 wire (the resilient path's only wire) gets the fusion."""
+        from distkeras_tpu.parallel.compression import is_encoded
+
+        if is_encoded(payload):
+            self.commit(worker_id, payload, seq=seq)
+            return self.pull()
+        vec = np.ascontiguousarray(self.spec.flatten(payload))
+        out = np.empty(self.spec.n, dtype=np.float32)
+        flags = 0
+        if seq is not None:
+            flags |= 1
+        if self.epoch is not None:
+            flags |= 2
+        if self.pull_compression == "int8":
+            flags |= 4
+        if lag:
+            flags |= 8
+        sepoch = ctypes.c_uint64(0)
+        rc = self._lib.dkps_client_exchange(
+            self._handle, flags,
+            0 if self.epoch is None else int(self.epoch),
+            0 if seq is None else int(seq),
+            _f32p(vec), _f32p(out), ctypes.byref(sepoch),
+        )
+        if rc == -2:
+            from distkeras_tpu.networking import FencedEpochError
+
+            raise FencedEpochError(
+                "exchange fenced by the native server",
+                client_epoch=self.epoch, server_epoch=int(sepoch.value),
+            )
+        if rc < 0:
+            raise ConnectionError("dkps exchange failed (server gone?)")
+        return self.spec.unflatten(out)
 
     def heartbeat(self, retries: int = 0) -> bool:
         """Renew this worker's liveness lease (HEARTBEAT, action 6);

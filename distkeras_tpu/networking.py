@@ -192,7 +192,22 @@ def send_data(sock: socket.socket, obj: Any) -> None:
     if _fault_hook is not None:
         _fault_hook("send", sock)
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    prefix = _LEN.pack(len(payload))
+    if not hasattr(sock, "sendmsg"):  # e.g. a test double wrapping send
+        sock.sendall(prefix + payload)
+        return
+    # gather-write the 8-byte prefix + payload (zero-copy host staging,
+    # ISSUE 10): the historical `prefix + payload` concat copied the whole
+    # O(model) weight frame once per send just to prepend 8 bytes
+    sent = sock.sendmsg([prefix, payload])
+    total = len(prefix) + len(payload)
+    if sent < total:
+        # partial gather write (huge frame vs socket buffer): finish with
+        # sendall over zero-copy memoryviews of the remainder
+        if sent < len(prefix):
+            sock.sendall(prefix[sent:])
+            sent = len(prefix)
+        sock.sendall(memoryview(payload)[sent - len(prefix):])
 
 
 def _recv_exact(sock: socket.socket, n: int, expected: int | None = None) -> bytes:

@@ -141,6 +141,15 @@ class ParameterServer:
         # module docstring for the full locking discipline
         self._lock = _TimedLock()
         self._pull_versions: dict[int, int] = {}
+        # The PREVIOUS recorded pull version per worker (ISSUE 10): every
+        # pull-version record shifts cur → prev, so prev always holds the
+        # version recorded one exchange/pull earlier. A pipelined worker's
+        # fused exchange prices DynSGD τ from prev (``lag=True``) because
+        # the delta it commits was computed from the center returned one
+        # exchange ago — the deliberate one-window staleness the pipeline
+        # introduces must be PRICED, not hidden. Guarded by the center
+        # lock; reconstructed on replay by the same shift rule.
+        self._prev_pull_versions: dict[int, int] = {}
         # Liveness: worker leases renewed by heartbeats (resilience/
         # heartbeat.py). Workers that never heartbeat are never leased, so
         # nothing ever expires — legacy runs see zero overhead/behavior
@@ -200,6 +209,7 @@ class ParameterServer:
         self._n_pulls = 0
         self._n_compressed_pulls = 0
         self._n_commits = 0
+        self._n_fused = 0
         self._bytes_in = 0
         self._bytes_out = 0
         # elastic-membership accounting (resilience/elastic.py): the pool
@@ -286,6 +296,9 @@ class ParameterServer:
         self.center = state["center"]
         self.num_updates = int(state["num_updates"])
         self._pull_versions = dict(state["pull_versions"])
+        self._prev_pull_versions = dict(
+            state.get("prev_pull_versions", {})
+        )
         self._last_seq = dict(state["last_seq"])
         self.fence_epoch = max(self.fence_epoch, int(state["fence_epoch"]))
         if self.ema_decay is not None and state.get("ema") is not None:
@@ -309,6 +322,7 @@ class ParameterServer:
         return ps_state_dict(
             self.center, self.num_updates, self._pull_versions,
             self._last_seq, None, 0, self.fence_epoch,
+            prev_pull_versions=self._prev_pull_versions,
         )
 
     def _attach_ema_state(self, state: dict) -> dict:
@@ -384,6 +398,9 @@ class ParameterServer:
         worker saw, grab the immutable center snapshot, and resolve this
         worker's residual state when compressing."""
         with self._lock:
+            prev = self._pull_versions.get(worker_id)
+            if prev is not None:
+                self._prev_pull_versions[worker_id] = prev
             self._pull_versions[worker_id] = self.num_updates
             if self._wal is not None or self._replica_sock is not None:
                 # pull versions are recoverable state (DynSGD prices the
@@ -516,6 +533,64 @@ class ParameterServer:
         Returns True when the commit folded, False when it was a
         duplicate.
         """
+        applied, _snap, _st = self._commit_impl(
+            worker_id, payload, seq=seq, epoch=epoch,
+            wire_frame=wire_frame,
+        )
+        return applied
+
+    def exchange(self, worker_id: int, payload: Pytree,
+                 seq: int | None = None, epoch: int | None = None,
+                 lag: bool = False, compressed: bool = False,
+                 wire_frame: bytes | None = None) -> tuple:
+        """Fused commit + pull — ONE call (one wire round trip on the
+        socket/native transports) that folds this worker's commit and
+        returns the fresh post-fold center, halving the per-window
+        exchange cost of the classic ``commit(); pull()`` pair.
+
+        Semantics are exactly the pair's, executed atomically under one
+        center-lock section: the fold is priced with the same τ a
+        standalone commit would see, then the pull version is recorded at
+        the post-fold ``num_updates`` and the published snapshot grabbed.
+        A duplicate (replayed ``seq``) skips the fold but still performs
+        the pull half — a lost-ACK replay gets a fresh center and records
+        its version exactly as a retried ``pull`` would, and can never
+        double-fold or advance ``num_updates`` twice. A fenced exchange
+        raises without folding or pulling.
+
+        ``lag=True`` (the pipelined worker) prices τ from the PREVIOUS
+        recorded pull version: the committed delta was computed from the
+        center returned one exchange ago, and DynSGD must see that extra
+        window of staleness (see ``_prev_pull_versions``).
+
+        Returns ``(weights_or_blob, applied)`` — the raw center copy, or
+        the int8 error-feedback blob when ``compressed=True``.
+        """
+        applied, snap, st = self._commit_impl(
+            worker_id, payload, seq=seq, epoch=epoch, lag=lag,
+            fused=True, compressed=compressed, wire_frame=wire_frame,
+        )
+        if not compressed:
+            out = jax_tree_copy(snap)  # O(model), off the center lock
+            self._count(pulls=1, bytes_out=self._center_nbytes, fused=1)
+            return out, applied
+        with st.lock:
+            blob, nbytes = self._encode_pull(st, snap)
+        self._count(compressed_pulls=1, bytes_out=nbytes, fused=1)
+        return blob, applied
+
+    def _commit_impl(self, worker_id: int, payload: Pytree,
+                     seq: int | None = None, epoch: int | None = None,
+                     wire_frame: bytes | None = None, fused: bool = False,
+                     lag: bool = False, compressed: bool = False) -> tuple:
+        """The shared commit pipeline behind ``commit`` and ``exchange``:
+        decode → off-lock durable encode → fold (+ fused pull
+        bookkeeping) under the center lock → deferred-ACK durability wait
+        → EMA fold. Returns ``(applied, snap, st)``; ``snap``/``st`` are
+        the fused pull's center snapshot and per-worker residual state
+        (None unless ``fused``). Counts the COMMIT-side stats only — the
+        caller counts the pull side once the reply is actually delivered
+        (socket) or materialized (in-process)."""
         import zlib as _zlib
 
         from distkeras_tpu.resilience import wal as _wal
@@ -558,7 +633,13 @@ class ParameterServer:
                 else:
                     self._last_seq[worker_id] = seq
             if not fenced and not dup:
-                pull_version = self._pull_versions.get(worker_id, 0)
+                if lag and worker_id in self._prev_pull_versions:
+                    # pipelined exchange: the delta was computed from the
+                    # center returned one exchange AGO — price τ from the
+                    # previous recorded pull version, not the current one
+                    pull_version = self._prev_pull_versions[worker_id]
+                else:
+                    pull_version = self._pull_versions.get(worker_id, 0)
                 staleness = self.num_updates - pull_version
                 self.center = utils.tree_to_numpy(
                     self.rule.fold(
@@ -602,6 +683,29 @@ class ParameterServer:
                     # snapshot's EMA is never behind its center)
                     self._wal.rotate(self.num_updates)
                     snap_state = self._capture_state_locked()
+            snap_out = None
+            st = None
+            if fused and not fenced:
+                # the fused pull half — applied AND duplicate commits get
+                # it (a lost-ACK replay still needs the fresh center, and
+                # recording its version is exactly what a retried pull
+                # would do): shift cur → prev, record the post-fold
+                # version, grab the immutable snapshot — O(1), the same
+                # bookkeeping as _begin_pull
+                prev = self._pull_versions.get(worker_id)
+                if prev is not None:
+                    self._prev_pull_versions[worker_id] = prev
+                self._pull_versions[worker_id] = self.num_updates
+                if self._wal is not None or self._replica_sock is not None:
+                    self._log_locked(_wal.encode_record(
+                        _wal.REC_PULL,
+                        (int(worker_id), int(self.num_updates)),
+                    ))
+                snap_out = self.center
+                if compressed:
+                    st = self._pull_errors.get(worker_id)
+                    if st is None:
+                        st = self._pull_errors[worker_id] = _PullState()
             if fenced:
                 self._n_fenced_commits += 1
         if fenced:
@@ -614,7 +718,7 @@ class ParameterServer:
             )
         if dup:
             self._count(dup_commits=1, bytes_in=nbytes)
-            return False
+            return False, snap_out, st
         self._count(commits=1, bytes_in=nbytes)
         hook = self.post_commit_hook
         if hook is not None:
@@ -661,7 +765,7 @@ class ParameterServer:
         if snap_state is not None and self._wal._fh is not None:
             self._attach_ema_state(snap_state)
             self._wal.publish_snapshot(snap_state)
-        return True
+        return True, snap_out, st
 
     def _log_commit_locked(self, worker_id: int, seq: int | None,
                            pull_version: int, version: int,
@@ -736,10 +840,17 @@ class ParameterServer:
         """Clean worker exit: drop the lease without counting an eviction,
         and retire the commit-seqno fence (a future client for this worker
         id starts a fresh epoch; keeping the fence would only grow the
-        map)."""
+        map). The pull-version slots (cur AND prev) retire too: every
+        worker loop pulls before committing, so a same-id successor never
+        reads the dead generation's cur — but the successor's first pull
+        would SHIFT a surviving cur into prev, and its first pipelined
+        (lag-priced) exchange would then be priced from the dead
+        generation's version instead of its own fresh pull."""
         self._registry.deregister(worker_id)
         with self._lock:
             self._last_seq.pop(worker_id, None)
+            self._pull_versions.pop(worker_id, None)
+            self._prev_pull_versions.pop(worker_id, None)
             if self._wal is not None or self._replica_sock is not None:
                 from distkeras_tpu.resilience import wal as _wal
 
@@ -796,6 +907,7 @@ class ParameterServer:
         with self._lock:
             for wid in worker_ids:
                 self._pull_versions.pop(wid, None)
+                self._prev_pull_versions.pop(wid, None)
                 self._last_seq.pop(wid, None)
             if self._wal is not None or self._replica_sock is not None:
                 from distkeras_tpu.resilience import wal as _wal
@@ -919,7 +1031,7 @@ class ParameterServer:
         return total
 
     def _count(self, pulls=0, compressed_pulls=0, commits=0,
-               bytes_in=0, bytes_out=0, dup_commits=0):
+               bytes_in=0, bytes_out=0, dup_commits=0, fused=0):
         with self._stats_lock:
             self._n_pulls += pulls
             self._n_compressed_pulls += compressed_pulls
@@ -927,6 +1039,7 @@ class ParameterServer:
             self._bytes_in += bytes_in
             self._bytes_out += bytes_out
             self._n_dup_commits += dup_commits
+            self._n_fused += fused
 
     def stats(self) -> dict:
         """Contention + throughput counters (cheap, approximate under load).
@@ -960,6 +1073,7 @@ class ParameterServer:
             pulls = self._n_pulls
             cpulls = self._n_compressed_pulls
             commits = self._n_commits
+            fusedx = self._n_fused
             bytes_in, bytes_out = self._bytes_in, self._bytes_out
             dups = self._n_dup_commits
             pool = self._pool_size
@@ -983,6 +1097,7 @@ class ParameterServer:
             wal_group_max=0 if wal is None else wal.wal_group_max,
             pool_size=pool, joined_workers=joined,
             preempted_workers=preempted, drain_timeouts=drain_to,
+            fused_exchanges=fusedx,
         )
 
 
@@ -996,7 +1111,8 @@ def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
                    wal_records: int = 0, wal_fsyncs: int = 0,
                    wal_group_max: int = 0, pool_size: int = 0,
                    joined_workers: int = 0, preempted_workers: int = 0,
-                   drain_timeouts: int = 0) -> dict:
+                   drain_timeouts: int = 0,
+                   fused_exchanges: int = 0) -> dict:
     """The ONE stats-dict builder both PS transports share (Python counters
     here, C++ atomics via ``native_ps.NativeSocketParameterServer.stats``):
     key set and derived-value math are pinned by construction, so the
@@ -1044,6 +1160,16 @@ def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
         "joined_workers": joined_workers,
         "preempted_workers": preempted_workers,
         "drain_timeouts": drain_timeouts,
+        # fused-exchange observability (ISSUE 10): a fused EXCHANGE counts
+        # one commit AND one pull in the op counters above (it is one of
+        # each, semantically) but only ONE wire round trip — so the total
+        # exchange-related RTTs are the op counts minus one per fusion.
+        # The 2→1 RTT claim is checkable from any trainer's ps_stats_:
+        # with fusion on, exchange_rtts == windows + initial pulls, not
+        # 2×windows + initial pulls.
+        "fused_exchanges": fused_exchanges,
+        "exchange_rtts": (pulls + compressed_pulls + commits + dup_commits
+                          - fused_exchanges),
     }
 
 
@@ -1177,6 +1303,11 @@ class SocketParameterServer(ParameterServer):
                         continue
                     networking.send_data(conn, {"ok": True,
                                                 "dup": not applied})
+                elif action == "exchange":
+                    # fused commit + pull (ISSUE 10): one round trip folds
+                    # the delta and answers with the fresh post-fold
+                    # center — see ParameterServer.exchange
+                    self._serve_exchange(conn, msg, raw)
                 elif action == "ping":
                     # liveness probe for the trainer-side failover
                     # supervisor (and the client's epoch discovery)
@@ -1261,6 +1392,47 @@ class SocketParameterServer(ParameterServer):
         snap, _ = self._begin_pull(worker_id, compressed=False)
         networking.send_data(conn, {"weights": snap})
         self._count(pulls=1, bytes_out=self._center_nbytes)
+
+    def _serve_exchange(self, conn, msg, raw: bytes) -> None:
+        """Wire variant of the fused ``exchange``: fold + fused pull
+        bookkeeping through ``_commit_impl`` (the request frame is logged
+        verbatim — REC_COMMIT_WIRE replay extracts ``payload`` exactly as
+        it does for a plain commit), then the reply serializes the
+        immutable snapshot straight onto the wire. Compressed replies get
+        the dropped-reply residual rollback of ``_serve_compressed_pull``;
+        counters land only once the reply is fully sent (delivered-traffic
+        semantics, both transports)."""
+        compressed = bool(msg.get("compressed"))
+        try:
+            applied, snap, st = self._commit_impl(
+                msg["worker_id"], msg["payload"], seq=msg.get("seq"),
+                epoch=msg.get("epoch"), wire_frame=raw, fused=True,
+                lag=bool(msg.get("lag")), compressed=compressed,
+            )
+        except networking.FencedEpochError as fe:
+            networking.send_data(conn, {
+                "error": "fenced", "epoch": fe.server_epoch,
+            })
+            return
+        if not compressed:
+            networking.send_data(
+                conn, {"ok": True, "dup": not applied, "weights": snap}
+            )
+            self._count(pulls=1, bytes_out=self._center_nbytes, fused=1)
+            return
+        with st.lock:
+            blob, nbytes = self._encode_pull(st, snap)
+            epoch_ = st.epoch
+        try:
+            networking.send_data(
+                conn, {"ok": True, "dup": not applied, "weights": blob}
+            )
+        except (ConnectionError, OSError):
+            with st.lock:
+                if st.epoch == epoch_:
+                    self._rollback_encode_locked(st, snap, blob)
+            raise
+        self._count(compressed_pulls=1, bytes_out=nbytes, fused=1)
 
     def _serve_compressed_pull(self, conn, worker_id: int) -> None:
         """Wire variant of ``pull(compressed=True)`` with a dropped-reply
@@ -1687,6 +1859,44 @@ class ParameterServerClient:
             raise networking.ProtocolError(
                 "server is an unpromoted standby", retryable=True
             )
+
+    def exchange(self, worker_id: int | None, payload: Pytree,
+                 seq: int | None = None, lag: bool = False) -> Pytree:
+        """Fused commit + pull: ONE round trip folds ``payload`` and
+        returns the fresh post-fold center (decoded). Carries the same
+        seq/epoch resilience tokens as ``commit``; ``lag=True`` is the
+        pipelined worker's honest-τ flag (price the fold from the
+        previous pull version — the delta is one exchange stale)."""
+        if not is_encoded(payload):
+            payload = utils.tree_to_numpy(payload)
+        msg = {
+            "action": "exchange",
+            "worker_id": self.worker_id,
+            "payload": payload,
+        }
+        if self.pull_compression == "int8":
+            msg["compressed"] = True
+        if seq is not None:
+            msg["seq"] = int(seq)
+        if self.epoch is not None:
+            msg["epoch"] = self.epoch
+        if lag:
+            msg["lag"] = True
+        networking.send_data(self._sock, msg)
+        reply = networking.recv_data(self._sock)
+        err = reply.get("error") if isinstance(reply, dict) else None
+        if err == "fenced":
+            raise networking.FencedEpochError(
+                "exchange fenced by the server",
+                client_epoch=self.epoch, server_epoch=reply.get("epoch"),
+            )
+        if "weights" not in reply:
+            # an unpromoted standby or other typed refusal: retryable
+            raise networking.ProtocolError(
+                f"exchange refused: {reply.get('error', reply)}",
+                retryable=True,
+            )
+        return maybe_decode(reply["weights"])
 
     def heartbeat(self, retries: int = 0) -> bool:
         """Renew this worker's lease (auto-registers); ``retries`` is the

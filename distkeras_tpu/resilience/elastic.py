@@ -61,7 +61,16 @@ from typing import Any, Callable
 
 import numpy as np
 
-__all__ = ["ShardAssigner", "ElasticPolicy", "ElasticCoordinator"]
+__all__ = ["ShardAssigner", "ElasticPolicy", "ElasticCoordinator",
+           "WOULD_BLOCK"]
+
+#: Sentinel ``ShardAssigner.claim(wait=False)`` returns when every
+#: remaining block is in flight (possibly with the CALLER — the pipelined
+#: worker claims its next block while its previous one is still awaiting
+#: its deferred exchange). The pipelined loop flushes that exchange and
+#: re-claims blocking; waiting here instead would deadlock on the
+#: worker's own unconfirmed block.
+WOULD_BLOCK = object()
 
 
 class ShardAssigner:
@@ -128,13 +137,16 @@ class ShardAssigner:
         return self._perm(epoch)[: self.blocks_per_epoch * self.win_rows]
 
     def claim(self, worker_id: int,
-              stop: Callable[[], bool] | None = None):
+              stop: Callable[[], bool] | None = None, wait: bool = True):
         """Lease the next block: ``(epoch, block, row_indices)``, or
         ``None`` when all work is complete / ``stop()`` goes true.
         Earlier epochs are served first; a worker may run ahead into the
         next epoch while a peer still holds blocks of the previous one
         (hogwild epochs, like the fixed-pool loop's free-running
-        workers)."""
+        workers). ``wait=False`` returns :data:`WOULD_BLOCK` instead of
+        waiting when the pool is empty but blocks remain in flight — the
+        pipelined worker's probe (its own deferred block may be what the
+        pool is waiting on)."""
         while True:
             with self._cv:
                 for e in self.epochs:
@@ -153,6 +165,8 @@ class ShardAssigner:
                         return e, b, idx
                 if not self._inflight:
                     return None  # every block of every epoch is complete
+                if not wait:
+                    return WOULD_BLOCK
                 # all remaining blocks are in flight with other workers —
                 # a drain/death may hand some back; wait, bounded, so a
                 # draining waiter can notice its stop flag

@@ -299,6 +299,31 @@ class ResilientPSClient:
                                               seq=seq))
         self.seq += 1
 
+    def exchange(self, worker_id: int | None, payload: Pytree,
+                 lag: bool = False) -> Pytree:
+        """Fused commit + pull under the retry policy (ISSUE 10): ONE
+        seqno covers the whole exchange — a lost-ACK replay re-sends the
+        same seq, the server's dedup skips the re-fold but still answers
+        with a fresh center (the pull half retries like any pull), so the
+        fused action is exactly-once for the fold and at-least-once for
+        the read, which is precisely the ``commit(); pull()`` contract.
+        Transports without a fused channel fall back to the 2-RTT pair
+        inside one retried op (a replayed pair dedups its commit)."""
+        self._wire_seq += 1
+        seq = self._seq_epoch + self._wire_seq
+
+        def op():
+            inner = self._client
+            ex = getattr(inner, "exchange", None)
+            if ex is not None:
+                return ex(self.worker_id, payload, seq=seq, lag=lag)
+            inner.commit(self.worker_id, payload, seq=seq)
+            return inner.pull()
+
+        out = self._run(op)
+        self.seq += 1
+        return out
+
     def heartbeat(self, retries: int | None = None) -> None:
         """Renew this worker's lease now (reporting cumulative retries)."""
         n = self.retries if retries is None else int(retries)

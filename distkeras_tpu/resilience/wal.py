@@ -666,13 +666,19 @@ class CommitLog:
 def ps_state_dict(center: Pytree, num_updates: int,
                   pull_versions: dict, last_seq: dict,
                   ema: Pytree | None, ema_version: int,
-                  fence_epoch: int) -> dict:
+                  fence_epoch: int,
+                  prev_pull_versions: dict | None = None) -> dict:
     """The full recoverable PS state (plain containers + numpy only, so
-    the restricted unpickler can load it back)."""
+    the restricted unpickler can load it back). ``prev_pull_versions``
+    (ISSUE 10) is each worker's previous recorded pull version — the base
+    a pipelined fused exchange prices its deliberately-stale commit from;
+    old snapshots without the key recover with an empty map and the next
+    pull record per worker rebuilds it exactly (the shift rule below)."""
     return {
         "center": center,
         "num_updates": int(num_updates),
         "pull_versions": dict(pull_versions),
+        "prev_pull_versions": dict(prev_pull_versions or {}),
         "last_seq": dict(last_seq),
         "ema": ema,
         "ema_version": int(ema_version),
@@ -776,14 +782,25 @@ def replay_record(state: dict, rec_type: int, body: Any, rule,
             state["ema_version"] = state["num_updates"]
     elif rec_type in (REC_PULL, REC_PULL_FLAT):
         worker_id, version = body
+        # the live servers shift cur → prev on EVERY pull-version record
+        # (plain pull or fused exchange); replay runs the identical rule,
+        # so a recovered pipelined worker's lag pricing is bit-exact
+        prev = state["pull_versions"].get(worker_id)
+        if prev is not None:
+            state.setdefault("prev_pull_versions", {})[worker_id] = prev
         state["pull_versions"][worker_id] = version
     elif rec_type in (REC_DEREG, REC_DEREG_FLAT):
         (worker_id,) = body
         state["last_seq"].pop(worker_id, None)
+        # pull-version slots retire with the clean exit (the live
+        # servers' deregister rule — see ParameterServer.deregister_worker)
+        state["pull_versions"].pop(worker_id, None)
+        state.get("prev_pull_versions", {}).pop(worker_id, None)
     elif rec_type in (REC_EVICT, REC_EVICT_FLAT):
         (worker_ids,) = body
         for wid in worker_ids:
             state["pull_versions"].pop(wid, None)
+            state.get("prev_pull_versions", {}).pop(wid, None)
             state["last_seq"].pop(wid, None)
     elif rec_type in (REC_FENCE, REC_FENCE_FLAT):
         (epoch,) = body

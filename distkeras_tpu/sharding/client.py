@@ -99,6 +99,32 @@ class ShardedPSClient:
             lambda c, sid: c.commit(self.worker_id, parts[sid])
         )
 
+    def exchange(self, worker_id: int | None, payload: Pytree,
+                 seq: int | None = None, lag: bool = False) -> Pytree:
+        """Fused commit + pull fanned to every shard (ISSUE 10): each
+        shard folds its part and answers with its fresh sub-center in ONE
+        round trip — an N-shard exchange costs ~one shard's RTT instead
+        of two. Per-shard seqnos stay with the (resilient) sub-clients,
+        and each shard's ``lag`` pricing uses its OWN prev pull version,
+        so per-shard DynSGD τ keeps matching the single-PS τ under
+        pipelining too."""
+        if seq is not None:
+            raise ValueError(
+                "ShardedPSClient assigns per-shard seqnos internally; "
+                "wrap the shard clients in ResilientPSClient instead of "
+                "passing seq"
+            )
+        parts = self.plan.split(payload)
+
+        def op(c, sid):
+            ex = getattr(c, "exchange", None)
+            if ex is not None:
+                return ex(self.worker_id, parts[sid], lag=lag)
+            c.commit(self.worker_id, parts[sid])
+            return c.pull()
+
+        return self.plan.join(self._scatter(op))
+
     def heartbeat(self, retries: int = 0) -> bool:
         out = self._scatter(
             lambda c, sid: (c.heartbeat(retries=retries)

@@ -441,6 +441,11 @@ def aggregate_ps_stats(per_shard: list[dict]) -> dict:
         "center_lock_hold_ns", "dup_commits", "heartbeats",
         "worker_retries", "fenced_commits", "wal_records", "wal_fsyncs",
         "pulls_per_sec", "commits_per_sec",
+        # fused-exchange counters (ISSUE 10): summed like the op counts —
+        # a fan-out exchange is one fused op (one RTT) PER SHARD, so the
+        # per-shard 2→1 claim reads off each shard's own pair of entries
+        # in per_shard, and the roll-up totals the group's wire traffic
+        "fused_exchanges", "exchange_rtts",
     )
     # elastic-membership counters are maxed like the lease gauges: every
     # shard sees the SAME global joins/drains through the fan-out, so

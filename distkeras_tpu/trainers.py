@@ -558,6 +558,8 @@ class DistributedTrainer(Trainer):
                  ps_failover_timeout: float | None = None,
                  ps_num_shards: int = 1,
                  ps_chain_length: int = 1,
+                 ps_fused_exchange: bool = True,
+                 ps_pipeline_depth: int = 0,
                  elastic: bool = False,
                  autoscale_target=None,
                  preempt_drain_timeout: float = 5.0,
@@ -850,6 +852,64 @@ class DistributedTrainer(Trainer):
                 "ps_standby is the pre-sharding single hot standby; with "
                 "ps_num_shards/ps_chain_length use ps_chain_length >= 2 "
                 "(chain replication subsumes it)"
+            )
+        # Pipelined fused exchange (ISSUE 10; DESIGN.md "Pipelined
+        # exchange"):
+        # - ps_fused_exchange (default True): route each window's
+        #   commit+pull through the single-round-trip EXCHANGE wire
+        #   action — the fold and the fresh post-fold center in ONE RTT
+        #   instead of two, identical semantics (False keeps the classic
+        #   pair, the A/B for the bit-identical tests).
+        # - ps_pipeline_depth: 0 (default) = the serial loop, bit-
+        #   identical to the pre-pipeline behavior; 1 = launch window
+        #   N+1's on-device compute, then exchange window N on the host
+        #   while the device runs — the committed delta is one window
+        #   stale, priced into DynSGD τ via the exchange's lag flag.
+        #   Depth > 1 is declined by design (see DESIGN.md: each extra
+        #   window multiplies staleness for a latency the single-deep
+        #   pipeline already hides).
+        self.ps_fused_exchange = bool(ps_fused_exchange)
+        self.ps_pipeline_depth = int(ps_pipeline_depth)
+        if self.ps_pipeline_depth not in (0, 1):
+            raise ValueError(
+                f"ps_pipeline_depth must be 0 (serial) or 1 (one window "
+                f"in flight), got {ps_pipeline_depth} — deeper pipelines "
+                f"buy no additional overlap (one RTT already hides behind "
+                f"one window) and multiply DynSGD staleness per extra "
+                f"window; see DESIGN.md 'Pipelined exchange'"
+            )
+        if self.ps_pipeline_depth and backend != "ps":
+            raise ValueError(
+                "ps_pipeline_depth applies to backend='ps' only (the "
+                "collective backend has no worker-hosted exchange loop)"
+            )
+        if self.ps_pipeline_depth and checkpoint_dir and not elastic:
+            raise ValueError(
+                "ps_pipeline_depth >= 1 is incompatible with fixed-pool "
+                "epoch-barrier checkpointing (checkpoint_dir): the "
+                "barrier would snapshot with one window still "
+                "un-exchanged — drop checkpoint_dir or run depth 0"
+            )
+        if self.ps_pipeline_depth and not self.ps_fused_exchange:
+            raise ValueError(
+                "ps_pipeline_depth >= 1 requires ps_fused_exchange=True: "
+                "only the fused EXCHANGE action carries the lag flag that "
+                "prices the pipeline's one-window staleness into DynSGD τ "
+                "— the unfused commit();pull() pair would silently "
+                "under-price it"
+            )
+        if self.ps_pipeline_depth and compression is not None \
+                and ps_transport == "native":
+            raise ValueError(
+                "ps_pipeline_depth >= 1 with compression on "
+                "ps_transport='native' is unsupported: the segmented "
+                "int8 commit wire has no fused EXCHANGE frame, and its "
+                "2-RTT fallback cannot carry the pipeline's lag pricing "
+                "— use ps_transport='socket' or drop one of the two"
+            )
+        if not self.ps_fused_exchange and backend != "ps":
+            raise ValueError(
+                "ps_fused_exchange applies to backend='ps' only"
             )
         # Elastic membership (distkeras_tpu/resilience/elastic.py;
         # DESIGN.md "Elastic membership & autoscaling"):

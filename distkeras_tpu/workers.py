@@ -23,7 +23,9 @@ sync backend's oracle tests).
 
 from __future__ import annotations
 
+import bisect
 import threading
+import time
 from typing import Any
 
 import jax
@@ -39,6 +41,37 @@ from distkeras_tpu.parameter_servers import (
 )
 
 Pytree = Any
+
+#: Exchange-phase histogram bucket edges (milliseconds, powers of two):
+#: a sample lands in the first bucket whose edge is >= its value, with one
+#: overflow bucket past the last edge. Cheap enough to run per window and
+#: coarse enough to stay JSON-small in ``trainer.ps_stats_``.
+_PHASE_BUCKETS = (0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0,
+                  128.0, 256.0, 512.0, 1024.0)
+
+
+def aggregate_exchange_phases(workers) -> dict:
+    """Merge every worker's per-phase exchange timings (fetch / compress /
+    commit / pull ms — see ``AsyncWorker._phase``) into one summary dict,
+    attached to ``trainer.ps_stats_["exchange_phases"]`` so the overlap
+    the pipelined exchange buys is observable, not asserted. JSON-clean."""
+    out: dict = {}
+    for w in workers:
+        for name, rec in getattr(w, "_phases", {}).items():
+            agg = out.setdefault(name, {
+                "count": 0, "total_ms": 0.0, "max_ms": 0.0,
+                "hist_ms_le": list(_PHASE_BUCKETS) + ["inf"],
+                "hist": [0] * (len(_PHASE_BUCKETS) + 1),
+            })
+            agg["count"] += rec["count"]
+            agg["total_ms"] += rec["total_ms"]
+            agg["max_ms"] = max(agg["max_ms"], rec["max_ms"])
+            agg["hist"] = [a + b for a, b in zip(agg["hist"], rec["hist"])]
+    for rec in out.values():
+        rec["mean_ms"] = (
+            rec["total_ms"] / rec["count"] if rec["count"] else 0.0
+        )
+    return out
 
 
 def _build_local_window(loss_step, optimizer):
@@ -73,7 +106,8 @@ class AsyncWorker:
                  restore: dict | None = None, start_epoch: int = 0,
                  tolerant: bool = False, codec=None, fault_plan=None,
                  assigner=None, drain_event: threading.Event | None = None,
-                 coordinator=None, joiner: bool = False):
+                 coordinator=None, joiner: bool = False,
+                 pipeline_depth: int = 0, fused: bool = True):
         self.worker_id = worker_id
         self.device = device
         self.window_fn = window_fn
@@ -125,28 +159,166 @@ class AsyncWorker:
         self.drain_event = drain_event
         self.coordinator = coordinator
         self.joiner = bool(joiner)
+        # Pipelined exchange (ISSUE 10): depth 1 launches window N+1's
+        # jitted compute on-device, then performs window N's exchange on
+        # the host while the device runs — the committed delta is one
+        # window stale (DynSGD prices it via the exchange's `lag` flag).
+        # Depth 0 (default) is the serial loop, bit-identical to the
+        # pre-pipeline behavior. `fused` routes the exchange through the
+        # single-RTT EXCHANGE wire action when the client has one
+        # (halving the wire cost); False keeps the commit();pull() pair.
+        self.pipeline_depth = int(pipeline_depth)
+        self.fused = bool(fused)
+        # zero-copy host staging: per-leaf delta scratch (allocated once,
+        # written with out=) + a double-buffered re-base target for the
+        # pipelined loop — steady-state exchange does no per-window
+        # O(model) allocation on the uncompressed path
+        self._stage_delta: list | None = None
+        self._stage_base: list[list] | None = None
+        self._base_flip = 0
+        # per-phase exchange timings (fetch/compress/commit/pull ms):
+        # merged across workers into ps_stats_["exchange_phases"]
+        self._phases: dict[str, dict] = {}
 
-    def _compress(self, tree):
-        """→ (wire payload, transmitted tree); updates the residual."""
+    def _compress(self, tree, owned: bool = False):
+        """→ (wire payload, transmitted tree); updates the residual.
+
+        Steady-state allocation-free (ISSUE 10 zero-copy staging): the
+        residual UPDATE always writes in place into this worker's
+        persistent residual buffers, and with ``owned=True`` (the delta
+        paths, whose leaves are this worker's staging scratch) the
+        residual ADD also writes into the input leaves — no model-sized
+        temporaries per window. ``owned=False`` (default) never mutates
+        the caller's tree, the historical contract."""
         if self.codec is None:
             return tree, tree
         if self._resid is not None:
-            tree = jax.tree.map(np.add, tree, self._resid)
+            if owned:
+                tree = jax.tree.map(
+                    lambda t, r: np.add(t, r, out=t)
+                    if getattr(t, "flags", None) is not None
+                    and t.flags.writeable else t + r,
+                    tree, self._resid,
+                )
+            else:
+                tree = jax.tree.map(np.add, tree, self._resid)
         blob = self.codec.encode(tree)
         sent = self.codec.decode(blob)
-        self._resid = jax.tree.map(np.subtract, tree, sent)
+        if self._resid is None:
+            self._resid = jax.tree.map(np.subtract, tree, sent)
+        else:
+            jax.tree.map(
+                lambda r, t, s: np.subtract(t, s, out=r),
+                self._resid, tree, sent,
+            )
         return blob, sent
+
+    def _phase(self, name: str, t0: float) -> float:
+        """Record one exchange-phase sample (ms since ``t0``); returns a
+        fresh ``perf_counter`` for chaining the next phase."""
+        t1 = time.perf_counter()
+        ms = (t1 - t0) * 1e3
+        rec = self._phases.get(name)
+        if rec is None:
+            rec = self._phases[name] = {
+                "count": 0, "total_ms": 0.0, "max_ms": 0.0,
+                "hist": [0] * (len(_PHASE_BUCKETS) + 1),
+            }
+        rec["count"] += 1
+        rec["total_ms"] += ms
+        if ms > rec["max_ms"]:
+            rec["max_ms"] = ms
+        rec["hist"][bisect.bisect_left(_PHASE_BUCKETS, ms)] += 1
+        return t1
+
+    def _window_delta(self, params, base):
+        """``params − base`` into the preallocated per-leaf delta staging
+        buffers: ``np.asarray`` views the device buffer where the backend
+        allows (the CPU path's zero-copy fetch; elsewhere it is the one
+        unavoidable D2H copy) and the subtract writes into scratch
+        allocated once per worker — no per-window O(model) allocation.
+        Blocks until the window's compute is done (the `fetch` phase)."""
+        cleaves, treedef = jax.tree.flatten(base)
+        hleaves = jax.tree.leaves(params)
+        if self._stage_delta is None:
+            self._stage_delta = [
+                np.empty(np.shape(h), np.asarray(h).dtype) for h in hleaves
+            ]
+        out = [
+            np.subtract(np.asarray(h), np.asarray(c), out=s)
+            for h, c, s in zip(hleaves, cleaves, self._stage_delta)
+        ]
+        return jax.tree.unflatten(treedef, out)
+
+    def _rebase_host(self, center, sent):
+        """The pipelined deferred re-base ``center + sent`` (the freshest
+        center in hand plus this window's transmitted update) into one of
+        TWO alternating staging buffer sets: the buffer fed to window N's
+        ``device_put`` is only rewritten at window N+2, after window N's
+        compute has provably finished — safe even when ``device_put``
+        aliases the host buffer (CPU backends)."""
+        cleaves, treedef = jax.tree.flatten(center)
+        sleaves = jax.tree.leaves(sent)
+        if self._stage_base is None:
+            self._stage_base = [
+                [np.empty(np.shape(c), np.asarray(c).dtype)
+                 for c in cleaves]
+                for _ in range(2)
+            ]
+        bufs = self._stage_base[self._base_flip]
+        self._base_flip ^= 1
+        out = [
+            np.add(np.asarray(c), np.asarray(s), out=b)
+            for c, s, b in zip(cleaves, sleaves, bufs)
+        ]
+        return jax.tree.unflatten(treedef, out)
+
+    def _do_exchange(self, blob, lag: bool = False):
+        """ONE wire exchange: the fused single-RTT EXCHANGE action when
+        enabled and the client speaks it, else the classic commit();
+        pull() pair — timed per phase either way (the fused RTT lands in
+        `commit`; `pull` stays empty, which is itself the observable 2→1
+        claim). NOTE: the unfused pair cannot carry ``lag`` (the wire
+        has no slot for it), so trainers.py rejects pipelining without
+        fusion — a direct caller combining them would silently
+        under-price DynSGD τ by one window."""
+        t0 = time.perf_counter()
+        exchange = getattr(self.ps, "exchange", None) if self.fused \
+            else None
+        if exchange is not None:
+            center = exchange(self.worker_id, blob, lag=lag)
+            self._phase("commit", t0)
+        else:
+            self.ps.commit(self.worker_id, blob)
+            t0 = self._phase("commit", t0)
+            center = self.ps.pull(self.worker_id)
+            self._phase("pull", t0)
+        return center
 
     def train(self, index: int, shard_cols: tuple, num_epoch: int,
               shuffle: bool, seed: int) -> None:
         """Reference signature spirit: ``Worker.train(index, iterator)``."""
         try:
+            # the pipelined (depth-1) loops apply to the delta-committing
+            # rules only: an elastic-rule commit depends on a fresh pull,
+            # so its exchange cannot be deferred behind the next window
+            # (run_async_training validates this loudly; direct callers
+            # fall back to the serial loop)
+            pipelined = self.pipeline_depth >= 1 and not isinstance(
+                self.rule, ElasticAverageMerge
+            )
             if self.assigner is not None:
                 # elastic membership: shard_cols is the FULL column set;
                 # the shared assigner hands out window blocks instead of
                 # a static per-worker shard (epochs/shuffle/seed live in
                 # the assigner, built once by run_async_training)
-                self._train_elastic(shard_cols)
+                if pipelined:
+                    self._train_elastic_pipelined(shard_cols)
+                else:
+                    self._train_elastic(shard_cols)
+            elif pipelined:
+                self._train_pipelined(index, shard_cols, num_epoch,
+                                      shuffle, seed)
             else:
                 self._train(index, shard_cols, num_epoch, shuffle, seed)
         except BaseException as e:  # surface thread failures to the driver
@@ -242,25 +414,32 @@ class AsyncWorker:
             # semantics), commit the elastic difference, keep own
             # variable moved toward the center — by the TRANSMITTED
             # difference, so worker and center stay symmetric under
-            # lossy compression
+            # lossy compression. The commit DEPENDS on the pull here, so
+            # the elastic rules cannot ride the fused single-RTT action.
+            t0 = time.perf_counter()
             center = self.ps.pull(self.worker_id)
+            t0 = self._phase("pull", t0)
             host_params = utils.tree_to_numpy(params)
+            t0 = self._phase("fetch", t0)
             diff = self.rule.worker_commit(host_params, center)
             blob, sent = self._compress(diff)
+            t0 = self._phase("compress", t0)
             self.ps.commit(self.worker_id, blob)
+            self._phase("commit", t0)
             params = jax.device_put(
                 jax.tree.map(lambda p, d: p - d, host_params, sent),
                 self.device,
             )
         else:
-            # commit window delta; re-base onto the fresh center
-            delta = jax.tree.map(
-                lambda p, c: np.asarray(p) - c,
-                utils.tree_to_numpy(params), center,
-            )
-            blob, _ = self._compress(delta)
-            self.ps.commit(self.worker_id, blob)
-            center = self.ps.pull(self.worker_id)
+            # commit window delta; re-base onto the fresh center — ONE
+            # round trip through the fused EXCHANGE action (commit folded
+            # and the post-fold center returned together)
+            t0 = time.perf_counter()
+            delta = self._window_delta(params, center)
+            t0 = self._phase("fetch", t0)
+            blob, _ = self._compress(delta, owned=True)
+            self._phase("compress", t0)
+            center = self._do_exchange(blob)
             params = jax.device_put(center, self.device)
 
         with self.lock:
@@ -270,6 +449,101 @@ class AsyncWorker:
                 "worker": self.worker_id,
             })
         return params, center
+
+    def _train_pipelined(self, index, shard_cols, num_epoch, shuffle,
+                         seed) -> None:
+        """Depth-1 pipelined window loop (ISSUE 10): launch window N+1's
+        jitted compute on-device immediately, then perform window N's
+        exchange on the host WHILE the device runs — the device→host
+        fetch is the only serial cost left; the encode/compress and the
+        wire round trip hide behind compute.
+
+        The data flow, per window N (u_N = window N's accumulated local
+        update, sent_N its transmitted image under lossy compression):
+
+        - window N+1 starts from ``C_{N-1} + sent_N`` — the freshest
+          center in hand (exchange N completes one iteration later) plus
+          this window's own update, so every update is committed exactly
+          once and the worker's base trails the serial loop's by exactly
+          one exchange. For a single DOWNPOUR worker the two coincide
+          bit-for-bit (``C_N == C_{N-1} + sent_N`` with fold scale 1 —
+          pinned by test).
+        - exchange N carries ``lag=True``: the server prices DynSGD τ
+          from the PREVIOUS pull version, because u_N was computed from
+          the center recorded one exchange earlier — the pipeline's extra
+          window of staleness is priced, never hidden.
+
+        Epoch-barrier checkpointing is excluded up front (trainers.py):
+        a barrier inside the loop would snapshot with one window still
+        un-exchanged."""
+        rows = len(shard_cols[0])
+        win_rows = self.window * self.batch_size
+        n_windows = rows // win_rows
+        maybe_heartbeat = getattr(self.ps, "maybe_heartbeat", None)
+        if maybe_heartbeat is not None:
+            maybe_heartbeat()
+        center = self.ps.pull(self.worker_id)
+        params = jax.device_put(center, self.device)
+        base = utils.tree_to_numpy(center)  # window 1's start, on host
+        nt = jax.device_put(self.nt, self.device)
+        opt = jax.jit(self.optimizer.init)(params)
+        pending = None  # window N's (blob, loss, epoch), exchanged at N+1
+        for epoch in range(self.start_epoch, num_epoch):
+            order = (
+                np.random.default_rng((seed, index, epoch)).permutation(rows)
+                if shuffle
+                else np.arange(rows)
+            )
+            for w in range(n_windows):
+                if self.fault_plan is not None:
+                    self.fault_plan.maybe_kill(
+                        self.worker_id, self._windows_done
+                    )
+                sl = order[w * win_rows : (w + 1) * win_rows]
+                batches = tuple(
+                    c[sl].reshape(
+                        (self.window, self.batch_size) + c.shape[1:]
+                    )
+                    for c in shard_cols
+                )
+                batches = jax.device_put(batches, self.device)
+                # async dispatch: the device starts this window NOW...
+                params, nt, opt, loss = self.window_fn(
+                    params, nt, opt, batches
+                )
+                if pending is not None:
+                    # ...while the host exchanges the PREVIOUS window
+                    center = self._flush_pipelined(pending)
+                # sync on this window's output; stage the next one
+                t0 = time.perf_counter()
+                delta = self._window_delta(params, base)
+                t0 = self._phase("fetch", t0)
+                blob, sent = self._compress(delta, owned=True)
+                self._phase("compress", t0)
+                base = self._rebase_host(center, sent)
+                params = jax.device_put(base, self.device)
+                pending = (blob, loss, epoch)
+                self._windows_done += 1
+                if maybe_heartbeat is not None:
+                    maybe_heartbeat()
+        if pending is not None:
+            self._flush_pipelined(pending)  # drain the last window
+        self.final_nt = utils.tree_to_numpy(nt)
+
+    def _flush_pipelined(self, pending):
+        """Exchange one deferred window (the pipelined loop's host leg):
+        fused commit+pull with the honest-τ ``lag`` flag, then the
+        history row — losses land when their window's exchange completes,
+        exactly like the serial loop's ordering contract."""
+        blob, loss, epoch = pending
+        center = self._do_exchange(blob, lag=True)
+        with self.lock:
+            self.history.append({
+                "loss": float(loss),
+                "epoch": epoch,
+                "worker": self.worker_id,
+            })
+        return center
 
     def _train_elastic(self, cols: tuple) -> None:
         """Elastic membership loop (resilience/elastic.py): lease window
@@ -301,8 +575,17 @@ class AsyncWorker:
         try:
             while True:
                 if drain is not None and drain.is_set():
-                    break  # preemption notice: in-flight window already
-                    # committed and confirmed — exit at the boundary
+                    # preemption notice: in-flight window already
+                    # committed and confirmed — exit at the boundary. An
+                    # elastic-RULE worker owns its local variable, so a
+                    # clean drain first commits the FINAL elastic
+                    # difference (ISSUE 10 satellite, PR 9 follow-up):
+                    # without it the drained worker's whole uncommitted
+                    # progress — everything its variable holds beyond
+                    # the center — is silently abandoned mid-epoch.
+                    if elastic_rule and self._windows_done > 0:
+                        self._commit_final_elastic(params)
+                    break
                 task = self.assigner.claim(self.worker_id, stop=stop)
                 if task is None:
                     break
@@ -341,6 +624,126 @@ class AsyncWorker:
             # path for clean exits, the safety net for deaths
             self.assigner.release(self.worker_id)
         self.final_nt = utils.tree_to_numpy(nt)
+
+    def _commit_final_elastic(self, params) -> None:
+        """Clean-drain EASGD epilogue: pull a fresh center, commit the
+        final elastic difference ``α·(worker − center)``, and move the
+        local variable by the transmitted image — the same symmetric
+        step every window takes, run once more at the exit boundary so
+        the center keeps the drained worker's contribution. The
+        post-step variable is stashed in ``final_params_`` (the center-
+        equivalence test pins ``c + α(w − c)`` against it)."""
+        center = self.ps.pull(self.worker_id)
+        host_params = utils.tree_to_numpy(params)
+        diff = self.rule.worker_commit(host_params, center)
+        blob, sent = self._compress(diff)
+        self.ps.commit(self.worker_id, blob)
+        self.drained_center_ = center
+        self.final_params_ = host_params
+
+    def _train_elastic_pipelined(self, cols: tuple) -> None:
+        """Depth-1 pipelined elastic loop: the ``_train_pipelined`` data
+        flow over assigner-leased window blocks. The exactly-once ledger
+        is untouched — a block is confirmed (``assigner.complete``) only
+        after its window's exchange ACKs, which the pipeline merely
+        DEFERS by one window; a drain or pool-exhaustion exit flushes the
+        pending window first, so the clean-drain contract ("finish the
+        in-flight window, commit, hand blocks back") holds verbatim."""
+        from distkeras_tpu.resilience.elastic import WOULD_BLOCK
+
+        maybe_heartbeat = getattr(self.ps, "maybe_heartbeat", None)
+        if self.joiner:
+            join = getattr(self.ps, "join", None)
+            if join is not None:
+                join()
+        if maybe_heartbeat is not None:
+            maybe_heartbeat()
+        center = self.ps.pull(self.worker_id)
+        params = jax.device_put(center, self.device)
+        base = utils.tree_to_numpy(center)
+        nt = jax.device_put(self.nt, self.device)
+        opt = jax.jit(self.optimizer.init)(params)
+        drain = self.drain_event
+        stop = drain.is_set if drain is not None else None
+        pending = None  # (blob, loss, epoch, block)
+        try:
+            while True:
+                if drain is not None and drain.is_set():
+                    break  # flush below finishes the in-flight window
+                task = self.assigner.claim(self.worker_id, stop=stop,
+                                           wait=False)
+                if task is WOULD_BLOCK:
+                    # the pool may be waiting on OUR deferred block:
+                    # flush the pending exchange (confirming it), then
+                    # claim blocking like the serial loop — the pipeline
+                    # degrades to serial exactly at pool starvation
+                    if pending is not None:
+                        center = self._flush_elastic_pipelined(
+                            pending, maybe_heartbeat
+                        )
+                        pending = None
+                    task = self.assigner.claim(self.worker_id, stop=stop)
+                if task is None:
+                    break
+                epoch, block, idx = task
+                if self.fault_plan is not None:
+                    self.fault_plan.maybe_kill(
+                        self.worker_id, self._windows_done
+                    )
+                batches = tuple(
+                    c[idx].reshape(
+                        (self.window, self.batch_size) + c.shape[1:]
+                    )
+                    for c in cols
+                )
+                batches = jax.device_put(batches, self.device)
+                params, nt, opt, loss = self.window_fn(
+                    params, nt, opt, batches
+                )
+                if pending is not None:
+                    center = self._flush_elastic_pipelined(
+                        pending, maybe_heartbeat
+                    )
+                t0 = time.perf_counter()
+                delta = self._window_delta(params, base)
+                t0 = self._phase("fetch", t0)
+                blob, sent = self._compress(delta, owned=True)
+                self._phase("compress", t0)
+                base = self._rebase_host(center, sent)
+                params = jax.device_put(base, self.device)
+                pending = (blob, loss, epoch, block)
+            if pending is not None:
+                self._flush_elastic_pipelined(pending, maybe_heartbeat)
+                pending = None
+        finally:
+            # hand any leased-but-unconfirmed block back — with the
+            # pending window flushed above, a clean exit holds none
+            self.assigner.release(self.worker_id)
+        self.final_nt = utils.tree_to_numpy(nt)
+
+    def _flush_elastic_pipelined(self, pending, maybe_heartbeat):
+        """Exchange one deferred elastic window: fused commit+pull with
+        the honest-τ lag flag, THEN confirm the block (complete-after-ACK
+        — the exactly-once ledger's invariant), then the window-boundary
+        hooks (heartbeat, seeded join/preempt chaos) in the serial
+        loop's order."""
+        blob, loss, epoch, block = pending
+        center = self._do_exchange(blob, lag=True)
+        with self.lock:
+            self.history.append({
+                "loss": float(loss),
+                "epoch": epoch,
+                "worker": self.worker_id,
+            })
+        # the exchange ACKed (durable when a WAL is on): the block is
+        # trained — confirm it before anything can drain us
+        self.assigner.complete(self.worker_id, epoch, block)
+        self._windows_done += 1
+        if maybe_heartbeat is not None:
+            maybe_heartbeat()
+        if self.coordinator is not None:
+            self.coordinator.on_window(self.worker_id, self._windows_done)
+        return center
 
 
 def run_async_training(trainer, ds, shuffle: bool):
@@ -409,6 +812,20 @@ def run_async_training(trainer, ds, shuffle: bool):
     # Resilience knobs (distkeras_tpu/resilience): a retry policy or a
     # heartbeat interval turns the plain transport clients into
     # reconnecting, seqno-deduplicated, lease-renewing wrappers.
+    # Pipelined fused exchange (ISSUE 10): depth-1 overlaps each window's
+    # exchange with the NEXT window's on-device compute; the fused flag
+    # routes commit+pull through the single-RTT EXCHANGE wire action.
+    # Both apply to the delta-committing rules only — an elastic-rule
+    # commit depends on a fresh pull, so it can neither fuse nor defer.
+    pipeline_depth = int(getattr(trainer, "ps_pipeline_depth", 0))
+    fused_exchange = bool(getattr(trainer, "ps_fused_exchange", True))
+    if pipeline_depth and isinstance(rule, ElasticAverageMerge):
+        raise ValueError(
+            "ps_pipeline_depth >= 1 applies to the delta-committing "
+            "rules (ADAG/DOWNPOUR/DynSGD); the elastic rules pull a "
+            "FRESH center before computing their commit, so their "
+            "exchange cannot be deferred behind the next window"
+        )
     retry_policy = getattr(trainer, "retry_policy", None)
     hb_interval = getattr(trainer, "heartbeat_interval", None)
     resilient = retry_policy is not None or hb_interval is not None
@@ -846,6 +1263,7 @@ def run_async_training(trainer, ds, shuffle: bool):
                 codec=codec, fault_plan=fault_plan,
                 assigner=assigner, drain_event=threading.Event(),
                 coordinator=coordinator, joiner=is_joiner,
+                pipeline_depth=pipeline_depth, fused=fused_exchange,
             )
             t = threading.Thread(
                 target=w.train,
@@ -879,6 +1297,7 @@ def run_async_training(trainer, ds, shuffle: bool):
                 tolerant=getattr(trainer, "tolerate_worker_failures",
                                  False),
                 codec=codec, fault_plan=fault_plan,
+                pipeline_depth=pipeline_depth, fused=fused_exchange,
             )
             for i in range(W)
         ]
@@ -1055,6 +1474,14 @@ def run_async_training(trainer, ds, shuffle: bool):
         trainer.ps_stats_ = (
             active_ps.stats() if hasattr(active_ps, "stats") else None
         )
+        if trainer.ps_stats_ is not None:
+            # per-phase exchange timings (fetch/compress/commit/pull ms
+            # histograms, merged across workers): the transport-agnostic
+            # proof that the pipelined exchange actually overlapped —
+            # with fusion on, `pull` has ZERO samples (2→1 RTTs) and the
+            # commit RTT hides behind the next window's compute
+            trainer.ps_stats_["exchange_phases"] = \
+                aggregate_exchange_phases(workers)
         if trainer.ps_stats_ is not None \
                 and getattr(trainer, "log_metrics", False):
             import json
@@ -1111,6 +1538,21 @@ class _BoundPS:
                epoch: int | None = None):
         self._ps.commit(self.worker_id, payload, seq=seq,
                         epoch=self.epoch if epoch is None else epoch)
+
+    def exchange(self, worker_id: int | None, payload,
+                 seq: int | None = None, lag: bool = False):
+        """Fused commit + pull (ISSUE 10). No wire is crossed, but the
+        in-process transport runs the same fused server path (one
+        center-lock section, same counters, same int8 round-trip when
+        pull_compression is on) so it stays a faithful oracle for the
+        socket/native wires."""
+        from distkeras_tpu.parallel.compression import maybe_decode
+
+        blob, _applied = self._ps.exchange(
+            self.worker_id, payload, seq=seq, epoch=self.epoch, lag=lag,
+            compressed=self.pull_compression == "int8",
+        )
+        return maybe_decode(blob)
 
     def heartbeat(self, retries: int = 0) -> bool:
         return self._ps.heartbeat(self.worker_id, retries=retries)

@@ -81,6 +81,31 @@ def test_ps_shard_bench_contract():
             < out["ps_shard_socket_n1"]["bytes_per_commit_per_shard"])
 
 
+def test_ps_exchange_bench_contract():
+    """--ps-bench's exchange leg (ISSUE 10): serial vs fused vs
+    fused+pipelined records present with positive rates, the measured
+    RTT-per-round oracle (2 for serial, 1 for fused — the wire-cost
+    halving read off ps.stats(), not asserted), and the host-ceiling
+    honesty field. Rate ORDERING is asserted only for the counters-based
+    claim; wall-clock speedups are recorded, not asserted (CI hosts
+    jitter)."""
+    out = bench.run_ps_exchange_bench(n_params=16_384, workers=(2,),
+                                      seconds=0.4, transports=("socket",),
+                                      compute_ms=2.0)
+    assert set(out) == {"ps_exchange_socket_w2"}
+    rec = out["ps_exchange_socket_w2"]
+    for k in ("serial_rounds_per_sec", "fused_rounds_per_sec",
+              "pipelined_rounds_per_sec"):
+        assert rec[k] > 0, k
+    # the acceptance counter oracle: 1 wire RTT per fused round, 2 per
+    # serial round (small slack: pull-side counters land post-send)
+    assert 1.9 <= rec["serial_rtts_per_round"] <= 2.1
+    assert 0.9 <= rec["fused_rtts_per_round"] <= 1.1
+    assert rec["fused_exchanges"] > 0
+    assert rec["host_cores"] >= 1
+    assert rec["speedup_pipelined_vs_serial"] > 0
+
+
 def test_ps_group_commit_sweep_contract():
     """--chaos-ps's flush-window sweep (ISSUE 7): every leg present with
     positive rates, the exactly-once oracle asserted per leg, the

@@ -1,0 +1,496 @@
+"""ISSUE 10: the fused commit+pull EXCHANGE and the pipelined window loop.
+
+Pins, per the acceptance criteria:
+
+- the fused exchange is semantically the ``commit(); pull()`` pair in ONE
+  round trip (counters: ``exchange_rtts`` == windows + initial pulls, not
+  2×windows), on every transport;
+- a lost-ACK replay of a fused exchange never double-folds NOR advances
+  the fold count twice (the pull half replays like a retried pull);
+- ``ps_pipeline_depth=0`` (the default) is bit-identical to the
+  pre-fusion HEAD path for ADAG/DOWNPOUR/DynSGD, int8 and 2-shard legs
+  included, and depth 1 is bit-identical to depth 0 for the single
+  DOWNPOUR worker (the deferred re-base telescopes exactly);
+- the pipelined exchange's one-window staleness is PRICED into DynSGD τ
+  (the ``lag`` flag reads the previous pull version);
+- a cleanly drained elastic-rule (EASGD) worker commits its final
+  elastic difference instead of abandoning its variable mid-epoch.
+"""
+
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.parallel.merge_rules import (
+    DownpourMerge,
+    DynSGDMerge,
+)
+from distkeras_tpu.parameter_servers import (
+    ParameterServer,
+    ParameterServerClient,
+    SocketParameterServer,
+)
+from tests.test_trainers import blobs_dataset, final_loss, model_spec
+
+
+def _tree_equal(a, b):
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+# -- the fused action, unit level --------------------------------------------
+
+
+def test_inprocess_exchange_is_commit_plus_pull():
+    center = {"w": np.zeros(3, np.float32)}
+    ps = ParameterServer(center, DownpourMerge(), num_workers=2)
+    ps.pull(0)
+    out, applied = ps.exchange(0, {"w": np.ones(3, np.float32)})
+    assert applied
+    assert np.array_equal(out["w"], np.ones(3, np.float32))  # post-fold
+    assert ps.num_updates == 1
+    assert ps._pull_versions[0] == 1  # fused pull recorded post-fold
+    s = ps.stats()
+    # one fused op counts one commit AND one pull but ONE round trip
+    assert s["fused_exchanges"] == 1
+    assert s["commits"] == 1 and s["pulls"] == 2
+    assert s["exchange_rtts"] == 2  # initial pull + one fused exchange
+
+
+def test_exchange_dup_replay_no_double_fold_or_double_advance():
+    """The lost-ACK replay contract: same seq → the fold is skipped (no
+    double-fold, num_updates advances once) while the pull half answers
+    with a fresh center and records its version exactly as a retried
+    standalone pull would — never past ``num_updates``."""
+    center = {"w": np.zeros(2, np.float32)}
+    ps = ParameterServer(center, DownpourMerge(), num_workers=1)
+    ps.pull(0)
+    d = {"w": np.ones(2, np.float32)}
+    out1, applied1 = ps.exchange(0, d, seq=1)
+    n_after = ps.num_updates
+    out2, applied2 = ps.exchange(0, d, seq=1)  # the replay
+    assert applied1 and not applied2
+    assert ps.num_updates == n_after == 1          # folded exactly once
+    assert ps._pull_versions[0] == ps.num_updates  # not double-advanced
+    assert np.array_equal(out2["w"], out1["w"])    # fresh center returned
+    s = ps.stats()
+    assert s["dup_commits"] == 1 and s["fused_exchanges"] == 2
+    assert s["num_updates"] == 1
+
+
+def test_exchange_lag_prices_previous_pull_version():
+    """DynSGD under the pipelined lag flag: the delta committed at
+    exchange N was computed from the center of exchange N−1, so τ must
+    be measured from the PREVIOUS recorded pull version — one extra
+    window of staleness, priced, not hidden."""
+    ps = ParameterServer({"w": np.zeros(1, np.float32)}, DynSGDMerge(),
+                         num_workers=1)
+    ps.pull(0)                                       # v0 = 0
+    d = {"w": np.array([2.0], np.float32)}
+    ps.exchange(0, d, lag=True)   # prev unset → cur v0: τ=0 → +2.0
+    ps.exchange(0, d, lag=True)   # prev=v0=0, updates=1: τ=1 → +1.0
+    assert np.allclose(ps.get_model()["w"], 3.0)
+    # the UN-lagged exchange would have priced τ=0 (+2.0): the flag is
+    # exactly one window of extra staleness
+    ps2 = ParameterServer({"w": np.zeros(1, np.float32)}, DynSGDMerge(),
+                          num_workers=1)
+    ps2.pull(0)
+    ps2.exchange(0, d)
+    ps2.exchange(0, d)
+    assert np.allclose(ps2.get_model()["w"], 4.0)
+
+
+def test_socket_exchange_matches_inprocess_bitwise():
+    rng = np.random.default_rng(3)
+    center = {"w": rng.normal(size=(64,)).astype(np.float32)}
+    deltas = [{"w": rng.normal(size=(64,)).astype(np.float32) * 0.1}
+              for _ in range(4)]
+    ref = ParameterServer(center, DynSGDMerge(), num_workers=1)
+    ref.pull(0)
+    for d in deltas:
+        ref.exchange(0, d, lag=True)
+
+    ps = SocketParameterServer(center, DynSGDMerge(), num_workers=1)
+    ps.initialize()
+    ps.start()
+    try:
+        c = ParameterServerClient("127.0.0.1", ps.port, 0)
+        c.pull()
+        out = None
+        for d in deltas:
+            out = c.exchange(0, d, lag=True)
+        assert _tree_equal(ps.get_model(), ref.get_model())
+        assert _tree_equal(out, ref.get_model())
+        c.close()
+    finally:
+        ps.stop()
+
+
+def test_native_exchange_matches_python_bitwise():
+    from distkeras_tpu.native import load_dkps
+
+    if load_dkps() is None:
+        pytest.skip("no C++ toolchain to build libdkps")
+    from distkeras_tpu.native_ps import (
+        NativePSClient,
+        NativeSocketParameterServer,
+    )
+
+    rng = np.random.default_rng(5)
+    center = {"w": rng.normal(size=(96,)).astype(np.float32)}
+    deltas = [{"w": rng.normal(size=(96,)).astype(np.float32) * 0.1}
+              for _ in range(4)]
+    ref = ParameterServer(center, DynSGDMerge(), num_workers=1)
+    ref.pull(0)
+    ref.exchange(0, deltas[0], seq=1, lag=True)
+    ref.exchange(0, deltas[1], seq=2, lag=True)
+    ref.exchange(0, deltas[1], seq=2, lag=True)  # dup replay
+    ref.exchange(0, deltas[2], seq=3, lag=True)
+
+    ps = NativeSocketParameterServer(center, DynSGDMerge(), num_workers=1)
+    ps.initialize()
+    ps.start()
+    try:
+        c = NativePSClient("127.0.0.1", ps.port, 0, ps.spec)
+        c.pull()
+        c.exchange(0, deltas[0], seq=1, lag=True)
+        c.exchange(0, deltas[1], seq=2, lag=True)
+        out_dup = c.exchange(0, deltas[1], seq=2, lag=True)  # dup replay
+        c.exchange(0, deltas[2], seq=3, lag=True)
+        assert _tree_equal(ps.get_model(), ref.get_model())
+        assert ps.num_updates == ref.num_updates == 3
+        # the dup returned the then-current center, not a re-fold
+        assert np.all(np.isfinite(out_dup["w"]))
+        s = ps.stats()
+        assert s["fused_exchanges"] == 4 and s["dup_commits"] == 1
+        c.close()
+    finally:
+        ps.stop()
+
+
+def test_fused_exchange_chaos_exactly_once():
+    """The acceptance chaos oracle: fused exchanges under seeded wire
+    drops (the recv drop — server folded, reply died, client replays)
+    keep the dedup exactly-once: lifetime folds == logical exchanges
+    confirmed, and no worker's pull version runs past the fold count."""
+    from distkeras_tpu.resilience.faults import FaultPlan
+    from distkeras_tpu.resilience.retry import (
+        ResilientPSClient,
+        RetryPolicy,
+    )
+
+    W, N = 2, 15
+    center = {"w": np.zeros(128, np.float32)}
+    delta = {"w": np.full(128, 1e-3, np.float32)}
+    ps = SocketParameterServer(center, DownpourMerge(), num_workers=W)
+    ps.initialize()
+    ps.start()
+    policy = RetryPolicy(max_attempts=50, base_delay=0.005,
+                         max_delay=0.05, deadline=60.0)
+    clients = [
+        ResilientPSClient(
+            lambda i=i: ParameterServerClient("127.0.0.1", ps.port, i),
+            i, policy=policy,
+        )
+        for i in range(W)
+    ]
+    plan = FaultPlan(seed=11, drop_recv=0.12, max_faults=60)
+    errors = []
+
+    def worker(i):
+        try:
+            c = clients[i]
+            c.pull()
+            for _ in range(N):
+                out = c.exchange(i, delta)
+                assert np.all(np.isfinite(out["w"]))
+        except BaseException as e:  # surfaced below
+            errors.append(e)
+
+    try:
+        with plan:
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(W)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert not errors, errors
+        assert plan.stats()["drops"] > 0  # the chaos actually bit
+        logical = sum(c.seq for c in clients)
+        assert logical == W * N
+        assert ps.num_updates == logical  # exactly-once folds
+        for i in range(W):
+            assert ps._pull_versions[i] <= ps.num_updates
+    finally:
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        ps.stop()
+
+
+def test_socket_exchange_wire_frame_wal_replay(tmp_path):
+    """A durable socket exchange logs its request frame VERBATIM
+    (REC_COMMIT_WIRE) plus the fused pull record; recovery replays both
+    through the live decode pipeline to a bit-identical server."""
+    rng = np.random.default_rng(9)
+    center = {"w": rng.normal(size=(32,)).astype(np.float32)}
+    deltas = [{"w": rng.normal(size=(32,)).astype(np.float32) * 0.1}
+              for _ in range(3)]
+    ps = SocketParameterServer(center, DynSGDMerge(), num_workers=1,
+                               wal_dir=str(tmp_path / "wal"),
+                               wal_group_window=1)
+    ps.initialize()
+    ps.start()
+    try:
+        c = ParameterServerClient("127.0.0.1", ps.port, 0)
+        c.pull()
+        for i, d in enumerate(deltas):
+            c.exchange(0, d, seq=i + 1, lag=True)
+        live_center = ps.get_model()
+        live_cur = dict(ps._pull_versions)
+        live_prev = dict(ps._prev_pull_versions)
+        c.close()
+    finally:
+        ps.stop()
+    rec = ParameterServer(center, DynSGDMerge(), num_workers=1,
+                          wal_dir=str(tmp_path / "wal"))
+    assert rec.recovered_ and rec.num_updates == 3
+    assert _tree_equal(rec.get_model(), live_center)
+    assert rec._pull_versions == live_cur
+    assert rec._prev_pull_versions == live_prev
+    rec.stop()
+
+
+def test_wal_recovery_restores_prev_pull_versions(tmp_path):
+    """A recovered server continues lag-pricing exactly where the crashed
+    one left off: the prev-pull-version map is reconstructed by replaying
+    the same cur→prev shift the live server runs."""
+    center = {"w": np.zeros(8, np.float32)}
+    d = {"w": np.ones(8, np.float32)}
+    ps = ParameterServer(center, DynSGDMerge(), num_workers=1,
+                         wal_dir=str(tmp_path / "wal"), wal_group_window=1)
+    ps.pull(0)
+    ps.exchange(0, d, lag=True)
+    ps.exchange(0, d, lag=True)
+    prev, cur = dict(ps._prev_pull_versions), dict(ps._pull_versions)
+    ps.stop()
+
+    twin = ParameterServer(center, DynSGDMerge(), num_workers=1)
+    twin.pull(0)
+    twin.exchange(0, d, lag=True)
+    twin.exchange(0, d, lag=True)
+
+    rec = ParameterServer(center, DynSGDMerge(), num_workers=1,
+                          wal_dir=str(tmp_path / "wal"))
+    assert rec.recovered_
+    assert rec._prev_pull_versions == prev
+    assert rec._pull_versions == cur
+    assert _tree_equal(rec.get_model(), twin.get_model())
+    # the continued run prices identically to the no-crash twin
+    rec.exchange(0, d, lag=True)
+    twin.exchange(0, d, lag=True)
+    assert _tree_equal(rec.get_model(), twin.get_model())
+    rec.stop()
+
+
+# -- trainer-level bit-identity (the depth-0 acceptance pin) -----------------
+
+
+def _run(cls_name, **kw):
+    import distkeras_tpu as dk
+
+    ds = blobs_dataset(n=512)
+    kw.setdefault("learning_rate", 0.05)
+    t = getattr(dk, cls_name)(
+        model_spec(), loss="sparse_softmax_cross_entropy",
+        worker_optimizer="sgd", num_workers=kw.pop("num_workers", 1),
+        batch_size=16, communication_window=2, num_epoch=2,
+        backend="ps", **kw,
+    )
+    weights = t.train(ds, shuffle=False)
+    return t, weights
+
+
+@pytest.mark.parametrize("cls_name", ["ADAG", "DOWNPOUR", "DynSGD"])
+def test_fused_depth0_bit_identical_to_unfused(cls_name):
+    """pipeline_depth=0 with the fused wire action is bit-identical to
+    the HEAD commit();pull() path (ps_fused_exchange=False IS that
+    path), per merge rule."""
+    _, w_head = _run(cls_name, ps_fused_exchange=False)
+    _, w_fused = _run(cls_name)
+    assert _tree_equal(w_head, w_fused)
+
+
+def test_fused_depth0_bit_identical_int8_leg():
+    _, w_head = _run("DOWNPOUR", compression="int8",
+                     pull_compression="int8", ps_fused_exchange=False)
+    _, w_fused = _run("DOWNPOUR", compression="int8",
+                      pull_compression="int8")
+    assert _tree_equal(w_head, w_fused)
+
+
+def test_fused_depth0_bit_identical_two_shard_leg():
+    _, w_head = _run("DynSGD", ps_num_shards=2, ps_transport="socket",
+                     ps_fused_exchange=False)
+    t, w_fused = _run("DynSGD", ps_num_shards=2, ps_transport="socket")
+    assert _tree_equal(w_head, w_fused)
+    # every shard served its windows as ONE round trip each
+    for s in t.ps_stats_["per_shard"]:
+        assert s["fused_exchanges"] == s["commits"]
+        assert s["exchange_rtts"] == s["commits"] + s["pulls"] \
+            + s["compressed_pulls"] + s["dup_commits"] \
+            - s["fused_exchanges"]
+
+
+@pytest.mark.parametrize("codec", [None, "int8"])
+def test_pipelined_downpour_bit_identical_to_serial(codec):
+    """The single DOWNPOUR worker's depth-1 loop telescopes exactly:
+    C_N == C_{N-1} + sent_N at fold scale 1, so the deferred re-base
+    reproduces the serial trajectory bit-for-bit — raw AND int8-commit
+    legs. (int8 PULL compression is excluded by construction: each
+    compressed pull is individually lossy, so the serial loop's re-base
+    onto ``decode(pull_N)`` and the pipelined ``decode(pull_{N-1}) +
+    sent_N`` legitimately differ below the quantization step — the EF
+    stream still telescopes on both.)"""
+    kw = {}
+    if codec:
+        kw = dict(compression=codec)
+    _, w0 = _run("DOWNPOUR", **kw)
+    _, w1 = _run("DOWNPOUR", ps_pipeline_depth=1, **kw)
+    assert _tree_equal(w0, w1)
+
+
+def test_pipelined_exchange_carries_lag_flag(monkeypatch):
+    """Depth 1 must price its one-window staleness: every exchange the
+    pipelined loop issues carries lag=True; the serial loop's never do."""
+    from distkeras_tpu import workers as workers_mod
+
+    seen = []
+    orig = workers_mod._BoundPS.exchange
+
+    def spy(self, worker_id, payload, seq=None, lag=False):
+        seen.append(lag)
+        return orig(self, worker_id, payload, seq=seq, lag=lag)
+
+    monkeypatch.setattr(workers_mod._BoundPS, "exchange", spy)
+    _run("DynSGD", ps_pipeline_depth=1)
+    assert seen and all(seen)
+    seen.clear()
+    _run("DynSGD")
+    assert seen and not any(seen)
+
+
+def test_trainer_rtt_counters_fused_vs_serial():
+    """The acceptance counter oracle from a real training run: with
+    fusion, exchange_rtts == windows + initial pulls (1 RTT per window);
+    without, 2×windows + initial pulls."""
+    W = 2
+    t_fused, _ = _run("DOWNPOUR", num_workers=W, ps_transport="socket")
+    s = t_fused.ps_stats_
+    windows = s["commits"]  # counted pre-ACK: exact by run end
+    assert windows > 0
+    # pull-side counters land AFTER the reply send (delivered-traffic
+    # semantics), so the end-of-run stats read may lag the last in-flight
+    # reply by up to one per worker — tolerate exactly that, nothing more
+    assert windows - W <= s["fused_exchanges"] <= windows
+    assert windows + 2 - W <= s["exchange_rtts"] <= windows + 2
+    t_head, _ = _run("DOWNPOUR", num_workers=W, ps_transport="socket",
+                     ps_fused_exchange=False)
+    sh = t_head.ps_stats_
+    assert sh["fused_exchanges"] == 0
+    assert 2 * sh["commits"] + 2 - W <= sh["exchange_rtts"] \
+        <= 2 * sh["commits"] + 2
+    # the per-phase timing proof rides ps_stats_ on every transport:
+    # fused runs never paid a standalone pull after the initial one
+    phases = t_fused.ps_stats_["exchange_phases"]
+    assert phases["commit"]["count"] == windows
+    assert "pull" not in phases
+    assert t_head.ps_stats_["exchange_phases"]["pull"]["count"] \
+        == sh["commits"]
+
+
+def test_pipelined_elastic_exactly_once_under_membership_chaos():
+    """Depth-1 elastic loop: block confirmation rides the DEFERRED
+    exchange ACK, and the exactly-once ledger survives a live join and a
+    preemption drain mid-run."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.resilience.faults import FaultPlan
+
+    ds = blobs_dataset(n=512)
+    # threshold-1 events (>= semantics), the test_elastic treatment: a
+    # live worker always completes >= 1 window (peers wait on its claimed
+    # block), so the events fire even when a 1-core host lets the other
+    # workers drain the pool first
+    plan = FaultPlan(seed=7, join_worker_at_window={0: 1},
+                     preempt_worker_at_window={1: 1})
+    t = dk.ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+                worker_optimizer="sgd", learning_rate=0.05,
+                num_workers=2, batch_size=16, communication_window=2,
+                num_epoch=2, backend="ps", elastic=True,
+                ps_pipeline_depth=1, fault_plan=plan,
+                preempt_drain_timeout=30.0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t.train(ds, shuffle=False)
+    el = t.resilience_stats_["elastic"]
+    assert el["joined"] == 1 and el["preempted"] == 1
+    assert el["drain_timeouts"] == 0
+    assert el["assigner"]["exactly_once"], el["assigner"]
+    assert t.ps_stats_["fused_exchanges"] == t.ps_stats_["commits"]
+    assert np.isfinite(final_loss(t))
+
+
+# -- the EASGD drain satellite (PR 9 follow-up) ------------------------------
+
+
+def test_easgd_clean_drain_commits_final_elastic_difference(monkeypatch):
+    """A cleanly drained elastic-rule worker must commit its final
+    elastic difference before deregistering — the center ends at
+    ``c + α·(w − c)`` (pinned bitwise against the worker's stashed
+    final state), instead of silently dropping everything the local
+    variable held beyond the center."""
+    import distkeras_tpu as dk
+    from distkeras_tpu import workers as workers_mod
+    from distkeras_tpu.resilience.faults import FaultPlan
+
+    created = []
+    orig_init = workers_mod.AsyncWorker.__init__
+
+    def spy_init(self, *a, **kw):
+        orig_init(self, *a, **kw)
+        created.append(self)
+
+    monkeypatch.setattr(workers_mod.AsyncWorker, "__init__", spy_init)
+
+    ds = blobs_dataset(n=512)
+    plan = FaultPlan(seed=1, preempt_worker_at_window={0: 2})
+    t = dk.AEASGD(model_spec(), loss="sparse_softmax_cross_entropy",
+                  worker_optimizer="sgd", learning_rate=0.05, rho=0.5,
+                  num_workers=1, batch_size=16, communication_window=2,
+                  num_epoch=4, backend="ps", elastic=True,
+                  fault_plan=plan, preempt_drain_timeout=30.0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        weights = t.train(ds, shuffle=False)
+
+    drained = [w for w in created if hasattr(w, "drained_center_")]
+    assert len(drained) == 1, "the preempted worker ran the drain commit"
+    w = drained[0]
+    rule = t.allocate_merge_rule()
+    diff = rule.worker_commit(w.final_params_, w.drained_center_)
+    expected = rule.fold(w.drained_center_, diff, 1, 0)
+    assert _tree_equal(weights, expected)
+    # the drain commit is one extra fold past the per-window commits
+    hist = [r for r in t.get_history() if "loss" in r]
+    assert t.ps_stats_["commits"] == len(hist) + 1
+    assert t.resilience_stats_["elastic"]["preempted"] == 1
