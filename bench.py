@@ -2371,7 +2371,30 @@ def main():
     ap.add_argument("--serve-legs", default="paged,int8,spec",
                     help="comma-separated serving legs to run "
                          "(paged,int8,spec)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="enable the flight recorder for every leg and "
+                         "write one Perfetto-loadable Chrome trace JSON "
+                         "here; each leg's record (and the headline "
+                         "blob) carries its path as trace_path")
     args = ap.parse_args()
+
+    if args.trace_dir:
+        from distkeras_tpu.observability import trace as _obs_trace
+
+        _obs_trace.enable()
+
+    def _finish_trace():
+        """Write the recorder out (one file per bench invocation; every
+        leg's spans land in it) and return its path, or None."""
+        if not args.trace_dir:
+            return None
+        from distkeras_tpu.observability import trace as _obs_trace
+
+        path = _obs_trace.save(os.path.join(
+            args.trace_dir, f"bench-trace-{os.getpid()}.json"
+        ))
+        _obs_trace.disable()
+        return path
 
     if args.ps_bench or args.chaos or args.chaos_ps or args.serve:
         # PS legs are pure host-side numpy/threading; the serve leg runs the
@@ -2423,11 +2446,19 @@ def main():
                 legs=tuple(x for x in args.serve_legs.split(",") if x)))
         serve_only = args.serve and not (args.ps_bench or args.chaos
                                          or args.chaos_ps)
+        trace_path = _finish_trace()
+        if trace_path is not None:
+            # BENCH_* records link to their timeline (ISSUE 11): the one
+            # trace file carries every leg's spans, stamped per leg
+            for rec in legs.values():
+                if isinstance(rec, dict):
+                    rec["trace_path"] = trace_path
         print(json.dumps({
             "metric": "serve_bench" if serve_only else "ps_bench",
             "unit": "requests/sec" if serve_only else "ops/sec",
             "workers": args.ps_bench_workers,
             "legs": legs,
+            "trace_path": trace_path,
         }))
         sys.stdout.flush()
         return
@@ -2581,6 +2612,11 @@ def main():
             leg(title, fn, est)
     if args.scaling:
         run_scaling(accel)
+    trace_path = _finish_trace()
+    if trace_path is not None:
+        # the training-headline path writes its timeline too — one
+        # stderr record links the run to its trace file
+        log(json.dumps({"metric": "trace", "trace_path": trace_path}))
     log(f"total wall: {time.perf_counter() - t_start:.0f}s")
 
 

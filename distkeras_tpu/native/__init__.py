@@ -150,6 +150,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dkps_client_deregister.argtypes = [ctypes.c_void_p]
     lib.dkps_server_set_pool_size.restype = None
     lib.dkps_server_set_pool_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.dkps_server_set_trace.restype = None
+    lib.dkps_server_set_trace.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dkps_client_trace_scrape.restype = ctypes.c_int64
+    lib.dkps_client_trace_scrape.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+    ]
     lib.dkps_client_join.restype = ctypes.c_int
     lib.dkps_client_join.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),

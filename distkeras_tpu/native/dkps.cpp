@@ -95,6 +95,7 @@
 // the flat center, not a Python bytecode loop.
 
 #include <algorithm>
+#include <array>
 #include <atomic>
 #include <cerrno>
 #include <cfloat>
@@ -103,6 +104,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <ctime>
 #include <mutex>
 #include <thread>
 #include <unordered_map>
@@ -590,6 +592,10 @@ struct Server {
       wal.queued_bytes = 0;
       wal.has_pending = false;
     }
+    // the group-fsync span (flusher thread): the segment every
+    // deferred-ACK commit's TK_WAL_WAIT span ends on. No worker/seq —
+    // one fsync serves a whole window.
+    const uint64_t t_sync = trace_t0();
     bool ok = true;
     for (const WalRec& r : batch) {
       const char* parts[2] = {r.head, r.payload};
@@ -628,6 +634,7 @@ struct Server {
       }
       wal.cv.notify_all();
     }
+    trace_rec(TK_FSYNC, 0xffffffffull, 0, t_sync);
     return ok;
   }
 
@@ -857,6 +864,55 @@ struct Server {
   std::atomic<uint64_t> st_bytes_in{0}, st_bytes_out{0};
   std::atomic<uint64_t> st_lock_acquires{0}, st_lock_wait_ns{0},
       st_lock_hold_ns{0};
+  // Delivered-traffic settling (ISSUE 11): handlers bump this around
+  // the reply-send → counter-land window of the pull-side paths;
+  // dkps_server_stats waits (bounded) for it to reach zero so an
+  // end-of-run stats read sees every delivered reply counted — parity
+  // with the Python server's _settle_stats barrier.
+  std::atomic<int64_t> st_pending{0};
+  struct PendingGuard {
+    Server* s;
+    explicit PendingGuard(Server* srv) : s(srv) { s->st_pending += 1; }
+    ~PendingGuard() { s->st_pending -= 1; }
+  };
+
+  // Flight-recorder span ring (ISSUE 11): fixed-capacity ring of
+  // (kind, wid, seq, t0_ns, dur_ns) span records over CLOCK_MONOTONIC —
+  // the SAME clock Python's perf_counter_ns reads on Linux, so scraped
+  // spans drop into the Python tracer's timeline with no offset
+  // arithmetic. Armed by dkps_server_set_trace, DRAINED by the TRACE
+  // wire action (15). Off by default: one relaxed atomic load per
+  // traced section, nothing else.
+  static constexpr size_t kTraceCap = 8192;
+  static constexpr uint64_t TK_FOLD = 1, TK_WAL_WAIT = 2, TK_FSYNC = 3;
+  std::atomic<bool> trace_on{false};
+  std::mutex trace_mu;
+  std::vector<std::array<uint64_t, 5>> trace_ring;
+  uint64_t trace_head = 0;  // total recorded; ring slot = head % cap
+
+  static uint64_t mono_ns() {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+           static_cast<uint64_t>(ts.tv_nsec);
+  }
+
+  // 0 disables recording at the call site (mono_ns is never 0 after
+  // boot): `uint64_t t = trace_t0(); ... trace_rec(kind, w, q, t);`
+  uint64_t trace_t0() const {
+    return trace_on.load(std::memory_order_relaxed) ? mono_ns() : 0;
+  }
+
+  void trace_rec(uint64_t kind, uint64_t wid, uint64_t seq, uint64_t t0) {
+    if (t0 == 0) return;
+    const uint64_t t1 = mono_ns();
+    std::lock_guard<std::mutex> g(trace_mu);
+    if (trace_ring.size() < kTraceCap)
+      trace_ring.push_back({kind, wid, seq, t0, t1 - t0});
+    else
+      trace_ring[trace_head % kTraceCap] = {kind, wid, seq, t0, t1 - t0};
+    trace_head += 1;
+  }
 
   int listen_fd = -1;
   int port = 0;
@@ -1029,10 +1085,13 @@ struct Server {
           if (wal_on) wal_append_pull_locked(conn_wid_, num_updates);
           std::memcpy(buf.data(), center.data(), n * sizeof(float));
         }
-        if (!send_all(fd, &version, 8)) break;
-        if (!send_all(fd, buf.data(), n * sizeof(float))) break;
-        st_pulls += 1;
-        st_bytes_out += n * sizeof(float);
+        {
+          PendingGuard pg(this);  // reply-send → counter settling window
+          if (!send_all(fd, &version, 8)) break;
+          if (!send_all(fd, buf.data(), n * sizeof(float))) break;
+          st_pulls += 1;
+          st_bytes_out += n * sizeof(float);
+        }
       } else if (action == 5) {  // PULL_INT8: block-quantized center + EF
         const uint64_t nb = pull_blocks(n);
         if (qbuf.size() != n) qbuf.resize(n);
@@ -1054,16 +1113,19 @@ struct Server {
         std::lock_guard<std::mutex> wg(pe->m);
         encode_int8_blocks(buf.data(), pe->err, qbuf, pscales);
         uint32_t nb32 = static_cast<uint32_t>(nb);
-        if (!send_all(fd, &version, 8) || !send_all(fd, &nb32, 4) ||
-            !send_all(fd, pscales.data(), nb * sizeof(float)) ||
-            !send_all(fd, qbuf.data(), n)) {
-          // dropped reply: the client never received this blob — roll
-          // the residual back to its pre-pull state (still under wg)
-          rollback_int8_blocks(buf.data(), pe->err, qbuf, pscales);
-          break;
+        {
+          PendingGuard pg(this);  // settling window, see PULL
+          if (!send_all(fd, &version, 8) || !send_all(fd, &nb32, 4) ||
+              !send_all(fd, pscales.data(), nb * sizeof(float)) ||
+              !send_all(fd, qbuf.data(), n)) {
+            // dropped reply: the client never received this blob — roll
+            // the residual back to its pre-pull state (still under wg)
+            rollback_int8_blocks(buf.data(), pe->err, qbuf, pscales);
+            break;
+          }
+          st_cpulls += 1;
+          st_bytes_out += nb * sizeof(float) + n;
         }
-        st_cpulls += 1;
-        st_bytes_out += nb * sizeof(float) + n;
       } else if (action == 2) {  // COMMIT
         if (!recv_all(fd, buf.data(), n * sizeof(float))) break;
         uint8_t ack = 1;
@@ -1230,6 +1292,7 @@ struct Server {
         bool dup = false, fenced = false;
         uint64_t server_epoch;
         uint64_t tok = 0;
+        const uint64_t t_fold = trace_t0();  // ISSUE 11 fold span
         {
           StatGuard g(this);
           server_epoch = fence_epoch;
@@ -1253,6 +1316,7 @@ struct Server {
             }
           }
         }
+        trace_rec(TK_FOLD, conn_wid_, seq, t_fold);
         if (fenced) {
           st_fenced += 1;
         } else if (dup) {
@@ -1261,7 +1325,12 @@ struct Server {
           st_commits += 1;
         }
         st_bytes_in += n * sizeof(float);
-        if (tok && wal.window >= 1 && !wal_wait(tok)) break;
+        if (tok && wal.window >= 1) {
+          const uint64_t t_w = trace_t0();
+          const bool durable = wal_wait(tok);
+          trace_rec(TK_WAL_WAIT, conn_wid_, seq, t_w);
+          if (!durable) break;
+        }
         uint8_t ack = fenced ? 3 : (dup ? 2 : 1);
         if (!send_all(fd, &ack, 1)) break;
         if (!send_all(fd, &server_epoch, 8)) break;
@@ -1343,6 +1412,7 @@ struct Server {
         bool dup = false, fenced = false;
         uint64_t server_epoch, version = 0, tok = 0;
         PullErr* pe = nullptr;
+        const uint64_t t_fold = trace_t0();  // ISSUE 11 fold span
         {
           StatGuard g(this);
           server_epoch = fence_epoch;
@@ -1377,6 +1447,7 @@ struct Server {
             std::memcpy(obuf.data(), center.data(), n * sizeof(float));
           }
         }
+        trace_rec(TK_FOLD, conn_wid_, has_seq ? seq : 0, t_fold);
         if (fenced) {
           st_fenced += 1;
         } else if (dup) {
@@ -1385,34 +1456,63 @@ struct Server {
           st_commits += 1;
         }
         st_bytes_in += n * sizeof(float);
-        if (tok && wal.window >= 1 && !wal_wait(tok)) break;  // crashed
-        uint8_t ack = fenced ? 3 : (dup ? 2 : 1);
-        if (!send_all(fd, &ack, 1)) break;
-        if (!send_all(fd, &server_epoch, 8)) break;
-        if (fenced) continue;
-        if (!send_all(fd, &version, 8)) break;
-        if (!want_int8) {
-          if (!send_all(fd, obuf.data(), n * sizeof(float))) break;
-          st_pulls += 1;
-          st_bytes_out += n * sizeof(float);
-          st_fused += 1;
-        } else {
-          // block-quantize obuf + this worker's EF residual — the SAME
-          // encode/rollback helpers as PULL_INT8, so the fused and
-          // standalone compressed-pull wires cannot drift
-          std::lock_guard<std::mutex> wg(pe->m);
-          encode_int8_blocks(obuf.data(), pe->err, qbuf, pscales);
-          uint32_t nb32 = static_cast<uint32_t>(nb);
-          if (!send_all(fd, &nb32, 4) ||
-              !send_all(fd, pscales.data(), nb * sizeof(float)) ||
-              !send_all(fd, qbuf.data(), n)) {
-            rollback_int8_blocks(obuf.data(), pe->err, qbuf, pscales);
-            break;
-          }
-          st_cpulls += 1;
-          st_bytes_out += nb * sizeof(float) + n;
-          st_fused += 1;
+        if (tok && wal.window >= 1) {
+          const uint64_t t_w = trace_t0();  // deferred-ACK wait span
+          const bool durable = wal_wait(tok);
+          trace_rec(TK_WAL_WAIT, conn_wid_, has_seq ? seq : 0, t_w);
+          if (!durable) break;  // crashed
         }
+        uint8_t ack = fenced ? 3 : (dup ? 2 : 1);
+        {
+          PendingGuard pg(this);  // settling window, see PULL
+          if (!send_all(fd, &ack, 1)) break;
+          if (!send_all(fd, &server_epoch, 8)) break;
+          if (fenced) continue;
+          if (!send_all(fd, &version, 8)) break;
+          if (!want_int8) {
+            if (!send_all(fd, obuf.data(), n * sizeof(float))) break;
+            st_pulls += 1;
+            st_bytes_out += n * sizeof(float);
+            st_fused += 1;
+          } else {
+            // block-quantize obuf + this worker's EF residual — the SAME
+            // encode/rollback helpers as PULL_INT8, so the fused and
+            // standalone compressed-pull wires cannot drift
+            std::lock_guard<std::mutex> wg(pe->m);
+            encode_int8_blocks(obuf.data(), pe->err, qbuf, pscales);
+            uint32_t nb32 = static_cast<uint32_t>(nb);
+            if (!send_all(fd, &nb32, 4) ||
+                !send_all(fd, pscales.data(), nb * sizeof(float)) ||
+                !send_all(fd, qbuf.data(), n)) {
+              rollback_int8_blocks(obuf.data(), pe->err, qbuf, pscales);
+              break;
+            }
+            st_cpulls += 1;
+            st_bytes_out += nb * sizeof(float) + n;
+            st_fused += 1;
+          }
+        }
+      } else if (action == 15) {  // TRACE: drain the span ring (ISSUE 11)
+        // reply: u64 count, then count * 5 u64 records of
+        // (kind, wid, seq, t0_ns, dur_ns). DRAINING read: a scrape
+        // empties the ring, so repeated scrapes never duplicate spans.
+        std::vector<std::array<uint64_t, 5>> recs;
+        {
+          std::lock_guard<std::mutex> g(trace_mu);
+          const uint64_t have =
+              trace_head < kTraceCap ? trace_head : kTraceCap;
+          recs.reserve(have);
+          for (uint64_t k = trace_head - have; k < trace_head; ++k)
+            recs.push_back(trace_ring[k % kTraceCap]);
+          trace_ring.clear();
+          trace_head = 0;
+        }
+        uint64_t cnt = recs.size();
+        if (!send_all(fd, &cnt, 8)) break;
+        if (cnt &&
+            !send_all(fd, recs.data(),
+                      cnt * sizeof(std::array<uint64_t, 5>)))
+          break;
       } else if (action == 11) {  // SHARD_INFO: shard-map handshake
         // reply: u32 shard_id, u32 num_shards (0 = unsharded), u64
         // fence_epoch — the sharded client verifies it is wired to the
@@ -1686,6 +1786,16 @@ void dkps_server_record_pull(void* h, uint32_t wid) {
 void dkps_server_stats(void* h, uint64_t* out) {
   auto* s = static_cast<Server*>(h);
   s->expire_leases(/*force=*/true);
+  // settling barrier (ISSUE 11): pull-side counters land after the
+  // reply send — wait (bounded) for in-flight reply windows to close so
+  // an end-of-run read is exact; under continuous traffic the gauge
+  // passes through zero between ops, and a wedged sender degrades to
+  // the historical may-lag semantics after the deadline
+  const auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::seconds(1);
+  while (s->st_pending.load() != 0 &&
+         std::chrono::steady_clock::now() < deadline)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
   out[0] = s->st_pulls.load();
   out[1] = s->st_cpulls.load();
   out[2] = s->st_commits.load();
@@ -1722,6 +1832,14 @@ void dkps_server_stats(void* h, uint64_t* out) {
 // adjust it from there.
 void dkps_server_set_pool_size(void* h, int64_t n) {
   static_cast<Server*>(h)->st_pool.store(n);
+}
+
+// Flight recorder (ISSUE 11): arm/disarm the server's span ring. Spans
+// cover the EXCHANGE/COMMIT_SEQ_E fold sections, the deferred-ACK WAL
+// wait, and the flusher's group fsync; drain them with the TRACE wire
+// action (dkps_client_trace_scrape).
+void dkps_server_set_trace(void* h, int on) {
+  static_cast<Server*>(h)->trace_on.store(on != 0);
 }
 
 // -- durable-state restore (crash recovery; the Python wrapper replays
@@ -1992,6 +2110,30 @@ int dkps_client_drain(void* h, uint8_t timed_out) {
   if (!send_all(c->fd, header, 2) || !recv_all(c->fd, &ack, 1) || ack != 1)
     return -1;
   return 0;
+}
+
+// trace scrape (action 15, ISSUE 11): drain the server's span ring into
+// `out` (room for max_recs records of 5 u64: kind, wid, seq, t0_ns,
+// dur_ns). Returns the record count written (the remainder of an
+// overfull ring is read off the wire and discarded so the stream stays
+// framed), or -1 on transport failure.
+int64_t dkps_client_trace_scrape(void* h, uint64_t* out,
+                                 uint64_t max_recs) {
+  auto* c = static_cast<Client*>(h);
+  uint8_t action = 15;
+  uint64_t cnt = 0;
+  if (!send_all(c->fd, &action, 1) || !recv_all(c->fd, &cnt, 8))
+    return -1;
+  const uint64_t keep = cnt < max_recs ? cnt : max_recs;
+  if (keep && !recv_all(c->fd, out, keep * 5 * 8)) return -1;
+  uint64_t left = (cnt - keep) * 5 * 8;
+  char sink[4096];
+  while (left) {
+    const uint64_t k = left < sizeof(sink) ? left : sizeof(sink);
+    if (!recv_all(c->fd, sink, k)) return -1;
+    left -= k;
+  }
+  return static_cast<int64_t>(keep);
 }
 
 // deregister (action 8): clean exit — drop the lease, no eviction counted

@@ -407,6 +407,57 @@ class NativeSocketParameterServer:
             "shard_id": int(shard_id), "num_shards": int(num_shards),
         }
 
+    # -- flight recorder (ISSUE 11, distkeras_tpu/observability) -------------
+
+    #: span-kind → name map for the C++ ring (dkps.cpp TK_*): the scraped
+    #: spans use the same "ps.*" namespace the Python server records, so
+    #: a Perfetto timeline reads identically across transports
+    _TRACE_KINDS = {1: "ps.fold", 2: "ps.wal_wait", 3: "wal.fsync"}
+
+    def set_trace(self, on: bool) -> None:
+        """Arm (or disarm) the C++ span ring: fold sections, deferred-ACK
+        WAL waits, and group fsyncs start recording (CLOCK_MONOTONIC ns —
+        the Python tracer's clock)."""
+        self._lib.dkps_server_set_trace(self._handle, 1 if on else 0)
+
+    def scrape_trace_events(self, max_records: int = 8192) -> list[dict]:
+        """Drain the server's span ring over the TRACE wire action into
+        tracer-shaped event dicts (the ``observability.trace.add_events``
+        contract). The correlation id is rebuilt from the wire-carried
+        (worker id, seqno) — ``w<id>:s<seq>`` — matching what the
+        resilient client stamped on the worker side; spans without a
+        seqno (plain commits, fsyncs) carry the worker id alone or no
+        corr at all."""
+        import ctypes as _ct
+
+        client = NativePSClient("127.0.0.1", self.port, 2**32 - 2,
+                                self.spec)
+        try:
+            buf = (_ct.c_uint64 * (5 * max_records))()
+            n = int(self._lib.dkps_client_trace_scrape(
+                client._handle, buf, max_records
+            ))
+        finally:
+            client.close()
+        if n < 0:
+            raise ConnectionError("dkps trace scrape failed")
+        events = []
+        for i in range(n):
+            kind, wid, seq, t0, dur = buf[5 * i : 5 * i + 5]
+            if wid == 0xFFFFFFFF:
+                corr = None          # server-internal (flusher fsync)
+            elif seq:
+                corr = f"w{wid}:s{seq}"
+            else:
+                corr = f"w{wid}"
+            events.append({
+                "name": self._TRACE_KINDS.get(kind, f"ps.kind{kind}"),
+                "cat": "dkps", "corr": corr, "t0_ns": int(t0),
+                "dur_ns": int(dur), "tid": 1 + (self.port & 0xFFFF),
+                "tname": f"dkps:{self.port}",
+            })
+        return events
+
 
 class NativePSClient:
     """Worker-side proxy over the C ABI — same call surface as

@@ -1,0 +1,39 @@
+"""Observability: end-to-end tracing + the unified metrics surface.
+
+The flight recorder the ROADMAP directions (train→serve streaming,
+SLO-aware scheduling, shm transport) are debugged against:
+
+- :mod:`distkeras_tpu.observability.trace` — zero-cost-when-off spans
+  (thread-local ring buffers, monotonic clocks) emitting Chrome
+  trace-event JSON loadable in Perfetto, with a correlation id
+  (worker id + seqno, or serving request id) stitching one EXCHANGE
+  across the worker thread, the PS handler, the WAL flusher, chain
+  replicas, and the native C++ server ring.
+- :mod:`distkeras_tpu.observability.metrics` — a typed registry
+  normalizing ``ps.stats()`` / serving / WAL counters into named
+  metrics with Prometheus text + JSON snapshot exporters, served live
+  via the ``metrics`` wire action on ``SocketParameterServer`` and
+  ``GenerationServer``, plus the single-document
+  :func:`~distkeras_tpu.observability.metrics.health_snapshot`.
+- ``python -m distkeras_tpu.observability`` — ``dump`` / ``tail`` a
+  live server's metrics, or emit the ``health`` snapshot.
+
+Trainer knobs: ``trace=True`` (enable), ``trace_dir=`` (write the
+timeline file, path lands in ``trainer.trace_path_``),
+``trace_sample=`` (deterministic span sampling). ``bench.py`` legs take
+``--trace-dir`` and record ``trace_path`` in their stdout JSON.
+"""
+
+from distkeras_tpu.observability import trace
+from distkeras_tpu.observability.metrics import (
+    MetricsRegistry,
+    health_snapshot,
+    phase_metrics,
+    ps_metrics,
+    serving_metrics,
+)
+
+__all__ = [
+    "trace", "MetricsRegistry", "ps_metrics", "serving_metrics",
+    "phase_metrics", "health_snapshot",
+]

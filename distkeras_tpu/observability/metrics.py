@@ -1,0 +1,393 @@
+"""Unified metrics surface: typed registry + Prometheus/JSON exporters.
+
+Every subsystem in the rebuild already counts — ``ps.stats()`` /
+``aggregate_ps_stats`` (PS contention, WAL, elastic membership),
+``GenerationServer.stats()`` (serving), the worker phase histograms —
+but each with its own ad-hoc dict shape. This module normalizes them
+into ONE registry of *typed* metrics (counter / gauge / histogram) with
+two exporters:
+
+- :meth:`MetricsRegistry.to_prometheus` — the text exposition format
+  (``# HELP`` / ``# TYPE`` + samples; version 0.0.4), served live from
+  ``SocketParameterServer`` and ``GenerationServer`` via the ``metrics``
+  wire action and scraped by ``python -m distkeras_tpu.observability``;
+- :meth:`MetricsRegistry.to_json` — a JSON-clean snapshot (the shape the
+  health snapshot and CI artifacts embed).
+
+The normalizers (:func:`ps_metrics`, :func:`serving_metrics`,
+:func:`wal_metrics`, :func:`phase_metrics`) own the stat-key → metric
+mapping, so a new counter lands on the wire by adding ONE schema row —
+not another bespoke dump. :func:`health_snapshot` folds WAL health
+(``resilience.wal.verify_tree``), metrics, and membership into one JSON
+document — the single health artifact that replaces the separate
+wal-verify / ps-stats / membership dumps.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterable
+
+__all__ = [
+    "Metric", "MetricsRegistry", "ps_metrics", "serving_metrics",
+    "wal_metrics", "phase_metrics", "health_snapshot",
+]
+
+_KINDS = ("counter", "gauge", "histogram")
+
+
+class Metric:
+    """One named metric: a kind, help text, and labeled samples.
+
+    ``samples`` is a list of ``(labels, value)`` where ``labels`` is a
+    (possibly empty) tuple of ``(key, value)`` pairs — tuples, not
+    dicts, so a (name, labels) series is hashable and re-observing it
+    overwrites rather than duplicates. Histogram values are dicts
+    ``{"buckets": [(le, cumulative_count), ...], "sum": s, "count": n}``
+    with ``le`` ascending and an implicit ``+Inf`` == ``count``.
+    """
+
+    __slots__ = ("name", "kind", "help", "_samples")
+
+    def __init__(self, name: str, kind: str, help_: str = ""):
+        if kind not in _KINDS:
+            raise ValueError(f"kind must be one of {_KINDS}, got {kind!r}")
+        self.name = name
+        self.kind = kind
+        self.help = help_
+        self._samples: dict[tuple, Any] = {}
+
+    def observe(self, value, labels: dict | None = None) -> None:
+        key = tuple(sorted((labels or {}).items()))
+        self._samples[key] = value
+
+    @property
+    def samples(self) -> list[tuple[tuple, Any]]:
+        return list(self._samples.items())
+
+
+class MetricsRegistry:
+    """Insertion-ordered collection of :class:`Metric` (one per name;
+    re-declaring with a different kind is a programming error and raises
+    — the registry is what keeps the surface *typed*)."""
+
+    def __init__(self):
+        self._metrics: dict[str, Metric] = {}
+
+    def declare(self, name: str, kind: str, help_: str = "") -> Metric:
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = Metric(name, kind, help_)
+        elif m.kind != kind:
+            raise ValueError(
+                f"metric {name!r} already declared as {m.kind}, "
+                f"cannot re-declare as {kind}"
+            )
+        return m
+
+    def counter(self, name: str, value, labels: dict | None = None,
+                help_: str = "") -> None:
+        self.declare(name, "counter", help_).observe(value, labels)
+
+    def gauge(self, name: str, value, labels: dict | None = None,
+              help_: str = "") -> None:
+        self.declare(name, "gauge", help_).observe(value, labels)
+
+    def histogram(self, name: str, buckets: list[tuple[float, int]],
+                  sum_: float, count: int, labels: dict | None = None,
+                  help_: str = "") -> None:
+        self.declare(name, "histogram", help_).observe(
+            {"buckets": list(buckets), "sum": float(sum_),
+             "count": int(count)}, labels,
+        )
+
+    def __iter__(self) -> Iterable[Metric]:
+        return iter(self._metrics.values())
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    # -- exporters -----------------------------------------------------------
+
+    def to_json(self) -> dict:
+        """JSON-clean snapshot: ``{name: {"kind", "help", "samples":
+        [{"labels": {...}, "value": ...}]}}``."""
+        out = {}
+        for m in self:
+            out[m.name] = {
+                "kind": m.kind, "help": m.help,
+                "samples": [
+                    {"labels": dict(lbl), "value": val}
+                    for lbl, val in m.samples
+                ],
+            }
+        return out
+
+    def to_prometheus(self) -> str:
+        """Text exposition (0.0.4): HELP/TYPE headers + one line per
+        sample; counters get the ``_total`` suffix convention from their
+        declared name (the schemas below already carry it); histograms
+        expand to ``_bucket{le=...}`` / ``_sum`` / ``_count``."""
+        lines = []
+        for m in self:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for lbl, val in m.samples:
+                if m.kind == "histogram":
+                    for le, c in val["buckets"]:
+                        lines.append(_sample_line(
+                            m.name + "_bucket",
+                            lbl + (("le", _fmt_le(le)),), c))
+                    lines.append(_sample_line(
+                        m.name + "_bucket", lbl + (("le", "+Inf"),),
+                        val["count"]))
+                    lines.append(_sample_line(m.name + "_sum", lbl,
+                                              val["sum"]))
+                    lines.append(_sample_line(m.name + "_count", lbl,
+                                              val["count"]))
+                else:
+                    lines.append(_sample_line(m.name, lbl, val))
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_le(le) -> str:
+    return "+Inf" if le in (None, float("inf")) else repr(float(le))
+
+
+def _sample_line(name: str, labels: tuple, value) -> str:
+    if labels:
+        body = ",".join(
+            f'{k}="{_escape(str(v))}"' for k, v in labels
+        )
+        name = f"{name}{{{body}}}"
+    if isinstance(value, float):
+        return f"{name} {value!r}"
+    return f"{name} {value}"
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+# -- normalizers: stats dicts → typed metrics --------------------------------
+
+#: ``ps.stats()`` key → (metric name, kind, help). Rates and derived
+#: means are EXCLUDED by design: Prometheus derives rates from counters
+#: (``rate()``), and re-exporting ours would double-encode them; the
+#: JSON snapshot keeps the raw stats dict next to the metrics anyway.
+_PS_SCHEMA: tuple[tuple[str, str, str, str], ...] = (
+    ("pulls", "dk_ps_pulls_total", "counter", "raw center pulls served"),
+    ("compressed_pulls", "dk_ps_compressed_pulls_total", "counter",
+     "int8 error-feedback pulls served"),
+    ("commits", "dk_ps_commits_total", "counter", "commits folded"),
+    ("dup_commits", "dk_ps_dup_commits_total", "counter",
+     "replayed commits the seqno dedup refused to double-fold"),
+    ("fused_exchanges", "dk_ps_fused_exchanges_total", "counter",
+     "single-RTT fused commit+pull exchanges served"),
+    ("exchange_rtts", "dk_ps_exchange_rtts_total", "counter",
+     "wire round trips spent on exchange traffic"),
+    ("fenced_commits", "dk_ps_fenced_commits_total", "counter",
+     "commits rejected by the fencing epoch"),
+    ("bytes_in", "dk_ps_bytes_in_total", "counter",
+     "payload bytes received (commit direction, wire size)"),
+    ("bytes_out", "dk_ps_bytes_out_total", "counter",
+     "payload bytes sent (pull direction, wire size)"),
+    ("center_lock_acquires", "dk_ps_center_lock_acquires_total",
+     "counter", "center-lock acquisitions"),
+    ("center_lock_wait_ns", "dk_ps_center_lock_wait_ns_total", "counter",
+     "total ns spent waiting on the center lock"),
+    ("center_lock_hold_ns", "dk_ps_center_lock_hold_ns_total", "counter",
+     "total ns the center lock was held"),
+    ("num_updates", "dk_ps_num_updates", "gauge",
+     "lifetime fold count (durable across failover)"),
+    ("active_workers", "dk_ps_active_workers", "gauge",
+     "workers holding a live lease"),
+    ("evicted_workers", "dk_ps_evicted_workers_total", "counter",
+     "lease-lapse evictions"),
+    ("heartbeats", "dk_ps_heartbeats_total", "counter",
+     "lease renewals received"),
+    ("worker_retries", "dk_ps_worker_retries_total", "counter",
+     "cumulative client retry count (as reported by heartbeats)"),
+    ("wal_records", "dk_ps_wal_records_total", "counter",
+     "WAL records appended"),
+    ("wal_fsyncs", "dk_ps_wal_fsyncs_total", "counter",
+     "real fsync syscalls issued by the WAL"),
+    ("wal_group_max", "dk_ps_wal_group_max", "gauge",
+     "largest commit window one fsync ever released"),
+    ("pool_size", "dk_ps_pool_size", "gauge",
+     "elastic worker pool gauge (configured + joins - drains)"),
+    ("joined_workers", "dk_ps_joined_workers_total", "counter",
+     "lifetime elastic live-joins"),
+    ("preempted_workers", "dk_ps_preempted_workers_total", "counter",
+     "lifetime preemption drains"),
+    ("drain_timeouts", "dk_ps_drain_timeouts_total", "counter",
+     "drains whose deadline lapsed into force-drain"),
+    ("elapsed_s", "dk_ps_uptime_seconds", "gauge",
+     "seconds since server construction"),
+)
+
+_SERVING_SCHEMA: tuple[tuple[str, str, str, str], ...] = (
+    ("submitted", "dk_serve_submitted_total", "counter",
+     "requests accepted into the admission queue"),
+    ("admitted", "dk_serve_admitted_total", "counter",
+     "requests admitted into the running batch"),
+    ("completed", "dk_serve_completed_total", "counter",
+     "requests finished successfully"),
+    ("cancelled", "dk_serve_cancelled_total", "counter",
+     "requests cancelled (client death / explicit cancel)"),
+    ("rejected", "dk_serve_rejected_total", "counter",
+     "requests rejected by queue backpressure"),
+    ("failed", "dk_serve_failed_total", "counter", "requests failed"),
+    ("steps", "dk_serve_decode_steps_total", "counter",
+     "batched decode iterations executed"),
+    ("prefills", "dk_serve_prefills_total", "counter",
+     "per-request prefills executed"),
+    ("tokens_generated", "dk_serve_tokens_generated_total", "counter",
+     "new tokens emitted by completed requests"),
+    ("occupancy_sum", "dk_serve_occupancy_sum_total", "counter",
+     "sum over steps of active batch rows (mean = /steps)"),
+    ("spec_rounds", "dk_serve_spec_rounds_total", "counter",
+     "speculative verify rounds"),
+    ("spec_proposed", "dk_serve_spec_proposed_total", "counter",
+     "draft tokens proposed"),
+    ("spec_accepted", "dk_serve_spec_accepted_total", "counter",
+     "draft tokens accepted"),
+    ("connections", "dk_serve_connections_total", "counter",
+     "client connections accepted"),
+    ("open_connections", "dk_serve_open_connections", "gauge",
+     "currently open client connections"),
+    ("dead_connections", "dk_serve_dead_connections_total", "counter",
+     "clients detected dead mid-generation"),
+    ("queued", "dk_serve_queue_depth", "gauge",
+     "requests waiting in the admission queue"),
+    ("active", "dk_serve_active_requests", "gauge",
+     "requests currently occupying batch rows"),
+    ("blocks_in_use", "dk_serve_blocks_in_use", "gauge",
+     "KV-cache blocks allocated to live requests"),
+    ("blocks_free", "dk_serve_blocks_free", "gauge",
+     "KV-cache blocks free in the pool"),
+    ("blocks_high_water", "dk_serve_blocks_high_water", "gauge",
+     "peak concurrent KV-cache block allocation"),
+)
+
+
+def _apply_schema(reg: MetricsRegistry, schema, stats: dict,
+                  labels: dict | None) -> None:
+    for key, name, kind, help_ in schema:
+        if key not in stats:
+            continue
+        val = stats[key]
+        if kind == "counter":
+            reg.counter(name, val, labels, help_)
+        else:
+            reg.gauge(name, val, labels, help_)
+
+
+def ps_metrics(stats: dict, labels: dict | None = None,
+               registry: MetricsRegistry | None = None) -> MetricsRegistry:
+    """Normalize one ``ps.stats()`` dict — or an ``aggregate_ps_stats``
+    roll-up, whose ``per_shard`` list fans out into ``shard``-labeled
+    series next to the aggregate — into the registry."""
+    reg = registry if registry is not None else MetricsRegistry()
+    _apply_schema(reg, _PS_SCHEMA, stats, labels)
+    for shard in stats.get("per_shard", ()):
+        lbl = dict(labels or {})
+        lbl["shard"] = str(shard.get("shard_id", "?"))
+        _apply_schema(reg, _PS_SCHEMA, shard, lbl)
+    phases = stats.get("exchange_phases")
+    if phases:
+        phase_metrics(phases, labels=labels, registry=reg)
+    return reg
+
+
+def serving_metrics(stats: dict, labels: dict | None = None,
+                    registry: MetricsRegistry | None = None,
+                    ) -> MetricsRegistry:
+    """Normalize a ``GenerationServer.stats()`` /
+    ``GenerationEngine.stats()`` dict."""
+    reg = registry if registry is not None else MetricsRegistry()
+    _apply_schema(reg, _SERVING_SCHEMA, stats, labels)
+    return reg
+
+
+def phase_metrics(phases: dict, labels: dict | None = None,
+                  registry: MetricsRegistry | None = None,
+                  ) -> MetricsRegistry:
+    """Normalize the worker exchange-phase histograms
+    (``trainer.ps_stats_["exchange_phases"]`` — per-phase count/total/
+    max + log2 ms buckets) into ONE Prometheus histogram labeled by
+    phase."""
+    reg = registry if registry is not None else MetricsRegistry()
+    for phase, rec in phases.items():
+        lbl = dict(labels or {})
+        lbl["phase"] = phase
+        edges = [e for e in rec.get("hist_ms_le", []) if e != "inf"]
+        counts = rec.get("hist", [])
+        cum, buckets = 0, []
+        for le, c in zip(edges, counts):
+            cum += c
+            buckets.append((float(le), cum))
+        reg.histogram(
+            "dk_worker_exchange_phase_ms", buckets,
+            rec.get("total_ms", 0.0), rec.get("count", 0), lbl,
+            "per-window exchange phase latency (ms) by phase",
+        )
+        reg.gauge("dk_worker_exchange_phase_max_ms", rec.get("max_ms", 0.0),
+                  lbl, "worst single phase sample (ms)")
+    return reg
+
+
+# -- the one health document -------------------------------------------------
+
+_MEMBERSHIP_KEYS = (
+    "pool_size", "active_workers", "joined_workers", "preempted_workers",
+    "drain_timeouts", "evicted_workers", "num_updates",
+)
+
+
+def health_snapshot(wal_root: str | None = None,
+                    ps_stats: dict | None = None,
+                    serving_stats: dict | None = None) -> dict:
+    """ONE JSON health document: WAL health (``verify_tree`` — CRC-valid
+    prefixes, torn tails, record totals), the normalized metrics
+    snapshot, and the membership gauges — replacing the three separate
+    ad-hoc dumps (wal-verify JSON, raw ``ps.stats()``, elastic
+    membership counters) that CI and the chaos tests used to collect
+    independently. Every section is optional; ``ok`` is the AND of the
+    sections that can fail."""
+    out: dict = {"ok": True, "generated_unix_s": time.time()}
+    if wal_root is not None:
+        from distkeras_tpu.resilience.wal import verify_tree
+
+        wal = verify_tree(wal_root)
+        out["wal"] = wal
+        out["ok"] = out["ok"] and bool(wal.get("ok"))
+    reg = MetricsRegistry()
+    if ps_stats is not None:
+        ps_metrics(ps_stats, registry=reg)
+        out["membership"] = {
+            k: ps_stats[k] for k in _MEMBERSHIP_KEYS if k in ps_stats
+        }
+        out["ps_stats"] = _json_clean(ps_stats)
+    if serving_stats is not None:
+        serving_metrics(serving_stats, registry=reg)
+        out["serving_stats"] = _json_clean(serving_stats)
+    if len(reg):
+        out["metrics"] = reg.to_json()
+    return out
+
+
+def _json_clean(obj):
+    """Best-effort JSON coercion for stats dicts (numpy scalars etc.)."""
+    import numpy as np
+
+    if isinstance(obj, dict):
+        return {str(k): _json_clean(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_clean(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    return obj

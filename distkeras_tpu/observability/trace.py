@@ -1,0 +1,341 @@
+"""Flight-recorder tracing: zero-cost-when-off spans → Chrome trace JSON.
+
+The reference's only instrumentation was the trainers' wall-clock
+bookkeeping (SURVEY.md: ``distkeras.trainers`` ``training_time``); this
+module is the rebuild's real timeline: every interesting section of the
+PS exchange, WAL, elastic-membership, and serving stacks opens a *span*
+here, and a run with tracing enabled writes one Chrome-trace-event JSON
+file loadable in Perfetto (https://ui.perfetto.dev) where a single fused
+EXCHANGE stitches across the worker thread, the PS handler, the WAL
+flusher, the chain replica, and the C++ native server into one timeline.
+
+Design constraints, in order:
+
+1. **Zero cost when off.** Tracing is off by default and the hot paths
+   (worker window loop, PS fold, serving decode step) call into this
+   module unconditionally — so the off path must be one module-global
+   read plus a no-op. ``span()`` returns a shared no-op context manager
+   singleton, ``record``/``set_corr``/``instant`` return immediately:
+   no allocation, no locks, no clock reads (the off-mode
+   allocation-freeness is pinned by test).
+2. **Cheap when on.** Events land in per-thread ring buffers (no lock on
+   the record path; the only lock is one registration per thread) as
+   plain tuples; ring overflow drops the OLDEST events (a flight
+   recorder keeps the recent past). Timestamps are
+   ``time.perf_counter_ns()`` — CLOCK_MONOTONIC on Linux, the SAME clock
+   the native ``dkps.cpp`` span ring uses (``clock_gettime(
+   CLOCK_MONOTONIC)``), so scraped C++ spans and Python spans share one
+   timebase within a host without any offset arithmetic.
+3. **Correlation.** A span records the *correlation id* in effect on its
+   thread when it CLOSES (or an explicit ``corr=``). The worker loop
+   sets ``w<id>:x<n>`` per window, the resilient client overrides with
+   ``w<id>:s<seq>`` when it assigns the commit seqno (the id the wire
+   actually carries), the socket client stamps the current corr into the
+   request frame, and the PS handler adopts the frame's corr — so the
+   worker-side exchange span and the PS-side fold/WAL-append spans share
+   one id across threads, processes, and (via the seqno) the C++ wire.
+
+Sampling: ``enable(sample=0.1)`` keeps a deterministic ~10% of spans
+(counter-based, per thread — no RNG on the hot path). ``corr``
+propagation is never sampled out, only span recording is.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+__all__ = [
+    "enable", "disable", "enabled", "span", "record", "instant",
+    "set_corr", "current_corr", "add_events", "events", "save",
+]
+
+#: module-global tracer; ``None`` = disabled (the one read every
+#: call-site pays when tracing is off)
+_tracer = None
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager returned while tracing is off
+    (and for sampled-out spans): entering/exiting allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    """One live span: records ``(t_enter, t_exit)`` into the thread's
+    ring on exit. Corr resolution: an explicit ``corr=`` wins; otherwise
+    the thread's corr at CLOSE time — a span that wraps a wire call
+    inherits the id the client assigned inside it (see module doc)."""
+
+    __slots__ = ("_tr", "name", "cat", "corr", "args", "t0")
+
+    def __init__(self, tr, name, cat, corr, args):
+        self._tr = tr
+        self.name = name
+        self.cat = cat
+        self.corr = corr
+        self.args = args
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter_ns()
+        tr = self._tr
+        st = tr._state()
+        corr = self.corr if self.corr is not None else st.corr
+        tr._record(st, self.name, self.cat, corr, self.t0, t1 - self.t0,
+                   self.args)
+        return False
+
+
+class _ThreadState:
+    """Per-thread recorder state (ring + corr + sampling counter)."""
+
+    __slots__ = ("ring", "idx", "corr", "n_seen", "tid", "tname")
+
+    def __init__(self, cap: int):
+        self.ring: list = [None] * cap
+        self.idx = 0          # total events recorded (ring head = idx-1)
+        self.corr: str | None = None
+        self.n_seen = 0       # sampling counter (spans offered)
+        self.tid = threading.get_native_id()
+        self.tname = threading.current_thread().name
+
+
+class Tracer:
+    """The enabled-state recorder. Use the module functions; this class
+    is public only so tests can poke at ring internals."""
+
+    def __init__(self, ring_size: int = 65536, sample: float = 1.0):
+        if ring_size < 16:
+            raise ValueError(f"ring_size must be >= 16, got {ring_size}")
+        if not 0.0 < sample <= 1.0:
+            raise ValueError(f"sample must be in (0, 1], got {sample}")
+        self.ring_size = int(ring_size)
+        self.sample = float(sample)
+        self._tls = threading.local()
+        self._states: list[_ThreadState] = []
+        self._reg_lock = threading.Lock()
+        # foreign events merged in by scrapers (the native dkps ring, a
+        # peer process's snapshot): already-shaped dicts, see add_events
+        self._foreign: list[dict] = []
+
+    def _state(self) -> _ThreadState:
+        st = getattr(self._tls, "st", None)
+        if st is None:
+            st = self._tls.st = _ThreadState(self.ring_size)
+            with self._reg_lock:
+                self._states.append(st)
+        return st
+
+    def _record(self, st: _ThreadState, name, cat, corr, t0, dur, args):
+        if self.sample < 1.0:
+            st.n_seen += 1
+            # deterministic counter sampling: record iff the scaled
+            # counter crossed an integer — exactly ~sample of spans,
+            # no RNG, no per-thread drift
+            if int(st.n_seen * self.sample) == int(
+                    (st.n_seen - 1) * self.sample):
+                return
+        st.ring[st.idx % self.ring_size] = (name, cat, corr, t0, dur, args)
+        st.idx += 1
+
+    def add_events(self, evs: list[dict]) -> None:
+        with self._reg_lock:
+            self._foreign.extend(evs)
+
+    def events(self) -> list[dict]:
+        """Every recorded event as a list of dicts (oldest first per
+        thread), merged across threads + foreign sources and sorted by
+        start time. Keys: name, cat, corr, t0_ns, dur_ns, tid, tname,
+        args."""
+        out = []
+        with self._reg_lock:
+            states = list(self._states)
+            foreign = list(self._foreign)
+        for st in states:
+            n = min(st.idx, self.ring_size)
+            start = st.idx - n
+            for k in range(start, st.idx):
+                ev = st.ring[k % self.ring_size]
+                if ev is None:
+                    continue
+                name, cat, corr, t0, dur, args = ev
+                out.append({
+                    "name": name, "cat": cat, "corr": corr,
+                    "t0_ns": t0, "dur_ns": dur,
+                    "tid": st.tid, "tname": st.tname, "args": args,
+                })
+        out.extend(foreign)
+        out.sort(key=lambda e: e["t0_ns"])
+        return out
+
+    def dropped(self) -> int:
+        """Events lost to ring overflow (flight-recorder semantics:
+        oldest dropped first), totalled across threads."""
+        with self._reg_lock:
+            states = list(self._states)
+        return sum(max(0, st.idx - self.ring_size) for st in states)
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def enable(ring_size: int = 65536, sample: float = 1.0) -> Tracer:
+    """Turn tracing on (idempotent: an already-enabled tracer is kept —
+    nested enables from a bench leg inside a traced trainer must not
+    discard the outer recorder's rings)."""
+    global _tracer
+    if _tracer is None:
+        _tracer = Tracer(ring_size=ring_size, sample=sample)
+    return _tracer
+
+
+def disable() -> None:
+    """Turn tracing off and discard the recorder (hot paths return to
+    the one-global-read no-op)."""
+    global _tracer
+    _tracer = None
+
+
+def span(name: str, cat: str = "", corr: str | None = None,
+         args: dict | None = None):
+    """Open a span: ``with trace.span("ps.fold"): ...``. Returns the
+    shared no-op singleton when tracing is off — the off-mode call is
+    allocation-free."""
+    tr = _tracer
+    if tr is None:
+        return _NOOP_SPAN
+    return _Span(tr, name, cat, corr, args)
+
+
+def record(name: str, t0_ns: int, t1_ns: int, cat: str = "",
+           corr: str | None = None, args: dict | None = None) -> None:
+    """Record a completed span retroactively from two timestamps the
+    caller already took (the worker phase histograms' path: they clock
+    with ``perf_counter`` anyway, so tracing adds no extra clock reads).
+    No-op when off."""
+    tr = _tracer
+    if tr is None:
+        return
+    st = tr._state()
+    tr._record(st, name, cat, corr if corr is not None else st.corr,
+               t0_ns, t1_ns - t0_ns, args)
+
+
+def instant(name: str, cat: str = "", corr: str | None = None,
+            args: dict | None = None) -> None:
+    """Record a point event (zero-duration span). No-op when off."""
+    tr = _tracer
+    if tr is None:
+        return
+    st = tr._state()
+    t = time.perf_counter_ns()
+    tr._record(st, name, cat, corr if corr is not None else st.corr,
+               t, 0, args)
+
+
+def set_corr(corr: str | None) -> None:
+    """Set this thread's correlation id; spans without an explicit
+    ``corr=`` record whatever is in effect when they close. No-op when
+    off (corr is only consumed by recording)."""
+    tr = _tracer
+    if tr is None:
+        return
+    tr._state().corr = corr
+
+
+def current_corr() -> str | None:
+    """This thread's correlation id (None when off/unset) — the socket
+    client reads it to stamp outgoing commit/exchange frames."""
+    tr = _tracer
+    if tr is None:
+        return None
+    return tr._state().corr
+
+
+def add_events(evs: list[dict]) -> None:
+    """Merge foreign pre-shaped events (the native dkps span ring, a
+    peer process's ``events()`` snapshot). Each dict needs ``name``,
+    ``t0_ns``, ``dur_ns``; ``cat``/``corr``/``tid``/``tname``/``args``
+    are optional. No-op when off."""
+    tr = _tracer
+    if tr is None:
+        return
+    shaped = []
+    for e in evs:
+        shaped.append({
+            "name": e["name"], "cat": e.get("cat", ""),
+            "corr": e.get("corr"), "t0_ns": int(e["t0_ns"]),
+            "dur_ns": int(e.get("dur_ns", 0)),
+            "tid": e.get("tid", 0),
+            "tname": e.get("tname", "foreign"), "args": e.get("args"),
+        })
+    tr.add_events(shaped)
+
+
+def events() -> list[dict]:
+    """All recorded events (see :meth:`Tracer.events`); ``[]`` when off."""
+    tr = _tracer
+    if tr is None:
+        return []
+    return tr.events()
+
+
+def save(path: str) -> str:
+    """Write everything recorded so far as Chrome trace-event JSON
+    (``{"traceEvents": [...]}``, complete-event ``ph: "X"`` records with
+    µs timestamps) — drag the file into https://ui.perfetto.dev or
+    ``chrome://tracing``. Parent directories are created. Returns
+    ``path``. Raises RuntimeError when tracing is off (nothing to save —
+    a silent empty file would read as "traced, nothing happened")."""
+    tr = _tracer
+    if tr is None:
+        raise RuntimeError("tracing is not enabled: nothing to save")
+    evs = tr.events()
+    pid = os.getpid()
+    out: list[dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "distkeras_tpu"},
+    }]
+    seen_tids: set = set()
+    for e in evs:
+        if e["tid"] not in seen_tids:
+            seen_tids.add(e["tid"])
+            out.append({
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": e["tid"], "args": {"name": e["tname"]},
+            })
+        args = dict(e["args"]) if e["args"] else {}
+        if e["corr"] is not None:
+            args["corr"] = e["corr"]
+        out.append({
+            "name": e["name"], "cat": e["cat"] or "dk", "ph": "X",
+            "ts": e["t0_ns"] / 1e3, "dur": e["dur_ns"] / 1e3,
+            "pid": pid, "tid": e["tid"], "args": args,
+        })
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": tr.dropped()},
+        }, f)
+    return path

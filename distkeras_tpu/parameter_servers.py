@@ -51,6 +51,7 @@ from typing import Any
 import numpy as np
 
 from distkeras_tpu import networking, utils
+from distkeras_tpu.observability import trace as _trace
 from distkeras_tpu.parallel.compression import is_encoded, maybe_decode
 from distkeras_tpu.parallel.merge_rules import MergeRule
 
@@ -206,6 +207,14 @@ class ParameterServer:
         # at the center's size, computed once here (structure is fixed
         # for the server's lifetime).
         self._stats_lock = threading.Lock()
+        # Delivered-traffic settling (ISSUE 11): the socket/native wire
+        # paths count pull-side traffic only AFTER the reply is fully
+        # sent, so a stats read racing the last in-flight reply could
+        # lag it. Handlers bracket the send→count window with this
+        # gauge; stats() waits for it to reach zero (bounded) before
+        # reading — end-of-run counter reads are exact, no ≤1-per-worker
+        # tolerance needed. Guarded by _stats_lock.
+        self._n_pending_replies = 0
         self._n_pulls = 0
         self._n_compressed_pulls = 0
         self._n_commits = 0
@@ -596,7 +605,8 @@ class ParameterServer:
         from distkeras_tpu.resilience import wal as _wal
 
         nbytes = self._payload_nbytes(payload)  # wire size: BEFORE decode
-        payload = maybe_decode(payload)
+        with _trace.span("ps.decode"):
+            payload = maybe_decode(payload)
         rec_payload = None
         rec_sum = 0
         rec_type = _wal.REC_COMMIT2
@@ -623,7 +633,11 @@ class ParameterServer:
             rec_sum = _zlib.adler32(rec_payload)
         snap_state = None
         wait_token = None
-        with self._lock:
+        # the fold span covers the whole center-lock section (wait +
+        # hold): in a stitched timeline it sits between the worker's
+        # exchange span and the WAL flusher's fsync span, sharing the
+        # frame's correlation id
+        with _trace.span("ps.fold"), self._lock:
             fenced = epoch is not None and epoch != self.fence_epoch
             server_epoch = self.fence_epoch
             dup = False
@@ -738,7 +752,9 @@ class ParameterServer:
                 # connection (the C++ handler breaks the same way), the
                 # client replays, and the dedup table on whatever server
                 # answers next folds it at most once.
-                if not self._wal.wait_durable(wait_token):
+                with _trace.span("ps.wal_wait"):
+                    durable = self._wal.wait_durable(wait_token)
+                if not durable:
                     raise networking.ProtocolError(
                         "commit folded but its WAL group never became "
                         "durable (log abandoned or fsync stalled) — "
@@ -779,26 +795,27 @@ class ParameterServer:
         (None without a WAL)."""
         from distkeras_tpu.resilience import wal as _wal
 
-        chunks = _wal.encode_commit_chunks(
-            worker_id, seq, pull_version, version, rec_payload, rec_sum,
-            rec_type=rec_type,
-        )
-        token = None
-        if self._wal is not None:
-            token = self._wal.append_chunks(chunks)
-            self._wal.commits_since_snapshot += 1
-        sock = self._replica_sock
-        if sock is not None:
-            try:
-                for chunk in chunks:
-                    sock.sendall(chunk)
-            except OSError:
-                self._replica_sock = None
-                self._n_standby_drops += 1
+        with _trace.span("ps.wal_append"):
+            chunks = _wal.encode_commit_chunks(
+                worker_id, seq, pull_version, version, rec_payload,
+                rec_sum, rec_type=rec_type,
+            )
+            token = None
+            if self._wal is not None:
+                token = self._wal.append_chunks(chunks)
+                self._wal.commits_since_snapshot += 1
+            sock = self._replica_sock
+            if sock is not None:
                 try:
-                    sock.close()
+                    for chunk in chunks:
+                        sock.sendall(chunk)
                 except OSError:
-                    pass
+                    self._replica_sock = None
+                    self._n_standby_drops += 1
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
         return token
 
     def _log_locked(self, rec: bytes) -> None:
@@ -866,6 +883,7 @@ class ParameterServer:
         joiner's very next ``pull`` records its pull-version, so its
         first DynSGD commit is priced at the true small τ. Returns the
         admission record the wire action answers with."""
+        _trace.instant("ps.join", corr=f"w{worker_id}")
         self._registry.register(worker_id)
         with self._stats_lock:
             self._drained_wids.discard(worker_id)
@@ -885,6 +903,8 @@ class ParameterServer:
         path) plus the elastic counters — ``timeout=True`` records a
         drain whose deadline lapsed (the force-drain path; eviction
         remains the backstop for the abandoned worker)."""
+        _trace.instant("ps.drain", corr=f"w{worker_id}",
+                       args={"timeout": bool(timeout)})
         self.deregister_worker(worker_id)
         with self._stats_lock:
             if worker_id in self._drained_wids:
@@ -1030,6 +1050,35 @@ class ParameterServer:
                 total += 8  # scale floats / dtype tags / codec marks
         return total
 
+    def _begin_reply(self) -> None:
+        """Open a delivered-traffic window: this handler is between
+        sending a reply and landing its counters — a concurrent stats
+        read must settle on it (see ``_settle_stats``)."""
+        with self._stats_lock:
+            self._n_pending_replies += 1
+
+    def _end_reply(self) -> None:
+        with self._stats_lock:
+            self._n_pending_replies -= 1
+
+    def _settle_stats(self, timeout: float = 1.0) -> bool:
+        """The stats settling barrier (ISSUE 11 satellite): wait until no
+        handler sits between reply-send and counter-land, so a stats
+        read taken after the last reply was *received* also sees it
+        *counted*. Bounded: under continuous traffic the gauge passes
+        through zero between ops; a wedged sender (dead client holding a
+        send) times out rather than hanging telemetry — the read then
+        degrades to the historical may-lag-by-in-flight semantics."""
+        if self._n_pending_replies == 0:  # racy fast path: exact enough
+            return True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._stats_lock:
+                if self._n_pending_replies == 0:
+                    return True
+            time.sleep(0.001)
+        return False
+
     def _count(self, pulls=0, compressed_pulls=0, commits=0,
                bytes_in=0, bytes_out=0, dup_commits=0, fused=0):
         with self._stats_lock:
@@ -1068,6 +1117,7 @@ class ParameterServer:
           drain totals), ``drain_timeouts`` (drains whose deadline
           lapsed into the force-drain path).
         """
+        self._settle_stats()
         elapsed = time.monotonic() - self._t_start
         with self._stats_lock:
             pulls = self._n_pulls
@@ -1277,6 +1327,11 @@ class SocketParameterServer(ParameterServer):
                 # instead of re-pickling the tree
                 msg, raw = networking.recv_data_raw(conn)
                 action = msg.get("action")
+                if _trace.enabled():
+                    # adopt the frame's correlation id (stamped by the
+                    # client when tracing is on): every span this handler
+                    # records joins the worker-side exchange's timeline
+                    _trace.set_corr(msg.get("corr"))
                 if action == "pull":
                     self._serve_pull(conn, msg["worker_id"])
                 elif action == "pull_int8":
@@ -1355,6 +1410,26 @@ class SocketParameterServer(ParameterServer):
                     self.drain_worker(msg["worker_id"],
                                       timeout=bool(msg.get("timeout")))
                     networking.send_data(conn, {"ok": True})
+                elif action == "stats":
+                    # live counters with the settling barrier applied
+                    # (stats() flushes pending pull-side deliveries
+                    # before reading) — the observability CLI's source
+                    networking.send_data(
+                        conn, {"ok": True, "stats": self.stats()}
+                    )
+                elif action == "metrics":
+                    # the unified metrics surface (ISSUE 11): the same
+                    # settled counters normalized into typed metrics,
+                    # as a JSON snapshot + Prometheus text exposition
+                    from distkeras_tpu.observability.metrics import (
+                        ps_metrics,
+                    )
+
+                    reg = ps_metrics(self.stats())
+                    networking.send_data(conn, {
+                        "ok": True, "metrics": reg.to_json(),
+                        "prom": reg.to_prometheus(),
+                    })
                 elif action == "replicate_stream":
                     # hot-standby replication (StandbySocketParameterServer
                     # overrides; a primary politely refuses)
@@ -1389,9 +1464,14 @@ class SocketParameterServer(ParameterServer):
         redundant O(model) pass here) and counts the pull only once the
         reply is fully sent — delivered-traffic semantics, matching the
         compressed path and the native server."""
-        snap, _ = self._begin_pull(worker_id, compressed=False)
-        networking.send_data(conn, {"weights": snap})
-        self._count(pulls=1, bytes_out=self._center_nbytes)
+        with _trace.span("ps.pull"):
+            snap, _ = self._begin_pull(worker_id, compressed=False)
+            self._begin_reply()
+            try:
+                networking.send_data(conn, {"weights": snap})
+                self._count(pulls=1, bytes_out=self._center_nbytes)
+            finally:
+                self._end_reply()
 
     def _serve_exchange(self, conn, msg, raw: bytes) -> None:
         """Wire variant of the fused ``exchange``: fold + fused pull
@@ -1403,36 +1483,47 @@ class SocketParameterServer(ParameterServer):
         counters land only once the reply is fully sent (delivered-traffic
         semantics, both transports)."""
         compressed = bool(msg.get("compressed"))
-        try:
-            applied, snap, st = self._commit_impl(
-                msg["worker_id"], msg["payload"], seq=msg.get("seq"),
-                epoch=msg.get("epoch"), wire_frame=raw, fused=True,
-                lag=bool(msg.get("lag")), compressed=compressed,
-            )
-        except networking.FencedEpochError as fe:
-            networking.send_data(conn, {
-                "error": "fenced", "epoch": fe.server_epoch,
-            })
-            return
-        if not compressed:
-            networking.send_data(
-                conn, {"ok": True, "dup": not applied, "weights": snap}
-            )
-            self._count(pulls=1, bytes_out=self._center_nbytes, fused=1)
-            return
-        with st.lock:
-            blob, nbytes = self._encode_pull(st, snap)
-            epoch_ = st.epoch
-        try:
-            networking.send_data(
-                conn, {"ok": True, "dup": not applied, "weights": blob}
-            )
-        except (ConnectionError, OSError):
+        with _trace.span("ps.exchange"):
+            try:
+                applied, snap, st = self._commit_impl(
+                    msg["worker_id"], msg["payload"], seq=msg.get("seq"),
+                    epoch=msg.get("epoch"), wire_frame=raw, fused=True,
+                    lag=bool(msg.get("lag")), compressed=compressed,
+                )
+            except networking.FencedEpochError as fe:
+                networking.send_data(conn, {
+                    "error": "fenced", "epoch": fe.server_epoch,
+                })
+                return
+            if not compressed:
+                self._begin_reply()
+                try:
+                    networking.send_data(
+                        conn,
+                        {"ok": True, "dup": not applied, "weights": snap},
+                    )
+                    self._count(pulls=1, bytes_out=self._center_nbytes,
+                                fused=1)
+                finally:
+                    self._end_reply()
+                return
             with st.lock:
-                if st.epoch == epoch_:
-                    self._rollback_encode_locked(st, snap, blob)
-            raise
-        self._count(compressed_pulls=1, bytes_out=nbytes, fused=1)
+                blob, nbytes = self._encode_pull(st, snap)
+                epoch_ = st.epoch
+            self._begin_reply()
+            try:
+                networking.send_data(
+                    conn,
+                    {"ok": True, "dup": not applied, "weights": blob},
+                )
+                self._count(compressed_pulls=1, bytes_out=nbytes, fused=1)
+            except (ConnectionError, OSError):
+                with st.lock:
+                    if st.epoch == epoch_:
+                        self._rollback_encode_locked(st, snap, blob)
+                raise
+            finally:
+                self._end_reply()
 
     def _serve_compressed_pull(self, conn, worker_id: int) -> None:
         """Wire variant of ``pull(compressed=True)`` with a dropped-reply
@@ -1445,18 +1536,22 @@ class SocketParameterServer(ParameterServer):
         bounded phantom-pull behavior instead of corrupting the newer
         encode's residual. The center-lock section is the same O(1)
         version-record + snapshot grab as ``pull``."""
-        snap, st = self._begin_pull(worker_id, compressed=True)
-        with st.lock:
-            blob, nbytes = self._encode_pull(st, snap)
-            epoch = st.epoch
-        try:
-            networking.send_data(conn, {"weights": blob})
-        except (ConnectionError, OSError):
+        with _trace.span("ps.pull_int8"):
+            snap, st = self._begin_pull(worker_id, compressed=True)
             with st.lock:
-                if st.epoch == epoch:
-                    self._rollback_encode_locked(st, snap, blob)
-            raise
-        self._count(compressed_pulls=1, bytes_out=nbytes)
+                blob, nbytes = self._encode_pull(st, snap)
+                epoch = st.epoch
+            self._begin_reply()
+            try:
+                networking.send_data(conn, {"weights": blob})
+                self._count(compressed_pulls=1, bytes_out=nbytes)
+            except (ConnectionError, OSError):
+                with st.lock:
+                    if st.epoch == epoch:
+                        self._rollback_encode_locked(st, snap, blob)
+                raise
+            finally:
+                self._end_reply()
 
     def stop(self) -> None:
         """Shut down, unblocking ``accept`` via the reference's self-connect
@@ -1638,10 +1733,11 @@ class StandbySocketParameterServer(SocketParameterServer):
                     if not self.is_standby:
                         return True  # promoted: this stream is history
                     self._repl_records += 1
-                    _wal.replay_record(
-                        self._repl_state, recs[0][0], recs[0][1],
-                        self.rule, self.num_workers, self.ema_decay,
-                    )
+                    with _trace.span("ps.chain_apply"):
+                        _wal.replay_record(
+                            self._repl_state, recs[0][0], recs[0][1],
+                            self.rule, self.num_workers, self.ema_decay,
+                        )
                     # chain replication (distkeras_tpu/sharding): a middle
                     # link forwards the RAW frame to its own successor
                     # after applying it — under the same lock, so the
@@ -1665,8 +1761,9 @@ class StandbySocketParameterServer(SocketParameterServer):
         if sock is None:
             return
         try:
-            sock.sendall(head)
-            sock.sendall(body)
+            with _trace.span("ps.chain_forward"):
+                sock.sendall(head)
+                sock.sendall(body)
         except OSError:
             self._replica_sock = None
             self._n_standby_drops += 1
@@ -1730,6 +1827,10 @@ class StandbySocketParameterServer(SocketParameterServer):
         grace — closes the gap. (A zombie's post-promotion folds belong
         to the superseded history anyway; fencing rejects their clients'
         next commits.)"""
+        with _trace.span("ps.promote", args={"epoch": int(epoch)}):
+            self._promote_impl(epoch, drain_timeout)
+
+    def _promote_impl(self, epoch: int, drain_timeout: float) -> None:
         deadline = time.monotonic() + float(drain_timeout)
         last = -1
         while time.monotonic() < deadline:
@@ -1839,6 +1940,10 @@ class ParameterServerClient:
             "worker_id": self.worker_id,
             "payload": payload,
         }
+        if _trace.enabled() and (corr := _trace.current_corr()):
+            # carry the correlation id in the wire frame so the server's
+            # fold/WAL spans join this worker's timeline (ISSUE 11)
+            msg["corr"] = corr
         if seq is not None:
             # per-worker commit seqno: the server folds each (worker, seq)
             # at most once — see ParameterServer.commit / resilience.retry
@@ -1874,6 +1979,8 @@ class ParameterServerClient:
             "worker_id": self.worker_id,
             "payload": payload,
         }
+        if _trace.enabled() and (corr := _trace.current_corr()):
+            msg["corr"] = corr  # cross-process span stitching, see commit
         if self.pull_compression == "int8":
             msg["compressed"] = True
         if seq is not None:

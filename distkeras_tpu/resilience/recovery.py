@@ -208,6 +208,12 @@ class PSFailoverSupervisor:
         self._pending_fences = still
 
     def _failover(self) -> None:
+        from distkeras_tpu.observability import trace as _trace
+
+        with _trace.span("ps.failover"):
+            self._failover_impl()
+
+    def _failover_impl(self) -> None:
         t0 = time.monotonic()
         old_host, old_port, old_epoch = self.resolver.resolve()
         epoch = old_epoch + 1

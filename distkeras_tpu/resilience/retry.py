@@ -34,6 +34,7 @@ from typing import Any, Callable
 import numpy as np
 
 from distkeras_tpu.networking import FencedEpochError, ProtocolError
+from distkeras_tpu.observability import trace as _trace
 
 Pytree = Any
 
@@ -295,6 +296,12 @@ class ResilientPSClient:
         # (chaos tests, --chaos bench) don't tolerate silently anyway.
         self._wire_seq += 1
         seq = self._seq_epoch + self._wire_seq
+        if _trace.enabled():
+            # the seqno IS the wire-carried correlation id: stamp it on
+            # this thread so the worker-side exchange span and the
+            # server-side fold/WAL spans (Python frame corr, or the
+            # native ring's (wid, seq)) close under one id
+            _trace.set_corr(f"w{self.worker_id}:s{seq}")
         self._run(lambda: self._client.commit(self.worker_id, payload,
                                               seq=seq))
         self.seq += 1
@@ -311,6 +318,8 @@ class ResilientPSClient:
         inside one retried op (a replayed pair dedups its commit)."""
         self._wire_seq += 1
         seq = self._seq_epoch + self._wire_seq
+        if _trace.enabled():
+            _trace.set_corr(f"w{self.worker_id}:s{seq}")  # see commit()
 
         def op():
             inner = self._client

@@ -515,11 +515,17 @@ class CommitLog:
         if fh is None:
             return False
         try:
-            for chunks in batch:
-                for chunk in chunks:
-                    fh.write(chunk)
-            fh.flush()
-            os.fsync(fh.fileno())
+            # the group-fsync span: in a stitched timeline this is the
+            # flusher-thread segment a deferred-ACK commit waits on
+            # (ps.wal_wait on the handler thread ends when this closes)
+            from distkeras_tpu.observability import trace as _trace
+
+            with _trace.span("wal.fsync", args={"batch": len(batch)}):
+                for chunks in batch:
+                    for chunk in chunks:
+                        fh.write(chunk)
+                fh.flush()
+                os.fsync(fh.fileno())
         except (OSError, ValueError):
             # _io_lock is held, so this is not a close/rotate race — the
             # device genuinely failed the write: abandon (see docstring)

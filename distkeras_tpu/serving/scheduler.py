@@ -56,6 +56,7 @@ import numpy as np
 
 from distkeras_tpu.model import ModelSpec
 from distkeras_tpu.networking import ServerBusyError
+from distkeras_tpu.observability import trace as _trace
 from distkeras_tpu.serving.paged_cache import (
     BlockAllocator,
     PagedKVCache,
@@ -441,6 +442,11 @@ class GenerationEngine:
             self.stats_["submitted"] += 1
             self._queue.append(req)
             self._wake.notify_all()
+        # flight recorder: the request id is the serving tier's
+        # correlation id (carried in the wire frame), so this enqueue
+        # mark, the queued/prefill spans, and the final serve.request
+        # span stitch one request across threads
+        _trace.instant("serve.enqueue", corr=req.id)
         return req
 
     def cancel(self, request: Request) -> None:
@@ -462,6 +468,15 @@ class GenerationEngine:
         self.stats_[key] += 1
         if state == "done":
             self.stats_["tokens_generated"] += len(req.new_tokens)
+        if _trace.enabled():
+            # whole-lifetime span (submit → retire); time.monotonic and
+            # the tracer's perf_counter share CLOCK_MONOTONIC on Linux
+            _trace.record(
+                "serve.request", int(req.t_submit * 1e9),
+                int(req.t_done * 1e9), corr=req.id,
+                args={"state": state,
+                      "new_tokens": len(req.new_tokens)},
+            )
         req._event.set()
 
     def _retire(self, b: int, state: str, error: str | None = None) -> None:
@@ -500,6 +515,10 @@ class GenerationEngine:
             head.state = "running"
             head.t_admit = time.monotonic()
             self.stats_["admitted"] += 1
+            if _trace.enabled():
+                # the admission-wait span: submit → admit, per request
+                _trace.record("serve.queued", int(head.t_submit * 1e9),
+                              int(head.t_admit * 1e9), corr=head.id)
             admitted.append((b, head))
         return admitted
 
@@ -549,6 +568,7 @@ class GenerationEngine:
             if key not in self._prefill_fns:
                 self._prefill_fns[key] = self._make_prefill()
             c, dc = self.cache, getattr(self, "draft_cache", None)
+            t_pf = time.perf_counter_ns() if _trace.enabled() else 0
             tok, c.k_pools, c.v_pools, dk, dv = self._prefill_fns[key](
                 self._params, self._draft_params, c.k_pools, c.v_pools,
                 dc.k_pools if dc else (), dc.v_pools if dc else (),
@@ -560,6 +580,14 @@ class GenerationEngine:
             if dc:
                 dc.k_pools, dc.v_pools = dk, dv
             tok = np.asarray(jax.device_get(tok))
+            if _trace.enabled():
+                t1_pf = time.perf_counter_ns()
+                for _, req in grp:
+                    # the group forward, attributed to every request it
+                    # prefilled (same interval, each with its own corr)
+                    _trace.record("serve.prefill", t_pf, t1_pf,
+                                  corr=req.id,
+                                  args={"rows": n, "lpad": lpad})
             self.stats_["prefills"] += n
             for i, (b, req) in enumerate(grp):
                 slot = self._slots[b]
@@ -599,10 +627,12 @@ class GenerationEngine:
         active = [b for b, s in enumerate(self._slots) if s is not None]
         if not active:
             return bool(admitted)
-        if self._spec_fn is not None:
-            self._spec_step(active)
-        else:
-            self._decode_step(active)
+        _args = {"batch": len(active)} if _trace.enabled() else None
+        with _trace.span("serve.decode_step", args=_args):
+            if self._spec_fn is not None:
+                self._spec_step(active)
+            else:
+                self._decode_step(active)
         with self._wake:
             self.stats_["steps"] += 1
             self.stats_["occupancy_sum"] += len(active)

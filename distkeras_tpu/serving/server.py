@@ -50,11 +50,25 @@ class GenerationServer:
     drains gracefully by default."""
 
     def __init__(self, engine: GenerationEngine, host: str = "127.0.0.1",
-                 port: int = 0, poll_interval: float = 0.05):
+                 port: int = 0, poll_interval: float = 0.05,
+                 trace: bool = False, trace_dir: str | None = None,
+                 trace_sample: float = 1.0):
         self.engine = engine
         self.host = host
         self.port = int(port)
         self.poll_interval = float(poll_interval)
+        # Flight recorder (ISSUE 11): trace=/trace_dir= arm the span
+        # recorder for this server's lifetime (request lifecycle spans —
+        # enqueue→admit→prefill→decode→retire — stitched by request id);
+        # stop() writes the timeline to trace_dir (path in trace_path_).
+        # Ownership mirrors the trainer's: only an enable WE performed
+        # is disabled at stop, so a bench that already enabled tracing
+        # keeps its recorder.
+        self.trace = bool(trace) or trace_dir is not None
+        self.trace_dir = trace_dir
+        self.trace_sample = float(trace_sample)
+        self.trace_path_: str | None = None
+        self._trace_owner = False
         self._server_sock: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
         self._handlers: list[threading.Thread] = []
@@ -77,6 +91,12 @@ class GenerationServer:
     def start(self) -> None:
         if self._server_sock is None:
             self.initialize()
+        if self.trace:
+            from distkeras_tpu.observability import trace as _trace
+
+            if not _trace.enabled():
+                _trace.enable(sample=self.trace_sample)
+                self._trace_owner = True
         self.engine.start()
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
@@ -169,6 +189,20 @@ class GenerationServer:
                 elif action == "stats":
                     networking.send_data(conn, {"ok": True,
                                                 "stats": self.stats()})
+                elif action == "metrics":
+                    # unified metrics surface (ISSUE 11): the serving
+                    # counters normalized into typed metrics — JSON
+                    # snapshot + Prometheus text, same contract as the
+                    # PS tier's "metrics" action
+                    from distkeras_tpu.observability.metrics import (
+                        serving_metrics,
+                    )
+
+                    reg = serving_metrics(self.stats())
+                    networking.send_data(conn, {
+                        "ok": True, "metrics": reg.to_json(),
+                        "prom": reg.to_prometheus(),
+                    })
                 else:
                     networking.send_data(conn, {
                         "error": "bad_request",
@@ -214,6 +248,20 @@ class GenerationServer:
             t.join(timeout=2)
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=2)
+        if self.trace:
+            import os as _os
+            import time as _time
+
+            from distkeras_tpu.observability import trace as _trace
+
+            if self.trace_dir is not None and _trace.enabled():
+                self.trace_path_ = _trace.save(_os.path.join(
+                    self.trace_dir,
+                    f"serve-trace-{_os.getpid()}-{_time.time_ns()}.json",
+                ))
+            if self._trace_owner:
+                _trace.disable()
+                self._trace_owner = False
 
 
 class GenerationClient:

@@ -340,6 +340,15 @@ class ShardedPSGroup:
         out["ring"] = self.plan.digest
         return out
 
+    def metrics(self):
+        """The group's unified metrics surface (ISSUE 11): the
+        aggregate roll-up plus per-shard ``shard``-labeled series, as a
+        :class:`~distkeras_tpu.observability.metrics.MetricsRegistry`
+        ready for Prometheus/JSON export."""
+        from distkeras_tpu.observability.metrics import ps_metrics
+
+        return ps_metrics(self.stats())
+
     def make_client(self, worker_id: int,
                     pull_compression: str | None = None,
                     retry_policy=None,

@@ -544,6 +544,9 @@ class DistributedTrainer(Trainer):
                  resume: bool = False, checkpoint_async: bool = False,
                  profile_dir=None,
                  log_metrics: bool = False,
+                 trace: bool = False,
+                 trace_dir=None,
+                 trace_sample: float = 1.0,
                  tolerate_worker_failures: bool = False,
                  worker_restart_budget: int = 0,
                  worker_restart_delay: float = 0.0,
@@ -705,6 +708,27 @@ class DistributedTrainer(Trainer):
         # to stdout and records the same in the history.
         self.profile_dir = profile_dir
         self.log_metrics = bool(log_metrics)
+        # Flight recorder (ISSUE 11, distkeras_tpu/observability): spans
+        # across the worker window lifecycle, the PS fold/WAL/chain
+        # paths, and elastic membership, stitched by correlation id into
+        # one Perfetto-loadable timeline. trace=True enables recording;
+        # trace_dir= also writes the Chrome trace JSON (path lands in
+        # trace_path_); trace_sample keeps a deterministic fraction of
+        # spans. PS backend only — the collective backend's device
+        # timeline is profile_dir's job (jax.profiler).
+        self.trace = bool(trace) or trace_dir is not None
+        self.trace_dir = trace_dir
+        self.trace_sample = float(trace_sample)
+        if self.trace and backend != "ps":
+            raise ValueError(
+                "trace/trace_dir apply to backend='ps' only (use "
+                "profile_dir for the collective backend's XLA timeline)"
+            )
+        if not 0.0 < self.trace_sample <= 1.0:
+            raise ValueError(
+                f"trace_sample must be in (0, 1], got {trace_sample}"
+            )
+        self.trace_path_ = None
         # Failure tolerance (beyond-reference, SURVEY.md §5.3 — the reference
         # delegated retry wholesale to Spark): on the PS backend, True lets
         # surviving hogwild workers finish the run when a peer dies (the run
@@ -1207,7 +1231,19 @@ class DistributedTrainer(Trainer):
         validator = self._make_validator()
         self.record_training_start()
         t0 = time.perf_counter()
-        params, nt, history = run_async_training(runner or self, ds, shuffle)
+        # a run that DIES mid-flight must not leak an enabled tracer
+        # into the caller's process: run_async_training records its
+        # recorder ownership on the trainer (`_trace_owner_`, the single
+        # source of truth — it clears it itself on the success path)
+        tgt = runner or self
+        try:
+            params, nt, history = run_async_training(tgt, ds, shuffle)
+        except BaseException:
+            if getattr(tgt, "_trace_owner_", False):
+                from distkeras_tpu.observability import trace as _trace
+
+                _trace.disable()
+            raise
         elapsed = time.perf_counter() - t0
         self.record_training_end()
         for rec in history:

@@ -32,6 +32,7 @@ import jax
 import numpy as np
 
 from distkeras_tpu import utils
+from distkeras_tpu.observability import trace as _trace
 from distkeras_tpu.parallel.merge_rules import ElasticAverageMerge
 from distkeras_tpu.parameter_servers import (
     ParameterServer,
@@ -179,6 +180,11 @@ class AsyncWorker:
         # per-phase exchange timings (fetch/compress/commit/pull ms):
         # merged across workers into ps_stats_["exchange_phases"]
         self._phases: dict[str, dict] = {}
+        # flight-recorder correlation (ISSUE 11): a per-worker window
+        # ordinal sets this thread's corr id at each window's staging,
+        # so the phase spans (and, via the wire frame / seqno, the PS's
+        # fold+WAL spans) stitch into one timeline per exchange
+        self._xid = 0
 
     def _compress(self, tree, owned: bool = False):
         """→ (wire payload, transmitted tree); updates the residual.
@@ -213,10 +219,24 @@ class AsyncWorker:
             )
         return blob, sent
 
+    def _next_corr(self) -> None:
+        """Stamp this thread's correlation id for the window being
+        staged (``w<id>:x<n>``). The resilient client overrides it with
+        the wire-carried ``w<id>:s<seq>`` when it assigns the commit
+        seqno — either way the worker-side exchange span and the PS-side
+        fold/WAL spans close under the same id. Call only when tracing
+        is enabled (the off path must stay free)."""
+        self._xid += 1
+        _trace.set_corr(f"w{self.worker_id}:x{self._xid}")
+
     def _phase(self, name: str, t0: float) -> float:
         """Record one exchange-phase sample (ms since ``t0``); returns a
-        fresh ``perf_counter`` for chaining the next phase."""
+        fresh ``perf_counter`` for chaining the next phase. With tracing
+        on, the same two timestamps become a real span (the ISSUE 11
+        upgrade of the PR 10 phase histograms) — no extra clock reads."""
         t1 = time.perf_counter()
+        if _trace.enabled():
+            _trace.record("worker." + name, int(t0 * 1e9), int(t1 * 1e9))
         ms = (t1 - t0) * 1e3
         rec = self._phases.get(name)
         if rec is None:
@@ -409,6 +429,8 @@ class AsyncWorker:
         """The per-window PS exchange, shared by the fixed-pool and
         elastic loops (one code path for the commit math). Returns the
         re-based ``(params, center)``."""
+        if _trace.enabled():
+            self._next_corr()
         if elastic:
             # pull a FRESH center at exchange time (reference EASGD
             # semantics), commit the elastic difference, keep own
@@ -515,6 +537,8 @@ class AsyncWorker:
                     # ...while the host exchanges the PREVIOUS window
                     center = self._flush_pipelined(pending)
                 # sync on this window's output; stage the next one
+                if _trace.enabled():
+                    self._next_corr()
                 t0 = time.perf_counter()
                 delta = self._window_delta(params, base)
                 t0 = self._phase("fetch", t0)
@@ -704,6 +728,8 @@ class AsyncWorker:
                     center = self._flush_elastic_pipelined(
                         pending, maybe_heartbeat
                     )
+                if _trace.enabled():
+                    self._next_corr()
                 t0 = time.perf_counter()
                 delta = self._window_delta(params, base)
                 t0 = self._phase("fetch", t0)
@@ -808,6 +834,23 @@ def run_async_training(trainer, ds, shuffle: bool):
     transport = getattr(trainer, "ps_transport", "inprocess")
     external_host = getattr(trainer, "ps_host", None)
     offset = int(getattr(trainer, "worker_id_offset", 0))
+    # Flight recorder (ISSUE 11): trace=True / trace_dir= turn on the
+    # span recorder for this run (idempotent when a caller — bench.py —
+    # already enabled it; we only disable what we enabled). The timeline
+    # lands in trace_dir as Chrome trace-event JSON, path stashed on
+    # trainer.trace_path_.
+    trace_dir = getattr(trainer, "trace_dir", None)
+    trace_on = bool(getattr(trainer, "trace", False)) \
+        or trace_dir is not None
+    trace_owner = False
+    trainer.trace_path_ = None
+    if trace_on and not _trace.enabled():
+        _trace.enable(sample=float(getattr(trainer, "trace_sample", 1.0)))
+        trace_owner = True
+    # the ONE ownership record: trainers._train_ps reads it to release
+    # the recorder when this run dies mid-flight (no finally here — the
+    # success path below disables and clears it)
+    trainer._trace_owner_ = trace_owner
     codec = resolve_codec(getattr(trainer, "compression", None))
     # Resilience knobs (distkeras_tpu/resilience): a retry policy or a
     # heartbeat interval turns the plain transport clients into
@@ -1118,6 +1161,17 @@ def run_async_training(trainer, ds, shuffle: bool):
             failover_timeout=float(ps_failover_timeout),
         )
         ps_supervisor.start()
+
+    if trace_on:
+        # native servers keep their span ring in C++ — arm it (no-op on
+        # the Python servers, whose spans record directly)
+        _servers = (list(sharded_group.servers)
+                    if sharded_group is not None
+                    else [ps] if ps is not None else [])
+        for _srv in _servers:
+            _set = getattr(_srv, "set_trace", None)
+            if _set is not None:
+                _set(True)
 
     def build_client(i):
         """One worker's FULLY-WIRED client (any id — the elastic
@@ -1489,6 +1543,18 @@ def run_async_training(trainer, ds, shuffle: bool):
 
             print(json.dumps({"ps_stats": trainer.ps_stats_}),
                   file=sys.stderr, flush=True)
+        if trace_on:
+            # pull the native C++ span rings into the recorder while the
+            # servers are still up (the scrape rides the wire)
+            _servers = (list(sharded_group.active_servers)
+                        if sharded_group is not None else [active_ps])
+            for _srv in _servers:
+                _scrape = getattr(_srv, "scrape_trace_events", None)
+                if _scrape is not None:
+                    try:
+                        _trace.add_events(_scrape())
+                    except (OSError, ConnectionError):
+                        pass  # a crashed native server keeps no ring
         if ps is not None and ps is not active_ps:
             ps.stop()  # the crashed primary: releases any leftovers
         if ps_standby_server is not None \
@@ -1497,6 +1563,16 @@ def run_async_training(trainer, ds, shuffle: bool):
         active_ps.stop()
         if getattr(trainer, "ema_decay", None) is not None:
             trainer.ema_params_ = active_ps.get_ema()
+
+    if trace_on and trace_dir is not None:
+        import os as _os
+
+        trainer.trace_path_ = _trace.save(_os.path.join(
+            trace_dir, f"ps-trace-{_os.getpid()}-{time.time_ns()}.json"
+        ))
+    if trace_owner:
+        _trace.disable()
+        trainer._trace_owner_ = False
 
     final_nt = next(
         (w.final_nt for w in workers if hasattr(w, "final_nt")), nt

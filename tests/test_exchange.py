@@ -399,17 +399,18 @@ def test_trainer_rtt_counters_fused_vs_serial():
     s = t_fused.ps_stats_
     windows = s["commits"]  # counted pre-ACK: exact by run end
     assert windows > 0
-    # pull-side counters land AFTER the reply send (delivered-traffic
-    # semantics), so the end-of-run stats read may lag the last in-flight
-    # reply by up to one per worker — tolerate exactly that, nothing more
-    assert windows - W <= s["fused_exchanges"] <= windows
-    assert windows + 2 - W <= s["exchange_rtts"] <= windows + 2
+    # EXACT counters (ISSUE 11): pull-side counts still land after the
+    # reply send (delivered-traffic semantics), but stats() now runs the
+    # settling barrier — it waits for every in-flight reply window to
+    # close before reading — so the historical ≤1-per-worker tolerance
+    # is gone
+    assert s["fused_exchanges"] == windows
+    assert s["exchange_rtts"] == windows + 2
     t_head, _ = _run("DOWNPOUR", num_workers=W, ps_transport="socket",
                      ps_fused_exchange=False)
     sh = t_head.ps_stats_
     assert sh["fused_exchanges"] == 0
-    assert 2 * sh["commits"] + 2 - W <= sh["exchange_rtts"] \
-        <= 2 * sh["commits"] + 2
+    assert sh["exchange_rtts"] == 2 * sh["commits"] + 2
     # the per-phase timing proof rides ps_stats_ on every transport:
     # fused runs never paid a standalone pull after the initial one
     phases = t_fused.ps_stats_["exchange_phases"]
