@@ -1343,11 +1343,18 @@ def run_ps_shard_bench(n_params=10_000_000, workers=4, seconds=4.0,
 
 
 def run_ps_exchange_bench(n_params=1_000_000, workers=(2, 4), seconds=2.0,
-                          transports=("socket", "native"), compute_ms=3.0):
-    """Exchange-leg microbenchmark (ISSUE 10): serial (``commit();
+                          transports=("socket", "native", "shm"),
+                          compute_ms=3.0):
+    """Exchange-leg microbenchmark (ISSUE 10 + 12): serial (``commit();
     pull()`` — 2 RTTs) vs fused (one EXCHANGE RTT) vs fused+pipelined
     (the exchange overlapped with the NEXT window's simulated device
-    compute) rounds/s, per transport and worker count.
+    compute) rounds/s, per transport and worker count. ISSUE 12 grows
+    the grid a third transport — ``shm``, the zero-syscall mmap ring
+    lane for the colocated regime — and the batched-fold columns: every
+    leg reports ``batched_folds`` and the measured center-lock
+    acquisitions per round (< 1.0 during the fused phase means folds
+    rode shared lock sections; the native lane's C++ fold path is
+    per-commit, so it honestly reports 0 / 1.0).
 
     Each "round" is one training window's exchange plus ``compute_ms``
     of simulated device time — ``time.sleep``, which is faithful to a
@@ -1406,6 +1413,16 @@ def run_ps_exchange_bench(n_params=1_000_000, workers=(2, 4), seconds=2.0,
                 ps.start()
                 clients = [NativePSClient("127.0.0.1", ps.port, i, ps.spec)
                            for i in range(W)]
+            elif transport == "shm":
+                from distkeras_tpu.shm import (
+                    ShmParameterServer,
+                    ShmPSClient,
+                )
+
+                ps = ShmParameterServer(center, DownpourMerge(), W)
+                ps.initialize()
+                ps.start()
+                clients = [ShmPSClient(ps, i) for i in range(W)]
             else:
                 ps = SocketParameterServer(center, DownpourMerge(), W)
                 ps.initialize()
@@ -1469,6 +1486,19 @@ def run_ps_exchange_bench(n_params=1_000_000, workers=(2, 4), seconds=2.0,
                         / max(fused, 1), 3),
                     "fused_exchanges": (s3["fused_exchanges"]
                                         - s1["fused_exchanges"]),
+                    # batched local exchange (ISSUE 12): folds that rode
+                    # a shared center-lock acquisition during the fused
+                    # phase, and the measured acquisitions per round —
+                    # < 1.0 is the lock-amortization claim (one round ==
+                    # one worker exchange; without batching every fold
+                    # acquires once). Native reports 0 / ~1.0: its C++
+                    # fold path is per-commit by design.
+                    "batched_folds": (s3["batched_folds"]
+                                      - s1["batched_folds"]),
+                    "fused_lock_acquires_per_round": round(
+                        (s2["center_lock_acquires"]
+                         - s1["center_lock_acquires"]) / max(fused, 1),
+                        3),
                     "host_cores": host_cores,
                 }
                 log(json.dumps(rec))
@@ -1482,6 +1512,24 @@ def run_ps_exchange_bench(n_params=1_000_000, workers=(2, 4), seconds=2.0,
                 for d in devices:
                     d.shutdown(wait=False)
                 ps.stop()
+    # the ISSUE 12 acceptance ratio, recorded honestly per worker count:
+    # the shm lane's rounds/s over the socket lane's, serial AND fused
+    # (>= 1.5x is the colocated-regime target on this host)
+    for W in workers:
+        shm_rec = out.get(f"ps_exchange_shm_w{W}")
+        sock_rec = out.get(f"ps_exchange_socket_w{W}")
+        if shm_rec and sock_rec:
+            for leg in ("serial", "fused", "pipelined"):
+                base = sock_rec[f"{leg}_rounds_per_sec"]
+                shm_rec[f"shm_vs_socket_{leg}"] = (
+                    round(shm_rec[f"{leg}_rounds_per_sec"] / base, 3)
+                    if base else 0.0
+                )
+            log(json.dumps({
+                "config": f"ps_exchange_shm_vs_socket_w{W}",
+                **{k: shm_rec[k] for k in shm_rec
+                   if k.startswith("shm_vs_socket_")},
+            }))
     return out
 
 
@@ -1858,7 +1906,7 @@ def run_ps_failover_bench(n_params=1_000_000, workers=4, seconds=4.0,
 
 
 def run_ps_group_commit_sweep(n_params=1_000_000, workers=4, seconds=3.0,
-                              transports=("socket", "native")):
+                              transports=("socket", "native", "shm")):
     """Durability-cost sweep (--chaos-ps, ISSUE 7): the mixed pull+commit
     hammer per transport across flush-window settings —
 
@@ -1935,6 +1983,14 @@ def run_ps_group_commit_sweep(n_params=1_000_000, workers=4, seconds=3.0,
             if transport == "native":
                 ps = NativeSocketParameterServer(
                     center, DownpourMerge(), workers, **kw)
+            elif transport == "shm":
+                # ISSUE 12 satellite: the flush-window sweep on the shm
+                # lane — durable commits ride the pickle lane so the WAL
+                # logs wire frames verbatim, exactly like the socket leg
+                from distkeras_tpu.shm import ShmParameterServer
+
+                ps = ShmParameterServer(
+                    center, DownpourMerge(), workers, **kw)
             else:
                 ps = SocketParameterServer(
                     center, DownpourMerge(), workers, **kw)
@@ -1943,6 +1999,10 @@ def run_ps_group_commit_sweep(n_params=1_000_000, workers=4, seconds=3.0,
             if transport == "native":
                 clients = [NativePSClient("127.0.0.1", ps.port, i, ps.spec)
                            for i in range(workers)]
+            elif transport == "shm":
+                from distkeras_tpu.shm import ShmPSClient
+
+                clients = [ShmPSClient(ps, i) for i in range(workers)]
             else:
                 clients = [ParameterServerClient("127.0.0.1", ps.port, i)
                            for i in range(workers)]
@@ -2413,9 +2473,10 @@ def main():
             legs.update(run_ps_shard_bench(n_params=args.ps_bench_params,
                                            workers=args.ps_bench_workers,
                                            seconds=args.ps_bench_seconds))
-            # ISSUE 10: the exchange leg — serial vs fused (2→1 RTTs)
-            # vs fused+pipelined (exchange hidden behind the next
-            # window's compute) at 2 and 4 workers, socket + native
+            # ISSUE 10 + 12: the exchange leg — serial vs fused (2→1
+            # RTTs) vs fused+pipelined at 2 and 4 workers, over socket,
+            # native, AND the shm ring lane (with the shm-vs-socket
+            # ratio and the batched-fold lock-amortization columns)
             legs.update(run_ps_exchange_bench(
                 seconds=max(1.0, args.ps_bench_seconds / 2)))
         if args.chaos:

@@ -102,6 +102,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dkps_client_from_fd.argtypes = [
         ctypes.c_int, ctypes.c_uint32, ctypes.c_uint64,
     ]
+    # shm ring lane (ISSUE 12): the segment is mapped by Python
+    # (multiprocessing.shared_memory) and both endpoints attach by base
+    # pointer — see dkps.cpp "Shared-memory ring lane"
+    lib.dkps_server_attach_shm.restype = ctypes.c_int
+    lib.dkps_server_attach_shm.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+    ]
+    lib.dkps_client_connect_shm.restype = ctypes.c_void_p
+    lib.dkps_client_connect_shm.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint64,
+    ]
     lib.dkps_client_set_timeout_ms.restype = ctypes.c_int
     lib.dkps_client_set_timeout_ms.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.dkps_client_pull.restype = ctypes.c_int64

@@ -271,7 +271,197 @@ void put_hdr(char* out, uint8_t type, uint32_t crc, uint32_t len) {
   std::memcpy(out + 5, &be_len, 4);
 }
 
+// ---------------------------------------------------------------------------
+// Shared-memory ring lane (ISSUE 12 — parity with distkeras_tpu/shm.py).
+//
+// A segment (created and owned by the Python wrapper, layout shared with
+// the Python transport's header) carries two SPSC byte pipes: head/tail
+// are monotonic u64 byte counters on their own cache lines, the writer
+// owns head, the reader owns tail, and closed flags wake a blocked peer.
+// The native wire protocol is already self-framing, so the rings move its
+// exact frame bytes — no record layer: the whole TCP handler and client
+// run UNCHANGED over a ring by representing a channel as a NEGATIVE fd
+// (-2, -3, …) that send_all/recv_all dispatch on. Wakeup is a short
+// relax-spin, then yields, then 50 µs sleeps (no GIL here, so spinning is
+// safe and the common wake is sub-microsecond); client-side ops honour
+// the same timeout_ms knob as SO_RCVTIMEO on the socket lane.
+constexpr uint64_t kShmHdrBytes = 4096;
+constexpr size_t kShmOffC2SHead = 64;
+constexpr size_t kShmOffC2STail = 128;
+constexpr size_t kShmOffS2CHead = 192;
+constexpr size_t kShmOffS2CTail = 256;
+constexpr size_t kShmOffClientClosed = 384;
+constexpr size_t kShmOffServerClosed = 448;
+
+struct ShmRing {
+  std::atomic<uint64_t>* head = nullptr;
+  std::atomic<uint64_t>* tail = nullptr;
+  char* data = nullptr;
+  uint64_t cap = 0;
+};
+
+struct ShmChan {
+  ShmRing rx, tx;
+  std::atomic<uint64_t>* my_closed = nullptr;
+  std::atomic<uint64_t>* peer_closed = nullptr;
+  std::atomic<int> timeout_ms{0};
+};
+
+// channels are registered once and retired by their closed flag — slots
+// are never reused (bounded: one per connection; 4096 is far above any
+// real colocated worker count and a leak of ~100 B per retired slot)
+constexpr int kShmMaxChans = 4096;
+ShmChan* g_shm_chans[kShmMaxChans];
+std::atomic<int> g_shm_nchans{0};
+std::mutex g_shm_mu;
+
+inline ShmChan* shm_chan(int fd) { return g_shm_chans[-fd - 2]; }
+
+// register one endpoint over an already-mapped segment; returns the
+// pseudo-fd (< 0) or 0 when the channel table is full
+int shm_register(void* base, uint64_t bytes, bool server_side) {
+  if (bytes <= kShmHdrBytes) return 0;
+  const uint64_t cap = (bytes - kShmHdrBytes) / 2;
+  char* b = static_cast<char*>(base);
+  auto at = [&](size_t off) {
+    return reinterpret_cast<std::atomic<uint64_t>*>(b + off);
+  };
+  auto* ch = new ShmChan();
+  ShmRing c2s{at(kShmOffC2SHead), at(kShmOffC2STail), b + kShmHdrBytes,
+              cap};
+  ShmRing s2c{at(kShmOffS2CHead), at(kShmOffS2CTail),
+              b + kShmHdrBytes + cap, cap};
+  if (server_side) {
+    ch->rx = c2s;
+    ch->tx = s2c;
+    ch->my_closed = at(kShmOffServerClosed);
+    ch->peer_closed = at(kShmOffClientClosed);
+  } else {
+    ch->rx = s2c;
+    ch->tx = c2s;
+    ch->my_closed = at(kShmOffClientClosed);
+    ch->peer_closed = at(kShmOffServerClosed);
+  }
+  std::lock_guard<std::mutex> g(g_shm_mu);
+  const int idx = g_shm_nchans.load(std::memory_order_relaxed);
+  if (idx >= kShmMaxChans) {
+    delete ch;
+    return 0;
+  }
+  g_shm_chans[idx] = ch;
+  g_shm_nchans.store(idx + 1, std::memory_order_release);
+  return -(idx + 2);
+}
+
+inline bool shm_closed(ShmChan* ch) {
+  return ch->my_closed->load(std::memory_order_relaxed) ||
+         ch->peer_closed->load(std::memory_order_relaxed);
+}
+
+// spin-then-wait backoff: relax-spin first (the peer is usually mid-copy
+// on another core), then yield, then bounded sleeps
+struct ShmWaiter {
+  int spins = 0;
+  std::chrono::steady_clock::time_point deadline{};
+  bool bounded = false;
+  explicit ShmWaiter(int timeout_ms) {
+    if (timeout_ms > 0) {
+      bounded = true;
+      deadline = std::chrono::steady_clock::now() +
+                 std::chrono::milliseconds(timeout_ms);
+    }
+  }
+  // returns false when the (client-side) timeout lapsed
+  bool pause() {
+    ++spins;
+    if (spins < 256) {
+      // plain relax iteration; the load in the caller's loop is the wait
+    } else if (spins < 1024) {
+      std::this_thread::yield();
+    } else {
+      std::this_thread::sleep_for(std::chrono::microseconds(50));
+      if (bounded && std::chrono::steady_clock::now() >= deadline)
+        return false;
+    }
+    return true;
+  }
+};
+
+bool shm_send_chan(ShmChan* ch, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  ShmRing& r = ch->tx;
+  uint64_t head = r.head->load(std::memory_order_relaxed);
+  ShmWaiter w(ch->timeout_ms.load(std::memory_order_relaxed));
+  while (n) {
+    const uint64_t tail = r.tail->load(std::memory_order_acquire);
+    const uint64_t free_b = r.cap - (head - tail);
+    if (free_b == 0) {
+      if (shm_closed(ch)) return false;
+      if (!w.pause()) return false;
+      continue;
+    }
+    const uint64_t pos = head % r.cap;
+    uint64_t k = n;
+    if (k > free_b) k = free_b;
+    if (k > r.cap - pos) k = r.cap - pos;
+    std::memcpy(r.data + pos, p, k);
+    head += k;
+    r.head->store(head, std::memory_order_release);
+    p += k;
+    n -= static_cast<size_t>(k);
+    w.spins = 0;
+  }
+  return true;
+}
+
+bool shm_recv_chan(ShmChan* ch, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  ShmRing& r = ch->rx;
+  uint64_t tail = r.tail->load(std::memory_order_relaxed);
+  ShmWaiter w(ch->timeout_ms.load(std::memory_order_relaxed));
+  while (n) {
+    const uint64_t head = r.head->load(std::memory_order_acquire);
+    const uint64_t avail = head - tail;
+    if (avail == 0) {
+      // drain-before-fail: buffered bytes stay readable past a close
+      if (shm_closed(ch)) return false;
+      if (!w.pause()) return false;
+      continue;
+    }
+    const uint64_t pos = tail % r.cap;
+    uint64_t k = n;
+    if (k > avail) k = avail;
+    if (k > r.cap - pos) k = r.cap - pos;
+    std::memcpy(p, r.data + pos, k);
+    tail += k;
+    r.tail->store(tail, std::memory_order_release);
+    p += k;
+    n -= static_cast<size_t>(k);
+    w.spins = 0;
+  }
+  return true;
+}
+
+// connection close that understands both lanes: a ring peer is woken by
+// the closed flag (its next wait observes it), a socket is closed
+void close_conn_fd(int fd) {
+  if (fd < 0) {
+    shm_chan(fd)->my_closed->store(1, std::memory_order_release);
+    return;
+  }
+  ::close(fd);
+}
+
+void shutdown_conn_fd(int fd) {
+  if (fd < 0) {
+    shm_chan(fd)->my_closed->store(1, std::memory_order_release);
+    return;
+  }
+  ::shutdown(fd, SHUT_RDWR);
+}
+
 bool send_all(int fd, const void* buf, size_t n) {
+  if (fd < 0) return shm_send_chan(shm_chan(fd), buf, n);
   const char* p = static_cast<const char*>(buf);
   while (n) {
     ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
@@ -286,6 +476,7 @@ bool send_all(int fd, const void* buf, size_t n) {
 }
 
 bool recv_all(int fd, void* buf, size_t n) {
+  if (fd < 0) return shm_recv_chan(shm_chan(fd), buf, n);
   char* p = static_cast<char*>(buf);
   while (n) {
     ssize_t k = ::recv(fd, p, n, 0);
@@ -300,6 +491,7 @@ bool recv_all(int fd, void* buf, size_t n) {
 }
 
 void set_nodelay(int fd) {
+  if (fd < 0) return;  // ring lane: no socket options to set
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 }
@@ -1537,7 +1729,7 @@ struct Server {
       conn_fds.erase(std::remove(conn_fds.begin(), conn_fds.end(), fd),
                      conn_fds.end());
     }
-    ::close(fd);
+    close_conn_fd(fd);
   }
 
   // per-handler worker id — set via the thread entry, see serve_conn
@@ -1666,6 +1858,59 @@ int dkps_server_start(void* h) {
   return 0;
 }
 
+// Attach one shared-memory ring connection (ISSUE 12 — the shm lane,
+// parity with distkeras_tpu/shm.py): `base` is the caller-mapped segment
+// (4 KiB header + two SPSC rings; the Python wrapper creates, owns, and
+// unlinks it). Spawns a handler thread running the SAME handshake +
+// action loop an accepted TCP connection gets, dispatched over the rings
+// via the negative pseudo-fd. Returns that pseudo-fd (< 0) or 0 on
+// failure. Call after dkps_server_start and BEFORE the peer's
+// dkps_client_connect_shm — the client handshake blocks on the ring
+// until this handler answers it.
+int dkps_server_attach_shm(void* h, void* base, uint64_t bytes) {
+  auto* s = static_cast<Server*>(h);
+  if (!s->running) return 0;
+  const int fd = shm_register(base, bytes, /*server_side=*/true);
+  if (fd == 0) return 0;
+  std::lock_guard<std::mutex> g(s->conn_mu);
+  if (!s->running) {
+    // stop() raced the attach: its conn_mu shutdown section has (or
+    // will have) run, and its handler-join loop iterates WITHOUT the
+    // lock — appending now would race that iteration and leave an
+    // unjoined thread outliving the server. Re-checking under conn_mu
+    // closes the window: stop() flips running before ITS conn_mu
+    // section, so an attach that sees running here is fully registered
+    // before stop's shutdown loop (which then closes the new channel).
+    close_conn_fd(fd);
+    return 0;
+  }
+  s->conn_fds.push_back(fd);
+  s->handlers.emplace_back([s, fd] {
+    // the accept loop's handshake, over the ring: magic + worker_id +
+    // vector length, answered with the accept byte
+    char magic[6];
+    uint32_t wid;
+    uint64_t cn;
+    uint8_t ok = 0;
+    if (recv_all(fd, magic, 6) && std::memcmp(magic, kMagic, 6) == 0 &&
+        recv_all(fd, &wid, 4) && recv_all(fd, &cn, 8)) {
+      ok = (cn == s->n) ? 1 : 0;
+      if (send_all(fd, &ok, 1) && ok) {
+        s->serve_conn(fd, wid);  // prunes conn_fds + closes at its tail
+        return;
+      }
+    }
+    {
+      std::lock_guard<std::mutex> g2(s->conn_mu);
+      s->conn_fds.erase(
+          std::remove(s->conn_fds.begin(), s->conn_fds.end(), fd),
+          s->conn_fds.end());
+    }
+    close_conn_fd(fd);
+  });
+  return fd;
+}
+
 void dkps_server_stop(void* h) {
   auto* s = static_cast<Server*>(h);
   if (!s->running.exchange(false)) {
@@ -1677,7 +1922,7 @@ void dkps_server_stop(void* h) {
   if (s->accept_thread.joinable()) s->accept_thread.join();
   {
     std::lock_guard<std::mutex> g(s->conn_mu);
-    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+    for (int fd : s->conn_fds) shutdown_conn_fd(fd);
   }
   for (auto& t : s->handlers)
     if (t.joinable()) t.join();
@@ -1696,7 +1941,7 @@ void dkps_server_crash(void* h) {
     ::shutdown(s->listen_fd, SHUT_RDWR);
     ::close(s->listen_fd);
     std::lock_guard<std::mutex> g(s->conn_mu);
-    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+    for (int fd : s->conn_fds) shutdown_conn_fd(fd);
   }
   s->wal_abandon();
   if (s->accept_thread.joinable()) s->accept_thread.join();
@@ -1912,7 +2157,7 @@ static void* client_handshake(int fd, uint32_t wid, uint64_t n) {
   std::memcpy(hello + 10, &n, 8);
   uint8_t ok = 0;
   if (!send_all(fd, hello, sizeof(hello)) || !recv_all(fd, &ok, 1) || !ok) {
-    ::close(fd);
+    close_conn_fd(fd);
     return nullptr;
   }
   auto* c = new Client();
@@ -1937,10 +2182,26 @@ void* dkps_client_from_fd(int fd, uint32_t wid, uint64_t n) {
   return client_handshake(fd, wid, n);
 }
 
+// Connect over a shared-memory ring pair (ISSUE 12): `base` is the same
+// mapped segment the server side attached with dkps_server_attach_shm.
+// Runs the standard handshake through the ring; the returned handle
+// speaks every client op unchanged (the pseudo-fd dispatches in
+// send_all/recv_all).
+void* dkps_client_connect_shm(void* base, uint64_t bytes, uint32_t wid,
+                              uint64_t n) {
+  const int fd = shm_register(base, bytes, /*server_side=*/false);
+  if (fd == 0) return nullptr;
+  return client_handshake(fd, wid, n);
+}
+
 // Bound every subsequent pull/commit round-trip: a wedged server makes the
 // call fail with a transport error instead of hanging the caller forever.
 int dkps_client_set_timeout_ms(void* h, int ms) {
   auto* c = static_cast<Client*>(h);
+  if (c->fd < 0) {  // ring lane: the channel carries its own deadline
+    shm_chan(c->fd)->timeout_ms.store(ms, std::memory_order_relaxed);
+    return 0;
+  }
   timeval tv{};
   tv.tv_sec = ms / 1000;
   tv.tv_usec = (ms % 1000) * 1000;
@@ -2233,7 +2494,7 @@ void dkps_client_close(void* h) {
   auto* c = static_cast<Client*>(h);
   uint8_t action = 3;
   send_all(c->fd, &action, 1);
-  ::close(c->fd);
+  close_conn_fd(c->fd);
   delete c;
 }
 

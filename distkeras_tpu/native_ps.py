@@ -175,6 +175,9 @@ class NativeSocketParameterServer:
                 f"lease_timeout must be positive, got {lease_timeout}"
             )
         self.lease_timeout = lease_timeout
+        # shm ring lane (ISSUE 12): segments minted by attach_shm, owned
+        # (and unlinked) by this wrapper — the C++ side only maps them
+        self._shm_segments: list = []
 
     def initialize(self) -> None:
         state = self._recover_wal_state()
@@ -306,6 +309,7 @@ class NativeSocketParameterServer:
         if self._handle is not None:
             self._lib.dkps_server_crash(self._handle)
         self.crashed_ = True
+        self._release_shm_segments()  # crash joins handlers first (C++)
 
     def start(self) -> None:
         self._lib.dkps_server_start(self._handle)
@@ -316,6 +320,59 @@ class NativeSocketParameterServer:
     def stop(self) -> None:
         if self._handle is not None:
             self._lib.dkps_server_stop(self._handle)
+        # stop joined every handler thread in C++, so no ring is in use:
+        # safe to drop the /dev/shm names now (no-leak contract)
+        self._release_shm_segments()
+
+    # -- shm ring lane (ISSUE 12, parity with distkeras_tpu/shm.py) ----------
+
+    def attach_shm(self, ring_bytes: int | None = None):
+        """Mint one ring-pair segment and attach a C++ handler thread to
+        it; returns the ``SharedMemory`` segment the colocated client
+        connects through (``NativePSClient.connect_shm``). The segment
+        carries the SAME header layout as the Python shm transport; the
+        native wire's own framing rides the rings as a raw byte pipe.
+        Segments are unlinked at server stop/crash — the C++ side joins
+        every handler before Python drops the names."""
+        from distkeras_tpu import shm as _shm
+
+        if ring_bytes is None:
+            # default: one full f32 frame per ring plus slack, capped at
+            # the Python lane's default (the byte pipe streams larger
+            # frames through anyway — size is throughput, not a limit)
+            ring_bytes = min(
+                _shm.DEFAULT_RING_BYTES,
+                max(1 << 16, int(self.spec.n) * 4 + 8192),
+            )
+        # the ONE segment mint (name scheme + header layout live in
+        # shm.py — the two lanes cannot drift on the contract)
+        seg = _shm.mint_segment("dkshm_native", ring_bytes)
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(seg.buf))
+        rc = int(self._lib.dkps_server_attach_shm(
+            self._handle, ctypes.c_void_p(addr), seg.size
+        ))
+        if rc == 0:
+            try:
+                seg.close()
+            except BufferError:
+                pass
+            seg.unlink()
+            raise OSError("dkps_server_attach_shm failed (server stopped "
+                          "or channel table full)")
+        self._shm_segments.append(seg)
+        return seg
+
+    def _release_shm_segments(self) -> None:
+        segs, self._shm_segments = self._shm_segments, []
+        for seg in segs:
+            try:
+                seg.close()
+            except BufferError:
+                pass  # a client still maps it; the name still unlinks
+            try:
+                seg.unlink()
+            except FileNotFoundError:
+                pass
 
     def __del__(self):
         if getattr(self, "_handle", None) is not None:
@@ -510,6 +567,51 @@ class NativePSClient:
         # blocking round-trips by default, like ParameterServerClient (a
         # pull may legitimately wait behind many commits)
         self.set_timeout(None)
+
+    @classmethod
+    def connect_shm(cls, server: "NativeSocketParameterServer",
+                    worker_id: int,
+                    pull_compression: str | None = None,
+                    epoch: int | None = None,
+                    ring_bytes: int | None = None) -> "NativePSClient":
+        """Mint a shm ring-lane client against a COLOCATED native server
+        (ISSUE 12): the server attaches a fresh segment + handler thread
+        and this side handshakes through the rings — every client op
+        then runs unchanged over the zero-syscall byte pipes. The
+        returned client keeps the mapping alive; the server owns the
+        /dev/shm name and unlinks it at stop."""
+        from distkeras_tpu.parallel.compression import (
+            validate_pull_compression,
+        )
+
+        seg = server.attach_shm(ring_bytes)
+        self = cls.__new__(cls)
+        self.pull_compression = validate_pull_compression(pull_compression)
+        self.epoch = None if epoch is None else int(epoch)
+        self._lib = load_dkps(required=True)
+        self.worker_id = int(worker_id)
+        self.spec = server.spec
+        self._seg = seg
+        # PIN the mapping with a live buffer export: the C++ endpoints
+        # hold raw pointers into it, and the server's stop-time
+        # seg.close() would otherwise munmap the pages under them (a
+        # SIGSEGV, not an exception — caught in review). With the export
+        # alive, that close() raises BufferError (caught server-side:
+        # the name still unlinks, no /dev/shm leak) and the mapping
+        # survives until THIS client drops the pin in close().
+        self._shm_pin = ctypes.c_char.from_buffer(seg.buf)
+        self._handle = self._lib.dkps_client_connect_shm(
+            ctypes.c_void_p(ctypes.addressof(self._shm_pin)), seg.size,
+            self.worker_id, server.spec.n,
+        )
+        if not self._handle:
+            self._shm_pin = None
+            raise ConnectionError(
+                "dkps shm handshake failed (vector-length mismatch or "
+                "channel table full)"
+            )
+        self.set_timeout(None)
+        return self
 
     def pull(self, worker_id: int | None = None) -> Pytree:
         out = np.empty(self.spec.n, dtype=np.float32)
@@ -745,6 +847,14 @@ class NativePSClient:
         if self._handle is not None:
             self._lib.dkps_client_close(self._handle)
             self._handle = None
+        # Ring-lane clients: drop the mapping pin only AFTER the C++
+        # side stopped using the rings. Deliberately NO seg.close() here
+        # — the server's handler thread may still be draining the bye
+        # action, and closing the SHARED SharedMemory object would unmap
+        # the pages under it; the server's stop (which joins handlers
+        # first) or final GC performs the actual unmap.
+        if getattr(self, "_shm_pin", None) is not None:
+            self._shm_pin = None
 
     def __del__(self):
         try:

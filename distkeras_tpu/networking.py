@@ -67,6 +67,21 @@ class ProtocolError(ConnectionError):
         self.retryable = retryable
 
 
+class PeerDeadError(ProtocolError):
+    """The other end of a shared-memory ring died or closed mid-operation
+    (``distkeras_tpu/shm.py``): its closed flag is set, its pid is gone,
+    or a mid-record transfer stalled past the liveness deadline.
+    Retryable by design — it is the shm lane's equivalent of a torn TCP
+    connection, and the resilient client answers it the same way (tear
+    the conn, mint a fresh ring pair, replay the op under the seqno
+    dedup). The server-side handler treats it as connection death: the
+    handler exits and the segment is unlinked, so a worker that dies
+    mid-ring-write can never wedge the server or leak /dev/shm."""
+
+    def __init__(self, message: str, *, peer: str | None = None):
+        super().__init__(message, peer=peer, retryable=True)
+
+
 class FencedEpochError(ProtocolError):
     """A parameter-server rejected an operation carrying a stale fencing
     epoch: a failover promoted a new primary (or a restart bumped the
@@ -230,6 +245,15 @@ def _recv_exact(sock: socket.socket, n: int, expected: int | None = None) -> byt
     return b"".join(chunks)
 
 
+def decode_frame(raw: bytes) -> Any:
+    """Decode one frame's payload bytes through the SAME restricted
+    unpickler the socket wire uses. Shared with the shm transport
+    (``distkeras_tpu/shm.py``) and WAL wire-frame replay so every lane's
+    decode pipeline is literally this one function — a frame logged
+    verbatim from any transport replays bit-identically."""
+    return _RestrictedUnpickler(io.BytesIO(raw)).load()
+
+
 def recv_data(sock: socket.socket, max_bytes: int = MAX_FRAME_BYTES) -> Any:
     return recv_data_raw(sock, max_bytes)[0]
 
@@ -252,4 +276,4 @@ def recv_data_raw(sock: socket.socket,
             frame_size=int(length), peer=_peer_of(sock), retryable=False,
         )
     raw = _recv_exact(sock, length, expected=int(length))
-    return _RestrictedUnpickler(io.BytesIO(raw)).load(), raw
+    return decode_frame(raw), raw

@@ -185,6 +185,9 @@ _PS_SCHEMA: tuple[tuple[str, str, str, str], ...] = (
      "replayed commits the seqno dedup refused to double-fold"),
     ("fused_exchanges", "dk_ps_fused_exchanges_total", "counter",
      "single-RTT fused commit+pull exchanges served"),
+    ("batched_folds", "dk_ps_batched_folds_total", "counter",
+     "folds applied inside a multi-fold center-lock section "
+     "(batched local exchange)"),
     ("exchange_rtts", "dk_ps_exchange_rtts_total", "counter",
      "wire round trips spent on exchange traffic"),
     ("fenced_commits", "dk_ps_fenced_commits_total", "counter",

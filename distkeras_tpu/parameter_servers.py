@@ -77,18 +77,89 @@ class _TimedLock:
         self.hold_ns = 0
         self._t_acq = 0
 
-    def __enter__(self):
+    def acquire(self, blocking: bool = True,
+                timeout: float | None = None) -> bool:
+        """Timed/non-blocking acquire for the batched fold drain
+        (ISSUE 12): only a SUCCESSFUL acquire counts — the whole point
+        of batching is that a follower whose fold rode the leader's
+        acquisition never touches the lock, and the ``acquires`` counter
+        is the observable proof."""
         t0 = time.perf_counter_ns()
-        self._lock.acquire()
+        if timeout is None:
+            got = self._lock.acquire(blocking)
+        else:
+            got = self._lock.acquire(blocking, timeout)
+        if not got:
+            return False
         t1 = time.perf_counter_ns()
         self.wait_ns += t1 - t0
         self.acquires += 1
         self._t_acq = t1
+        return True
+
+    def release(self) -> None:
+        self.hold_ns += time.perf_counter_ns() - self._t_acq
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
         return self
 
     def __exit__(self, *exc):
-        self.hold_ns += time.perf_counter_ns() - self._t_acq
-        self._lock.release()
+        self.release()
+
+
+#: follower wake/retry slice for the batched fold drain: a follower whose
+#: work is being folded by the current leader wakes the instant its item's
+#: event is set; the timeout only bounds the retry cadence when the lock
+#: is held by a NON-fold section (a pull snapshot, a fence)
+_FOLD_WAIT_SLICE = 0.0005
+
+
+class _FoldWork:
+    """One queued commit/exchange awaiting the batched fold drain
+    (ISSUE 12 — see ``ParameterServer._enqueue_and_fold``). Carries the
+    pre-lock-encoded inputs in and the locked section's outputs back to
+    the submitting thread, which runs every post-lock step (durability
+    wait, EMA fold, chaos hook, counters) itself — only the center-lock
+    section is combined."""
+
+    __slots__ = (
+        "worker_id", "payload", "seq", "epoch", "lag", "fused",
+        "compressed", "wire_frame", "rec_payload", "rec_sum", "rec_type",
+        "corr", "done", "exc", "fenced", "server_epoch", "dup", "applied",
+        "version", "center_snap", "snap_out", "st", "wait_token",
+        "snap_state", "batched",
+    )
+
+    def __init__(self, worker_id, payload, seq, epoch, lag, fused,
+                 compressed, wire_frame, rec_payload, rec_sum, rec_type,
+                 corr):
+        self.worker_id = worker_id
+        self.payload = payload
+        self.seq = seq
+        self.epoch = epoch
+        self.lag = lag
+        self.fused = fused
+        self.compressed = compressed
+        self.wire_frame = wire_frame
+        self.rec_payload = rec_payload
+        self.rec_sum = rec_sum
+        self.rec_type = rec_type
+        self.corr = corr
+        self.done = threading.Event()
+        self.exc: BaseException | None = None
+        self.fenced = False
+        self.server_epoch = 0
+        self.dup = False
+        self.applied = False
+        self.version = 0
+        self.center_snap = None
+        self.snap_out = None
+        self.st = None
+        self.wait_token = None
+        self.snap_state = None
+        self.batched = False
 
 
 class _PullState:
@@ -142,6 +213,14 @@ class ParameterServer:
         # module docstring for the full locking discipline
         self._lock = _TimedLock()
         self._pull_versions: dict[int, int] = {}
+        # Batched local EXCHANGE (ISSUE 12): commits queue here and are
+        # drained in ONE center-lock acquisition by whichever thread
+        # holds the lock (flat combining) — K colocated workers' windows
+        # fold back-to-back in arrival order inside one lock section.
+        # The queue lock is leaf-level: held only for O(1) list ops,
+        # never while folding or while any other lock is held.
+        self._fold_mu = threading.Lock()
+        self._fold_pending: list[_FoldWork] = []
         # The PREVIOUS recorded pull version per worker (ISSUE 10): every
         # pull-version record shifts cur → prev, so prev always holds the
         # version recorded one exchange/pull earlier. A pipelined worker's
@@ -219,6 +298,7 @@ class ParameterServer:
         self._n_compressed_pulls = 0
         self._n_commits = 0
         self._n_fused = 0
+        self._n_batched_folds = 0
         self._bytes_in = 0
         self._bytes_out = 0
         # elastic-membership accounting (resilience/elastic.py): the pool
@@ -594,12 +674,25 @@ class ParameterServer:
                      lag: bool = False, compressed: bool = False) -> tuple:
         """The shared commit pipeline behind ``commit`` and ``exchange``:
         decode → off-lock durable encode → fold (+ fused pull
-        bookkeeping) under the center lock → deferred-ACK durability wait
-        → EMA fold. Returns ``(applied, snap, st)``; ``snap``/``st`` are
-        the fused pull's center snapshot and per-worker residual state
-        (None unless ``fused``). Counts the COMMIT-side stats only — the
-        caller counts the pull side once the reply is actually delivered
-        (socket) or materialized (in-process)."""
+        bookkeeping) under the center lock **via the batched drain** →
+        deferred-ACK durability wait → EMA fold. Returns ``(applied,
+        snap, st)``; ``snap``/``st`` are the fused pull's center snapshot
+        and per-worker residual state (None unless ``fused``). Counts the
+        COMMIT-side stats only — the caller counts the pull side once the
+        reply is actually delivered (socket/shm) or materialized
+        (in-process).
+
+        Batched local exchange (ISSUE 12): the locked section is no
+        longer entered per commit. Each commit enqueues a
+        :class:`_FoldWork` and the drain in ``_enqueue_and_fold`` folds
+        every queued window in ONE center-lock acquisition, in arrival
+        order — bit-identity is preserved because folds are
+        order-dependent but the drain applies the SAME serialized
+        arrival order the per-commit lock would have imposed, and each
+        worker still gets its own post-fold snapshot, DynSGD τ, seqno
+        dedup verdict, and WAL record. Everything after the lock (chaos
+        hook, group-commit durability wait, EMA fold, snapshot publish)
+        runs in the submitting thread, exactly as before."""
         import zlib as _zlib
 
         from distkeras_tpu.resilience import wal as _wal
@@ -622,8 +715,8 @@ class ParameterServer:
             # replay is bit-identical either way.
             payload = utils.tree_to_numpy(payload)
             if wire_frame is not None:
-                # socket path: the request frame's bytes are already in
-                # hand — log them verbatim, saving the re-pickle pass
+                # socket/shm pickle lane: the request frame's bytes are
+                # already in hand — log them verbatim, no re-pickle pass
                 rec_payload = wire_frame
                 rec_type = _wal.REC_COMMIT_WIRE
             else:
@@ -631,23 +724,156 @@ class ParameterServer:
                     payload, protocol=pickle.HIGHEST_PROTOCOL
                 )
             rec_sum = _zlib.adler32(rec_payload)
-        snap_state = None
-        wait_token = None
-        # the fold span covers the whole center-lock section (wait +
-        # hold): in a stitched timeline it sits between the worker's
-        # exchange span and the WAL flusher's fsync span, sharing the
-        # frame's correlation id
-        with _trace.span("ps.fold"), self._lock:
-            fenced = epoch is not None and epoch != self.fence_epoch
-            server_epoch = self.fence_epoch
+        work = _FoldWork(
+            worker_id, payload, seq, epoch, lag, fused, compressed,
+            wire_frame, rec_payload, rec_sum, rec_type,
+            _trace.current_corr() if _trace.enabled() else None,
+        )
+        self._enqueue_and_fold(work)
+        if work.exc is not None:
+            raise work.exc
+        if work.fenced:
+            # the payload still crossed the wire: count its bytes (the
+            # native server does — stats parity), just not a commit
+            self._count(bytes_in=nbytes)
+            raise networking.FencedEpochError(
+                "commit fenced: a newer primary holds this history",
+                client_epoch=epoch, server_epoch=work.server_epoch,
+            )
+        if work.dup:
+            self._count(dup_commits=1, bytes_in=nbytes)
+            return False, work.snap_out, work.st
+        self._count(commits=1, bytes_in=nbytes,
+                    batched_folds=1 if work.batched else 0)
+        hook = self.post_commit_hook
+        if hook is not None:
+            # chaos seam, deliberately BEFORE the durability wait: a
+            # kill-PS fault here crashes the server with this commit
+            # appended but its group not yet flushed — the torn-GROUP
+            # case the recovery tests pin (every unACKed commit in the
+            # lost window replays and folds exactly once)
+            hook(work.version)
+        if self._wal is not None:
+            if work.wait_token is not None and self._wal.group_mode:
+                # group commit: the ACK this return releases must imply
+                # fsync'd — block until the flusher lands our window. A
+                # failed wait (the log was abandoned by a crash/IO error,
+                # or timed out) means this commit is NOT durable: refuse
+                # to ACK it — the retryable error tears the caller's
+                # connection (the C++ handler breaks the same way), the
+                # client replays, and the dedup table on whatever server
+                # answers next folds it at most once.
+                with _trace.span("ps.wal_wait"):
+                    durable = self._wal.wait_durable(work.wait_token)
+                if not durable:
+                    raise networking.ProtocolError(
+                        "commit folded but its WAL group never became "
+                        "durable (log abandoned or fsync stalled) — "
+                        "no ACK; replay it", retryable=True,
+                    )
+            else:
+                self._wal.maybe_fsync()  # periodic, off the critical path
+        if self._ema is not None:
+            d = self.ema_decay
+            version = work.version
+            snap = work.center_snap
+
+            def fma(e, c, s):
+                np.multiply(np.asarray(c, dtype=e.dtype), 1.0 - d, out=s)
+                e *= d
+                e += s
+
+            with self._ema_lock:
+                # version-ordered: if a concurrent commit already folded a
+                # NEWER center, this fold is subsumed — dropping it keeps
+                # the EMA a well-formed average of center snapshots instead
+                # of applying an older center after a newer one.
+                if version > self._ema_version:
+                    self._ema_version = version
+                    _tree_map(fma, self._ema, snap, self._ema_scratch)
+        if work.snap_state is not None and self._wal._fh is not None:
+            self._attach_ema_state(work.snap_state)
+            self._wal.publish_snapshot(work.snap_state)
+        return True, work.snap_out, work.st
+
+    def _enqueue_and_fold(self, work: _FoldWork) -> None:
+        """The batched fold drain (ISSUE 12, flat combining): enqueue,
+        then either become the leader — acquire the center lock ONCE and
+        fold EVERY queued commit in arrival order — or wait for the
+        current leader to fold ours. A follower whose window rode the
+        leader's drain never acquires the center lock at all: at K
+        colocated workers the lock is acquired < once per fold
+        (``batched_folds`` / ``center_lock_acquires`` in stats are the
+        observable claim). Arrival order is the queue's append order —
+        the same serialized order the per-commit lock would have
+        imposed, so batched and serial folds are bit-identical (pinned
+        by test)."""
+        t0 = time.perf_counter_ns()
+        with self._fold_mu:
+            self._fold_pending.append(work)
+        while True:
+            # fast path / leader election: non-blocking, so an
+            # uncontended commit pays nothing over the old direct lock
+            if self._lock.acquire(blocking=False):
+                try:
+                    with self._fold_mu:
+                        batch = self._fold_pending
+                        self._fold_pending = []
+                    if batch:
+                        self._drain_folds_locked(batch)
+                finally:
+                    self._lock.release()
+                # any drain that ran since our enqueue — ours or an
+                # earlier leader's — necessarily included our work
+                return
+            # a leader (or a pull) holds the lock: wake the instant our
+            # item completes, re-contend on the slice timeout otherwise
+            if work.done.wait(timeout=_FOLD_WAIT_SLICE):
+                # keep the contention signal honest: pre-batching,
+                # commit queueing showed up as center-lock wait; a
+                # follower never acquires, so its time-to-fold is
+                # credited to wait_ns here (unsynchronized add — the
+                # telemetry counters are documented approximate)
+                self._lock.wait_ns += time.perf_counter_ns() - t0
+                return
+
+    def _drain_folds_locked(self, batch: list[_FoldWork]) -> None:
+        """Fold one drained batch — call holding the center lock. Every
+        item is processed (its ``done`` event always set), exceptions
+        are carried per item to the submitting thread, and the batch
+        span makes K-folds-per-acquisition visible on the timeline."""
+        batched = len(batch) >= 2
+        if batched:
+            with _trace.span("ps.fold_batch", args={"k": len(batch)}):
+                for work in batch:
+                    work.batched = True
+                    self._fold_one_locked(work)
+            return
+        for work in batch:
+            self._fold_one_locked(work)
+
+    def _fold_one_locked(self, work: _FoldWork) -> None:
+        """One commit's center-lock section (the body the per-commit
+        lock used to run), operating on a :class:`_FoldWork` — call
+        holding the center lock. Always sets ``work.done``."""
+        import zlib as _zlib
+
+        from distkeras_tpu.resilience import wal as _wal
+
+        t0 = time.perf_counter_ns()
+        worker_id = work.worker_id
+        try:
+            fenced = (work.epoch is not None
+                      and work.epoch != self.fence_epoch)
+            work.server_epoch = self.fence_epoch
             dup = False
-            if not fenced and seq is not None:
-                if seq <= self._last_seq.get(worker_id, 0):
+            if not fenced and work.seq is not None:
+                if work.seq <= self._last_seq.get(worker_id, 0):
                     dup = True
                 else:
-                    self._last_seq[worker_id] = seq
+                    self._last_seq[worker_id] = work.seq
             if not fenced and not dup:
-                if lag and worker_id in self._prev_pull_versions:
+                if work.lag and worker_id in self._prev_pull_versions:
                     # pipelined exchange: the delta was computed from the
                     # center returned one exchange AGO — price τ from the
                     # previous recorded pull version, not the current one
@@ -657,13 +883,14 @@ class ParameterServer:
                 staleness = self.num_updates - pull_version
                 self.center = utils.tree_to_numpy(
                     self.rule.fold(
-                        self.center, payload, self.num_workers, staleness
+                        self.center, work.payload, self.num_workers,
+                        staleness,
                     )
                 )
                 self.num_updates += 1
-                version = self.num_updates
-                snap = self.center
-                if rec_payload is None and (
+                work.version = self.num_updates
+                work.center_snap = self.center
+                if work.rec_payload is None and (
                         self._wal is not None
                         or self._replica_sock is not None):
                     # an attach_standby raced in between the pre-lock
@@ -671,35 +898,35 @@ class ParameterServer:
                     # under the lock, but only for the one commit that
                     # straddles the attach) so the stream never misses a
                     # fold the attach-time base state didn't include
-                    if wire_frame is not None:
-                        rec_payload = wire_frame
-                        rec_type = _wal.REC_COMMIT_WIRE
+                    if work.wire_frame is not None:
+                        work.rec_payload = work.wire_frame
+                        work.rec_type = _wal.REC_COMMIT_WIRE
                     else:
-                        payload = utils.tree_to_numpy(payload)
-                        rec_payload = pickle.dumps(
-                            payload, protocol=pickle.HIGHEST_PROTOCOL
+                        work.payload = utils.tree_to_numpy(work.payload)
+                        work.rec_payload = pickle.dumps(
+                            work.payload,
+                            protocol=pickle.HIGHEST_PROTOCOL,
                         )
-                    rec_sum = _zlib.adler32(rec_payload)
-                if rec_payload is not None:
+                    work.rec_sum = _zlib.adler32(work.rec_payload)
+                if work.rec_payload is not None:
                     # O(1) under the lock: frame the pre-encoded payload
                     # (split-checksum commit — the header hashes only the
                     # 32-byte prefix) and queue the chunk REFS (bytes are
                     # immutable: no copy, no I/O, inside the lock)
-                    wait_token = self._log_commit_locked(
-                        worker_id, seq, pull_version, version,
-                        rec_payload, rec_sum, rec_type,
+                    work.wait_token = self._log_commit_locked(
+                        worker_id, work.seq, pull_version, work.version,
+                        work.rec_payload, work.rec_sum, work.rec_type,
+                        corr=work.corr,
                     )
                 if self._wal is not None and self._wal.should_snapshot():
                     # phase 1 under the lock: rotate the segment at this
                     # exact version and capture the center-side state;
                     # the O(model) serialize+fsync publish runs after the
-                    # lock (and after this commit's EMA fold, so the
-                    # snapshot's EMA is never behind its center)
+                    # lock in the submitting thread (and after its EMA
+                    # fold, so the snapshot's EMA never trails its center)
                     self._wal.rotate(self.num_updates)
-                    snap_state = self._capture_state_locked()
-            snap_out = None
-            st = None
-            if fused and not fenced:
+                    work.snap_state = self._capture_state_locked()
+            if work.fused and not fenced:
                 # the fused pull half — applied AND duplicate commits get
                 # it (a lost-ACK replay still needs the fresh center, and
                 # recording its version is exactly what a retried pull
@@ -715,87 +942,44 @@ class ParameterServer:
                         _wal.REC_PULL,
                         (int(worker_id), int(self.num_updates)),
                     ))
-                snap_out = self.center
-                if compressed:
+                work.snap_out = self.center
+                if work.compressed:
                     st = self._pull_errors.get(worker_id)
                     if st is None:
                         st = self._pull_errors[worker_id] = _PullState()
+                    work.st = st
             if fenced:
                 self._n_fenced_commits += 1
-        if fenced:
-            # the payload still crossed the wire: count its bytes (the
-            # native server does — stats parity), just not a commit
-            self._count(bytes_in=nbytes)
-            raise networking.FencedEpochError(
-                "commit fenced: a newer primary holds this history",
-                client_epoch=epoch, server_epoch=server_epoch,
-            )
-        if dup:
-            self._count(dup_commits=1, bytes_in=nbytes)
-            return False, snap_out, st
-        self._count(commits=1, bytes_in=nbytes)
-        hook = self.post_commit_hook
-        if hook is not None:
-            # chaos seam, deliberately BEFORE the durability wait: a
-            # kill-PS fault here crashes the server with this commit
-            # appended but its group not yet flushed — the torn-GROUP
-            # case the recovery tests pin (every unACKed commit in the
-            # lost window replays and folds exactly once)
-            hook(version)
-        if self._wal is not None:
-            if wait_token is not None and self._wal.group_mode:
-                # group commit: the ACK this return releases must imply
-                # fsync'd — block until the flusher lands our window. A
-                # failed wait (the log was abandoned by a crash/IO error,
-                # or timed out) means this commit is NOT durable: refuse
-                # to ACK it — the retryable error tears the caller's
-                # connection (the C++ handler breaks the same way), the
-                # client replays, and the dedup table on whatever server
-                # answers next folds it at most once.
-                with _trace.span("ps.wal_wait"):
-                    durable = self._wal.wait_durable(wait_token)
-                if not durable:
-                    raise networking.ProtocolError(
-                        "commit folded but its WAL group never became "
-                        "durable (log abandoned or fsync stalled) — "
-                        "no ACK; replay it", retryable=True,
-                    )
-            else:
-                self._wal.maybe_fsync()  # periodic, off the critical path
-        if self._ema is not None:
-            d = self.ema_decay
-
-            def fma(e, c, s):
-                np.multiply(np.asarray(c, dtype=e.dtype), 1.0 - d, out=s)
-                e *= d
-                e += s
-
-            with self._ema_lock:
-                # version-ordered: if a concurrent commit already folded a
-                # NEWER center, this fold is subsumed — dropping it keeps
-                # the EMA a well-formed average of center snapshots instead
-                # of applying an older center after a newer one.
-                if version > self._ema_version:
-                    self._ema_version = version
-                    _tree_map(fma, self._ema, snap, self._ema_scratch)
-        if snap_state is not None and self._wal._fh is not None:
-            self._attach_ema_state(snap_state)
-            self._wal.publish_snapshot(snap_state)
-        return True, snap_out, st
+            work.fenced = fenced
+            work.dup = dup
+            work.applied = not fenced and not dup
+        except BaseException as e:  # carried to the submitting thread
+            work.exc = e
+        finally:
+            if _trace.enabled():
+                # per-fold span with the COMMIT'S correlation id (the
+                # leader's thread corr would mislabel followers' folds)
+                _trace.record("ps.fold", t0, time.perf_counter_ns(),
+                              corr=work.corr)
+            work.done.set()
 
     def _log_commit_locked(self, worker_id: int, seq: int | None,
                            pull_version: int, version: int,
                            rec_payload: bytes, rec_sum: int,
-                           rec_type: int) -> int | None:
+                           rec_type: int,
+                           corr: str | None = None) -> int | None:
         """Hand one commit record to every durable sink — call under the
         center lock (durable order == fold order; record-before-ACK).
         The payload bytes and their checksum were computed OFF the lock;
         this frames and queues pre-encoded chunks without ever copying or
         hashing the O(model) payload. Returns the WAL durability token
-        (None without a WAL)."""
+        (None without a WAL). ``corr`` is the commit's correlation id —
+        under the batched fold drain the executing thread may be another
+        commit's leader, so the span must carry the item's id, not the
+        thread's."""
         from distkeras_tpu.resilience import wal as _wal
 
-        with _trace.span("ps.wal_append"):
+        with _trace.span("ps.wal_append", corr=corr):
             chunks = _wal.encode_commit_chunks(
                 worker_id, seq, pull_version, version, rec_payload,
                 rec_sum, rec_type=rec_type,
@@ -1080,7 +1264,8 @@ class ParameterServer:
         return False
 
     def _count(self, pulls=0, compressed_pulls=0, commits=0,
-               bytes_in=0, bytes_out=0, dup_commits=0, fused=0):
+               bytes_in=0, bytes_out=0, dup_commits=0, fused=0,
+               batched_folds=0):
         with self._stats_lock:
             self._n_pulls += pulls
             self._n_compressed_pulls += compressed_pulls
@@ -1089,6 +1274,7 @@ class ParameterServer:
             self._bytes_out += bytes_out
             self._n_dup_commits += dup_commits
             self._n_fused += fused
+            self._n_batched_folds += batched_folds
 
     def stats(self) -> dict:
         """Contention + throughput counters (cheap, approximate under load).
@@ -1124,6 +1310,7 @@ class ParameterServer:
             cpulls = self._n_compressed_pulls
             commits = self._n_commits
             fusedx = self._n_fused
+            batched = self._n_batched_folds
             bytes_in, bytes_out = self._bytes_in, self._bytes_out
             dups = self._n_dup_commits
             pool = self._pool_size
@@ -1147,7 +1334,7 @@ class ParameterServer:
             wal_group_max=0 if wal is None else wal.wal_group_max,
             pool_size=pool, joined_workers=joined,
             preempted_workers=preempted, drain_timeouts=drain_to,
-            fused_exchanges=fusedx,
+            fused_exchanges=fusedx, batched_folds=batched,
         )
 
 
@@ -1162,7 +1349,8 @@ def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
                    wal_group_max: int = 0, pool_size: int = 0,
                    joined_workers: int = 0, preempted_workers: int = 0,
                    drain_timeouts: int = 0,
-                   fused_exchanges: int = 0) -> dict:
+                   fused_exchanges: int = 0,
+                   batched_folds: int = 0) -> dict:
     """The ONE stats-dict builder both PS transports share (Python counters
     here, C++ atomics via ``native_ps.NativeSocketParameterServer.stats``):
     key set and derived-value math are pinned by construction, so the
@@ -1220,6 +1408,13 @@ def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
         "fused_exchanges": fused_exchanges,
         "exchange_rtts": (pulls + compressed_pulls + commits + dup_commits
                           - fused_exchanges),
+        # batched local exchange (ISSUE 12): folds that landed inside a
+        # multi-fold center-lock section (the flat-combining drain).
+        # commits − batched_folds ≈ lock acquisitions spent on commits,
+        # so batched_folds > 0 is the observable proof that K colocated
+        # workers' windows folded under < K acquisitions. 0 on the
+        # native transport (its C++ fold path is per-commit).
+        "batched_folds": batched_folds,
     }
 
 

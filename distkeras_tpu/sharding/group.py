@@ -73,10 +73,10 @@ class ShardedPSGroup:
                  vnodes: int = 64, bound: float = 1.25):
         from distkeras_tpu import utils
 
-        if transport not in ("inprocess", "socket", "native"):
+        if transport not in ("inprocess", "socket", "native", "shm"):
             raise ValueError(
-                f"transport must be 'inprocess', 'socket', or 'native', "
-                f"got {transport!r}"
+                f"transport must be 'inprocess', 'socket', 'native', or "
+                f"'shm', got {transport!r}"
             )
         if chain_length < 1:
             raise ValueError(
@@ -149,6 +149,20 @@ class ShardedPSGroup:
             srv = SocketParameterServer(
                 sub_center, self.rule, self.num_workers, host=self.host,
                 port=0, ema_decay=self.ema_decay,
+                lease_timeout=self.lease_timeout,
+                wal_dir=wal_dir, snapshot_every=self.snapshot_every,
+                wal_group_window=self.wal_group_window,
+                wal_group_interval=self.wal_group_interval,
+            )
+        elif self.transport == "shm":
+            # shared-memory ring shard (ISSUE 12): each shard serves its
+            # sub-center over per-worker mmap ring pairs — the fan-out
+            # client opens one ring pair per (worker, shard)
+            from distkeras_tpu.shm import ShmParameterServer
+
+            srv = ShmParameterServer(
+                sub_center, self.rule, self.num_workers,
+                ema_decay=self.ema_decay,
                 lease_timeout=self.lease_timeout,
                 wal_dir=wal_dir, snapshot_every=self.snapshot_every,
                 wal_group_window=self.wal_group_window,
@@ -403,6 +417,18 @@ class ShardedPSGroup:
                 )
 
             return mk
+        if self.transport == "shm":
+            from distkeras_tpu.shm import ShmPSClient
+
+            def mk_shm(sid=sid):
+                # each call mints a fresh ring pair against the shard's
+                # server — exactly what a resilient reconnect needs
+                return ShmPSClient(
+                    self.servers[sid], worker_id,
+                    pull_compression=pull_compression,
+                )
+
+            return mk_shm
         from distkeras_tpu.native_ps import NativePSClient
 
         def mk_native(sid=sid):
@@ -455,6 +481,9 @@ def aggregate_ps_stats(per_shard: list[dict]) -> dict:
         # per-shard 2→1 claim reads off each shard's own pair of entries
         # in per_shard, and the roll-up totals the group's wire traffic
         "fused_exchanges", "exchange_rtts",
+        # batched local exchange (ISSUE 12): per-shard drains batch
+        # independently, so the roll-up is a plain sum like the op counts
+        "batched_folds",
     )
     # elastic-membership counters are maxed like the lease gauges: every
     # shard sees the SAME global joins/drains through the fan-out, so

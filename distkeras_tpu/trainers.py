@@ -593,14 +593,16 @@ class DistributedTrainer(Trainer):
         if backend not in ("collective", "ps"):
             raise ValueError(f"backend must be 'collective' or 'ps', got {backend!r}")
         self.backend = backend
-        # PS-backend options: in-process shared-memory PS (single host), a
-        # TCP socket PS (the DCN/multi-slice story), or the C++ native PS
-        # (same TCP story with a pickle-free flat-f32 wire and a GIL-free
-        # fold — distkeras_tpu/native_ps.py).
-        if ps_transport not in ("inprocess", "socket", "native"):
+        # PS-backend options: in-process PS (single host, worker threads
+        # call the center directly), a TCP socket PS (the DCN/multi-slice
+        # story), the C++ native PS (same TCP story with a pickle-free
+        # flat-f32 wire and a GIL-free fold — distkeras_tpu/native_ps.py),
+        # or the shared-memory ring PS (``shm`` — zero-syscall mmap ring
+        # pairs for the colocated regime, distkeras_tpu/shm.py, ISSUE 12).
+        if ps_transport not in ("inprocess", "socket", "native", "shm"):
             raise ValueError(
-                f"ps_transport must be 'inprocess', 'socket', or 'native', "
-                f"got {ps_transport!r}"
+                f"ps_transport must be 'inprocess', 'socket', 'native', "
+                f"or 'shm', got {ps_transport!r}"
             )
         self.ps_transport = ps_transport
         self.ps_port = ps_port
@@ -612,7 +614,10 @@ class DistributedTrainer(Trainer):
         if ps_host is not None and ps_transport not in ("socket", "native"):
             raise ValueError(
                 "ps_host requires ps_transport='socket' or 'native' (an "
-                "external PS is only reachable over TCP)"
+                "external PS is only reachable over TCP; "
+                "ps_transport='shm' is colocated-only — its rings live in "
+                "this host's /dev/shm, so point ps_host at a socket/native "
+                "server instead)"
             )
         self.ps_host = ps_host
         self.worker_id_offset = int(worker_id_offset)

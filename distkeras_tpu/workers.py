@@ -1082,6 +1082,28 @@ def run_async_training(trainer, ds, shuffle: bool):
             def make_client(i):
                 return ParameterServerClient("127.0.0.1", ps.port, i,
                                              pull_compression=pull_comp)
+    elif transport == "shm":
+        # shared-memory ring transport (ISSUE 12): zero-syscall,
+        # zero-copy exchange for the colocated regime — same protocol,
+        # resilience tokens, WAL, and chaos seams as the socket wire,
+        # framed over per-worker mmap ring pairs. Colocated-only by
+        # construction (trainers.py rejects ps_host with it).
+        from distkeras_tpu.shm import ShmParameterServer, ShmPSClient
+
+        ps = ShmParameterServer(
+            params, rule, W, ema_decay=getattr(trainer, "ema_decay", None),
+            lease_timeout=lease_timeout,
+            wal_dir=ps_wal_dir, snapshot_every=ps_snapshot_every,
+            wal_group_window=ps_wal_group_window,
+            wal_group_interval=ps_wal_group_interval,
+        )
+        ps.initialize()
+        ps.start()
+
+        def make_client(i):
+            # any id mints a fresh ring pair — the elastic coordinator
+            # builds joiner clients through this factory too
+            return ShmPSClient(ps, i, pull_compression=pull_comp)
     elif transport == "inprocess":
         ps = ParameterServer(
             params, rule, W, ema_decay=getattr(trainer, "ema_decay", None),

@@ -82,53 +82,66 @@ def test_ps_shard_bench_contract():
 
 
 def test_ps_exchange_bench_contract():
-    """--ps-bench's exchange leg (ISSUE 10): serial vs fused vs
+    """--ps-bench's exchange leg (ISSUE 10 + 12): serial vs fused vs
     fused+pipelined records present with positive rates, the measured
     RTT-per-round oracle (2 for serial, 1 for fused — the wire-cost
-    halving read off ps.stats(), not asserted), and the host-ceiling
-    honesty field. Rate ORDERING is asserted only for the counters-based
-    claim; wall-clock speedups are recorded, not asserted (CI hosts
+    halving read off ps.stats(), not asserted), the host-ceiling
+    honesty field, and the ISSUE 12 columns: an shm leg next to the
+    socket leg, the shm-vs-socket ratio recorded on it, and the
+    batched-fold lock-amortization fields on every leg. Rate ORDERING
+    is asserted only for the counters-based claim; wall-clock speedups
+    and cross-transport ratios are recorded, not asserted (CI hosts
     jitter)."""
     out = bench.run_ps_exchange_bench(n_params=16_384, workers=(2,),
-                                      seconds=0.4, transports=("socket",),
+                                      seconds=0.4,
+                                      transports=("socket", "shm"),
                                       compute_ms=2.0)
-    assert set(out) == {"ps_exchange_socket_w2"}
-    rec = out["ps_exchange_socket_w2"]
-    for k in ("serial_rounds_per_sec", "fused_rounds_per_sec",
-              "pipelined_rounds_per_sec"):
-        assert rec[k] > 0, k
-    # the acceptance counter oracle: 1 wire RTT per fused round, 2 per
-    # serial round (small slack: pull-side counters land post-send)
-    assert 1.9 <= rec["serial_rtts_per_round"] <= 2.1
-    assert 0.9 <= rec["fused_rtts_per_round"] <= 1.1
-    assert rec["fused_exchanges"] > 0
-    assert rec["host_cores"] >= 1
-    assert rec["speedup_pipelined_vs_serial"] > 0
+    assert set(out) == {"ps_exchange_socket_w2", "ps_exchange_shm_w2"}
+    for name, rec in out.items():
+        for k in ("serial_rounds_per_sec", "fused_rounds_per_sec",
+                  "pipelined_rounds_per_sec"):
+            assert rec[k] > 0, (name, k)
+        # the acceptance counter oracle: 1 wire RTT per fused round, 2
+        # per serial round (pull-side counters settle exactly)
+        assert 1.9 <= rec["serial_rtts_per_round"] <= 2.1, name
+        assert 0.9 <= rec["fused_rtts_per_round"] <= 1.1, name
+        assert rec["fused_exchanges"] > 0, name
+        assert rec["host_cores"] >= 1, name
+        assert rec["speedup_pipelined_vs_serial"] > 0, name
+        # ISSUE 12: the batched-fold columns ride every leg
+        assert rec["batched_folds"] >= 0, name
+        assert rec["fused_lock_acquires_per_round"] > 0, name
+    shm_rec = out["ps_exchange_shm_w2"]
+    for leg in ("serial", "fused", "pipelined"):
+        assert shm_rec[f"shm_vs_socket_{leg}"] > 0, leg
 
 
 def test_ps_group_commit_sweep_contract():
-    """--chaos-ps's flush-window sweep (ISSUE 7): every leg present with
-    positive rates, the exactly-once oracle asserted per leg, the
-    durable legs carrying the WAL amortization counters, and the
-    durable-vs-raw fraction computed against the no-WAL line."""
+    """--chaos-ps's flush-window sweep (ISSUE 7 + the ISSUE 12 shm leg):
+    every leg present with positive rates, the exactly-once oracle
+    asserted per leg, the durable legs carrying the WAL amortization
+    counters, and the durable-vs-raw fraction computed against the
+    no-WAL line — on the socket AND shm transports."""
     out = bench.run_ps_group_commit_sweep(n_params=16_384, workers=2,
                                           seconds=0.25,
-                                          transports=("socket",))
-    rec = out["ps_group_commit_socket"]
-    assert set(rec["legs"]) == {"nowal", "w1", "w8", "w32", "time"}
-    assert rec["host_cores"] >= 1 and rec["wal_fs"]
-    for leg, r in rec["legs"].items():
-        assert r["rounds_per_sec"] > 0, leg
-        assert r["dedup_exact_once"], leg
-        assert "invalid" not in r, leg
-        if leg == "nowal":
-            assert r["wal_records"] == 0
-        else:
-            assert r["wal_records"] > 0
-            assert 0 < r["durable_fraction"]
-            if leg != "time":  # a short run may not cross the deadline
-                assert r["wal_fsyncs"] >= 1
-    assert rec["durable_fraction_w8"] == rec["legs"]["w8"]["durable_fraction"]
+                                          transports=("socket", "shm"))
+    assert set(out) == {"ps_group_commit_socket", "ps_group_commit_shm"}
+    for name, rec in out.items():
+        assert set(rec["legs"]) == {"nowal", "w1", "w8", "w32", "time"}, name
+        assert rec["host_cores"] >= 1 and rec["wal_fs"]
+        for leg, r in rec["legs"].items():
+            assert r["rounds_per_sec"] > 0, (name, leg)
+            assert r["dedup_exact_once"], (name, leg)
+            assert "invalid" not in r, (name, leg)
+            if leg == "nowal":
+                assert r["wal_records"] == 0
+            else:
+                assert r["wal_records"] > 0
+                assert 0 < r["durable_fraction"]
+                if leg != "time":  # a short run may not cross the deadline
+                    assert r["wal_fsyncs"] >= 1
+        assert rec["durable_fraction_w8"] == \
+            rec["legs"]["w8"]["durable_fraction"]
 
 
 def test_ps_elastic_bench_contract():

@@ -301,6 +301,56 @@ def test_join_and_drain_over_socket_wire_retires_dedup_seqno():
         ps.stop()
 
 
+def test_join_and_drain_over_shm_rings_retires_dedup_seqno():
+    """ISSUE 12: the shm transport speaks the full elastic protocol —
+    join/drain over the rings with the same pool accounting and
+    bounded-dedup-table retirement as the socket wire."""
+    from distkeras_tpu.shm import ShmParameterServer, ShmPSClient
+
+    ps = ShmParameterServer({"w": np.zeros(2, np.float32)},
+                            DownpourMerge(), 1)
+    ps.initialize()
+    ps.start()
+    try:
+        c = ShmPSClient(ps, 3)
+        rec = c.join()
+        assert rec["ok"] and rec["pool_size"] == 2
+        c.commit(3, {"w": np.ones(2, np.float32)}, seq=9)
+        assert 3 in ps._last_seq
+        c.drain(timeout=False)
+        assert 3 not in ps._last_seq      # the PR 5 bounded-table path
+        s = ps.stats()
+        assert s["pool_size"] == 1
+        assert s["joined_workers"] == 1 and s["preempted_workers"] == 1
+        c.close()
+    finally:
+        ps.stop()
+
+
+def test_elastic_trainer_live_join_and_clean_preempt_shm():
+    """ISSUE 12: the elastic trainer loop on ps_transport='shm' —
+    build_client mints JOINER ring clients mid-run, the drained worker
+    leaves cleanly, and the exactly-once ledger holds."""
+    from distkeras_tpu.shm import ShmParameterServer  # noqa: F401
+
+    ds = blobs_dataset(n=1024)
+    plan = FaultPlan(seed=3, join_worker_at_window={0: 1},
+                     preempt_worker_at_window={1: 1})
+    t = dk.DOWNPOUR(model_spec(), **_kw(elastic=True, fault_plan=plan,
+                                        ps_transport="shm",
+                                        heartbeat_interval=0.1))
+    t.train(ds, shuffle=True)
+    el = t.resilience_stats_["elastic"]
+    assert el["joined"] == 1 and el["preempted"] == 1
+    assert el["assigner"]["exactly_once"], el["assigner"]
+    s = t.ps_stats_
+    assert s["joined_workers"] == 1 and s["preempted_workers"] == 1
+    assert s["pool_size"] == 2            # 2 + 1 join − 1 drain
+    assert s["commits"] == t.resilience_stats_["logical_commits"]
+    workers_seen = {r.get("worker") for r in t.get_history() if "loss" in r}
+    assert 2 in workers_seen              # the joiner trained over rings
+
+
 def test_native_join_drain_protocol_parity():
     """The C++ transport speaks JOIN/DRAIN (actions 12/13) with the same
     pool accounting and the same stats key set as the Python PS."""

@@ -1,0 +1,597 @@
+"""Shared-memory ring transport + batched local EXCHANGE (ISSUE 12).
+
+The oracles, mirroring every transport before it:
+
+- framing round-trips on both lanes, incl. ring-wrapping records and the
+  >1-ring-capacity oversize spill path;
+- shm-transport trainer runs bit-identical to the in-process transport
+  (ADAG/DOWNPOUR/DynSGD, int8 pulls+commits, fused and pipelined legs,
+  2-shard fan-out);
+- chaos exactly-once under FaultPlan drops over the rings;
+- WAL replay parity from shm-logged wire frames (verbatim
+  REC_COMMIT_WIRE through the one shared decode pipeline);
+- batched folds bit-identical to the same arrival order folded serially,
+  and the deterministic K-folds-one-acquisition drain;
+- peer death mid-ring-write surfaces as a retryable PeerDeadError and
+  never wedges the server; segments unlink on close/stop/eviction —
+  /dev/shm never leaks (checked by name).
+"""
+
+import os
+import struct
+import threading
+import time
+from multiprocessing import shared_memory
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import networking, shm
+from distkeras_tpu.networking import PeerDeadError
+from distkeras_tpu.parallel.merge_rules import DownpourMerge, DynSGDMerge
+from distkeras_tpu.parameter_servers import ParameterServer
+from distkeras_tpu.shm import ShmParameterServer, ShmPSClient
+from tests.test_exchange import _run, _tree_equal
+
+_PAIR_SEQ = iter(range(10_000))
+
+
+def _dkshm_entries():
+    try:
+        return [f for f in os.listdir("/dev/shm") if f.startswith("dkshm")]
+    except FileNotFoundError:  # no tmpfs: SharedMemory still works
+        return []
+
+
+def _conn_pair(ring_bytes=1 << 14):
+    """A raw client/server endpoint pair over one fresh segment (no
+    handler thread — the test drives both ends)."""
+    seg = shared_memory.SharedMemory(
+        create=True, name=f"dkshm_test_{os.getpid()}_{next(_PAIR_SEQ)}",
+        size=shm._HDR_BYTES + 2 * ring_bytes,
+    )
+    struct.pack_into("<Q", seg.buf, shm._OFF_MAGIC, shm._MAGIC)
+    struct.pack_into("<Q", seg.buf, shm._OFF_CAP, ring_bytes)
+    waker = shm._waker_for(seg.name)
+    cli = shm._ShmConn(seg, "client", waker)
+    srv = shm._ShmConn(seg, "server", waker)
+    return seg, cli, srv
+
+
+def _drop_pair(seg, cli, srv):
+    cli.close()
+    srv.close()
+    shm._waker_drop(seg.name)
+    try:
+        seg.close()
+    except BufferError:
+        pass
+    seg.unlink()
+
+
+# -- framing -----------------------------------------------------------------
+
+
+def test_pickle_lane_roundtrip_wraps_the_ring():
+    """Many frames through a tiny ring: records cross the wrap point
+    repeatedly and every frame survives byte-exact."""
+    seg, cli, srv = _conn_pair(ring_bytes=1 << 12)
+    try:
+        for i in range(64):  # 64 * ~200B >> 4 KiB ring: plenty of wraps
+            msg = {"action": "ping", "i": i, "blob": b"x" * (i * 7 % 97)}
+            cli.send_msg(msg)
+            got, raw, release = srv.recv_msg()
+            assert release is None and raw is not None
+            assert got == msg
+            srv.send_msg({"ok": True, "i": i})
+            assert cli.recv_msg()[0] == {"ok": True, "i": i}
+    finally:
+        _drop_pair(seg, cli, srv)
+
+
+def test_bulk_lane_roundtrip_views_then_release():
+    """The zero-copy lane: ndarray leaves arrive as views over the
+    mapped ring (no copy until the consumer says so), scalars and codec
+    marks ride the skeleton, release frees the region for the next
+    frame."""
+    seg, cli, srv = _conn_pair(ring_bytes=1 << 14)
+    try:
+        rng = np.random.default_rng(0)
+        for _ in range(8):  # repeated: the region must actually free
+            msg = {
+                "action": "commit", "worker_id": 3, "seq": 7,
+                "payload": {
+                    "w": rng.normal(size=(31,)).astype(np.float32),
+                    "q": {"b": np.arange(5, dtype=np.int8), "s": 0.25},
+                },
+            }
+            cli.send_msg(msg, bulk=True)
+            got, raw, release = srv.recv_msg()
+            assert raw is None and release is not None
+            assert got["worker_id"] == 3 and got["seq"] == 7
+            assert got["payload"]["q"]["s"] == 0.25
+            assert np.array_equal(got["payload"]["w"], msg["payload"]["w"])
+            assert np.array_equal(got["payload"]["q"]["b"],
+                                  msg["payload"]["q"]["b"])
+            release()
+    finally:
+        _drop_pair(seg, cli, srv)
+
+
+def test_oversize_payload_spills_through_a_small_ring():
+    """A payload several times the ring capacity streams through the
+    spill path (progressive publication both sides) byte-exact — the
+    >1-ring-capacity contract."""
+    seg, cli, srv = _conn_pair(ring_bytes=1 << 12)  # 4 KiB rings
+    try:
+        big = np.arange(50_000, dtype=np.float32)  # 200 KB >> ring
+        out = {}
+
+        def reader():
+            out["msg"], _, rel = srv.recv_msg(copy=True)
+            assert rel is None
+
+        t = threading.Thread(target=reader)
+        t.start()
+        cli.send_msg({"payload": {"w": big}}, bulk=True)  # falls back
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert np.array_equal(out["msg"]["payload"]["w"], big)
+    finally:
+        _drop_pair(seg, cli, srv)
+
+
+def test_duck_socket_carries_networking_frames_and_fault_hook():
+    """networking.send_data/recv_data run UNCHANGED over the conn (the
+    inherited client actions' path), and the _fault_hook chaos seam
+    fires on both ops."""
+    seg, cli, srv = _conn_pair()
+    calls = []
+    old = networking._fault_hook
+    networking._fault_hook = lambda op, sock: calls.append(op)
+    try:
+        msg = {"action": "heartbeat", "worker_id": 1,
+               "w": np.ones(16, np.float32)}
+        networking.send_data(cli, msg)
+        got, raw = networking.recv_data_raw(srv)
+        assert got["action"] == "heartbeat"
+        assert np.array_equal(got["w"], msg["w"])
+        assert raw  # the verbatim frame bytes the WAL would log
+        assert calls == ["send", "recv"]
+    finally:
+        networking._fault_hook = old
+        _drop_pair(seg, cli, srv)
+
+
+# -- peer death & leak hygiene ----------------------------------------------
+
+
+def test_peer_death_mid_record_raises_retryable_and_never_wedges():
+    """A writer that dies after publishing a record word but before the
+    payload: the blocked reader surfaces a typed, RETRYABLE
+    PeerDeadError (the satellite's liveness contract) instead of
+    wedging."""
+    seg, cli, srv = _conn_pair()
+    try:
+        # half a record: a word claiming 100 payload bytes, then death
+        cli._skip_to_word_boundary_tx()
+        cli._stream_tx([shm._WORD.pack((shm.FLAG_PKL << 56) | 100)])
+        errs = []
+
+        def reader():
+            try:
+                srv.recv_msg()
+            except BaseException as e:
+                errs.append(e)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        time.sleep(0.05)
+        cli.close()  # mid-record death
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert errs and isinstance(errs[0], PeerDeadError)
+        assert errs[0].retryable
+        assert isinstance(errs[0], ConnectionError)  # existing triage
+    finally:
+        _drop_pair(seg, cli, srv)
+
+
+def test_server_unlinks_segments_on_close_stop_and_no_leaks():
+    """Segments vanish from /dev/shm on client close AND on server stop
+    with clients abandoned un-closed — the no-leak contract, checked by
+    name."""
+    before = set(_dkshm_entries())
+    center = {"w": np.zeros(64, np.float32)}
+    ps = ShmParameterServer(center, DownpourMerge(), 2, ring_bytes=1 << 14)
+    ps.initialize()
+    ps.start()
+    c0 = ShmPSClient(ps, 0)
+    c1 = ShmPSClient(ps, 1)  # never closed: stop() must reclaim it
+    c0.pull()
+    c1.pull()
+    assert len(set(_dkshm_entries()) - before) == 2
+    c0.close()
+    deadline = time.monotonic() + 5
+    while len(set(_dkshm_entries()) - before) > 1 \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(set(_dkshm_entries()) - before) == 1  # c0's reclaimed
+    ps.stop()
+    assert set(_dkshm_entries()) <= before  # c1's reclaimed by stop
+
+
+def test_heartbeat_eviction_reclaims_abandoned_worker_segment():
+    """The PR 4 lease eviction garbage-collects the shm lane too: an
+    abandoned worker's lease lapses, _on_evict closes its connection,
+    the handler exits, the segment unlinks."""
+    before = set(_dkshm_entries())
+    center = {"w": np.zeros(64, np.float32)}
+    ps = ShmParameterServer(center, DownpourMerge(), 1,
+                            ring_bytes=1 << 14, lease_timeout=0.2)
+    ps.initialize()
+    ps.start()
+    try:
+        c = ShmPSClient(ps, 0)
+        c.heartbeat()  # registers the lease
+        assert len(set(_dkshm_entries()) - before) == 1
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            time.sleep(0.1)
+            if ps.stats()["evicted_workers"] >= 1 \
+                    and not (set(_dkshm_entries()) - before):
+                break
+        assert ps.stats()["evicted_workers"] >= 1
+        assert not (set(_dkshm_entries()) - before)
+        # the abandoned client's next op sees typed peer death
+        with pytest.raises(ConnectionError):
+            c.pull()
+    finally:
+        ps.stop()
+
+
+# -- trainer bit-identity ----------------------------------------------------
+
+
+@pytest.mark.parametrize("cls_name", ["ADAG", "DOWNPOUR", "DynSGD"])
+def test_trainer_shm_bit_identical_to_inprocess(cls_name):
+    """The acceptance oracle: shm-transport training produces a final
+    center bit-identical to the in-process transport, per merge rule."""
+    _, w_inp = _run(cls_name)
+    _, w_shm = _run(cls_name, ps_transport="shm")
+    assert _tree_equal(w_inp, w_shm)
+
+
+def test_trainer_shm_bit_identical_int8_and_fused_legs():
+    """int8 commits + int8 pulls over the rings (bulk-lane codec blobs)
+    match the in-process oracle bitwise, fused and unfused."""
+    _, w_inp = _run("DOWNPOUR", compression="int8",
+                    pull_compression="int8")
+    _, w_shm = _run("DOWNPOUR", compression="int8",
+                    pull_compression="int8", ps_transport="shm")
+    _, w_unf = _run("DOWNPOUR", compression="int8",
+                    pull_compression="int8", ps_transport="shm",
+                    ps_fused_exchange=False)
+    assert _tree_equal(w_inp, w_shm)
+    assert _tree_equal(w_inp, w_unf)
+
+
+def test_trainer_shm_pipelined_single_worker_telescopes():
+    """The PR 10 pipelined telescope holds over the rings: a single
+    DOWNPOUR worker's depth-1 run is bit-identical to its serial run."""
+    _, w0 = _run("DOWNPOUR", ps_transport="shm")
+    _, w1 = _run("DOWNPOUR", ps_transport="shm", ps_pipeline_depth=1)
+    assert _tree_equal(w0, w1)
+
+
+def test_trainer_shm_two_shard_fanout_bit_identical():
+    """ps_num_shards=2 over the shm lane: the fan-out client opens one
+    ring pair per (worker, shard) and the folds pin bit-identical to
+    the single in-process center."""
+    _, w1 = _run("DynSGD")
+    t, w2 = _run("DynSGD", ps_num_shards=2, ps_transport="shm")
+    assert _tree_equal(w1, w2)
+    assert t.ps_stats_["num_shards"] == 2
+
+
+# -- chaos / resilience ------------------------------------------------------
+
+
+def test_shm_chaos_exactly_once_under_drops():
+    """FaultPlan drops over the rings + ResilientPSClient reconnect
+    (each reconnect mints a FRESH ring pair): lifetime folds == logical
+    exchanges confirmed — the dedup exactly-once oracle."""
+    from distkeras_tpu.resilience.faults import FaultPlan
+    from distkeras_tpu.resilience.retry import (
+        ResilientPSClient,
+        RetryPolicy,
+    )
+
+    W, N = 2, 15
+    center = {"w": np.zeros(128, np.float32)}
+    delta = {"w": np.full(128, 1e-3, np.float32)}
+    before = set(_dkshm_entries())
+    ps = ShmParameterServer(center, DownpourMerge(), W, ring_bytes=1 << 15)
+    ps.initialize()
+    ps.start()
+    policy = RetryPolicy(max_attempts=50, base_delay=0.005,
+                         max_delay=0.05, deadline=60.0)
+    clients = [
+        ResilientPSClient(lambda i=i: ShmPSClient(ps, i), i, policy=policy)
+        for i in range(W)
+    ]
+    plan = FaultPlan(seed=11, drop_recv=0.12, max_faults=60)
+    errors = []
+
+    def worker(i):
+        try:
+            c = clients[i]
+            c.pull()
+            for _ in range(N):
+                out = c.exchange(i, delta)
+                assert np.all(np.isfinite(out["w"]))
+        except BaseException as e:
+            errors.append(e)
+
+    try:
+        with plan:
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(W)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert not errors, errors
+        assert plan.stats()["drops"] > 0  # the chaos actually bit
+        logical = sum(c.seq for c in clients)
+        assert logical == W * N
+        assert ps.num_updates == logical  # exactly-once folds
+    finally:
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        ps.stop()
+    assert set(_dkshm_entries()) <= before  # chaos leaked nothing
+
+
+def test_shm_wal_replay_parity_from_wire_frames(tmp_path):
+    """A durable shm server's clients take the pickle lane (handshake
+    wal_frames), so commits are logged VERBATIM (REC_COMMIT_WIRE) and
+    recovery replays them through the one shared decode pipeline to a
+    bit-identical server — incl. DynSGD staleness state."""
+    rng = np.random.default_rng(9)
+    center = {"w": rng.normal(size=(32,)).astype(np.float32)}
+    deltas = [{"w": rng.normal(size=(32,)).astype(np.float32) * 0.1}
+              for _ in range(3)]
+    ps = ShmParameterServer(center, DynSGDMerge(), 1,
+                            wal_dir=str(tmp_path / "wal"),
+                            wal_group_window=1)
+    ps.initialize()
+    ps.start()
+    try:
+        c = ShmPSClient(ps, 0)
+        assert c._wal_frames  # the handshake picked the verbatim lane
+        c.pull()
+        for i, d in enumerate(deltas):
+            c.exchange(0, d, seq=i + 1, lag=True)
+        live_center = ps.get_model()
+        live_cur = dict(ps._pull_versions)
+        live_prev = dict(ps._prev_pull_versions)
+        c.close()
+    finally:
+        ps.stop()
+    rec = ParameterServer(center, DynSGDMerge(), num_workers=1,
+                          wal_dir=str(tmp_path / "wal"))
+    assert rec.recovered_ and rec.num_updates == 3
+    assert _tree_equal(rec.get_model(), live_center)
+    assert rec._pull_versions == live_cur
+    assert rec._prev_pull_versions == live_prev
+
+
+# -- batched local exchange --------------------------------------------------
+
+
+class _RecordingDownpour(DownpourMerge):
+    """DownpourMerge that records fold arrival order via a tag leaf."""
+
+    def __init__(self):
+        super().__init__()
+        self.order = []
+
+    def fold(self, center, payload, num_workers, staleness):
+        self.order.append(int(np.asarray(payload["tag"])[0]))
+        return super().fold(center, payload, num_workers, staleness)
+
+
+def test_batched_folds_bitwise_equal_same_order_serial():
+    """The bit-identity oracle: K workers' deltas folded through the
+    batched drain produce a center bitwise EQUAL to folding the same
+    deltas serially in the recorded arrival order."""
+    rng = np.random.default_rng(4)
+    K, N = 4, 12
+    center = {"tag": np.zeros(1, np.float32),
+              "w": rng.normal(size=(257,)).astype(np.float32)}
+    deltas = [
+        {"tag": np.full(1, i, np.float32),
+         "w": rng.normal(size=(257,)).astype(np.float32) * 0.1}
+        for i in range(K)
+    ]
+    rule = _RecordingDownpour()
+    ps = ParameterServer(center, rule, K)
+    barrier = threading.Barrier(K)
+
+    def worker(i):
+        for _ in range(N):
+            barrier.wait()  # maximize contention → real batches form
+            ps.commit(i, deltas[i])
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(K)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(rule.order) == K * N
+    # replay the recorded arrival order serially on a twin
+    twin = ParameterServer(center, DownpourMerge(), K)
+    for tag in rule.order:
+        twin.commit(tag, deltas[tag])
+    assert _tree_equal(ps.get_model(), twin.get_model())
+    assert ps.num_updates == twin.num_updates == K * N
+
+
+def test_batched_drain_folds_k_commits_in_one_acquisition():
+    """Deterministic flat-combining: with the center lock held, K
+    commits queue up; the release lets ONE leader drain all K — the
+    batched_folds stat records K and the lock was acquired once for
+    the whole batch."""
+    K = 4
+    center = {"w": np.zeros(64, np.float32)}
+    ps = ParameterServer(center, DownpourMerge(), K)
+    delta = {"w": np.ones(64, np.float32)}
+    assert ps._lock.acquire()
+    acq_before = ps._lock.acquires
+    threads = [
+        threading.Thread(target=ps.commit, args=(i, delta))
+        for i in range(K)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 10
+        while len(ps._fold_pending) < K and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert len(ps._fold_pending) == K
+    finally:
+        ps._lock.release()
+    for t in threads:
+        t.join(timeout=10)
+    s = ps.stats()
+    assert s["commits"] == K
+    assert s["batched_folds"] == K
+    # one drain acquisition for all K folds (stray re-checks allowed by
+    # the protocol are bounded by the batch, not by K folds)
+    assert ps._lock.acquires - acq_before < K
+    assert np.array_equal(ps.center["w"], np.full(64, K, np.float32))
+
+
+def test_shm_concurrent_stress_four_workers_exact():
+    """4 workers hammering fused exchanges over the rings with integer
+    deltas: the final center is exact (order-independent in integer
+    arithmetic — any fold-order bug shows), counters agree, nothing
+    leaks."""
+    W, N = 4, 20
+    before = set(_dkshm_entries())
+    center = {"w": np.zeros(2048, np.float32)}
+    delta = {"w": np.ones(2048, np.float32)}
+    ps = ShmParameterServer(center, DownpourMerge(), W, ring_bytes=1 << 16)
+    ps.initialize()
+    ps.start()
+    clients = [ShmPSClient(ps, i) for i in range(W)]
+    errors = []
+
+    def worker(i):
+        try:
+            c = clients[i]
+            c.pull()
+            for _ in range(N):
+                out = c.exchange(i, delta)
+                assert float(out["w"][0]) == float(out["w"][-1])
+        except BaseException as e:
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(W)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        s = ps.stats()
+        assert s["commits"] == W * N
+        assert s["fused_exchanges"] == W * N
+        assert s["batched_folds"] >= 0  # host-dependent; key present
+        assert np.array_equal(ps.center["w"],
+                              np.full(2048, W * N, np.float32))
+    finally:
+        for c in clients:
+            c.close()
+        ps.stop()
+    assert set(_dkshm_entries()) <= before
+
+
+# -- native lane parity ------------------------------------------------------
+
+
+def test_native_shm_lane_parity():
+    """The dkps.cpp ring lane: a shm-connected native client speaks the
+    full protocol (pull/commit/exchange/heartbeat/join) and sees the
+    same center as a TCP client of the same server."""
+    from distkeras_tpu.native import load_dkps
+
+    if load_dkps(required=False) is None:
+        pytest.skip("no C++ toolchain")
+    from distkeras_tpu.native_ps import (
+        NativePSClient,
+        NativeSocketParameterServer,
+    )
+
+    before = set(_dkshm_entries())
+    center = {"w": np.zeros(4096, np.float32)}
+    ps = NativeSocketParameterServer(center, DownpourMerge(), 2)
+    ps.initialize()
+    ps.start()
+    try:
+        c = NativePSClient.connect_shm(ps, 0)
+        assert np.array_equal(c.pull()["w"], center["w"])
+        delta = {"w": np.full(4096, 1.5, np.float32)}
+        c.commit(0, delta)
+        out = c.exchange(0, delta, seq=2)
+        assert np.allclose(out["w"], 3.0)
+        assert c.heartbeat() in (True, False)
+        tcp = NativePSClient("127.0.0.1", ps.port, 1, ps.spec)
+        assert np.allclose(tcp.pull()["w"], 3.0)  # one center, two lanes
+        tcp.close()
+        c.close()
+    finally:
+        ps.stop()
+    assert set(_dkshm_entries()) <= before  # native segments unlink too
+
+
+# -- trainer validation matrix ----------------------------------------------
+
+
+def test_shm_transport_validation_matrix():
+    """ps_transport='shm' is colocated-only: ps_host rejected with an
+    actionable error; the standby/chain replication rules keep pointing
+    at socket; the constructor accepts the plain shm config."""
+    import distkeras_tpu as dk
+
+    from tests.test_trainers import model_spec
+
+    def mk(**kw):
+        return dk.DOWNPOUR(model_spec(), backend="ps",
+                           ps_transport="shm", num_workers=1, **kw)
+
+    mk()  # plain shm config is valid
+    mk(ps_num_shards=2)  # sharded shm is valid
+    with pytest.raises(ValueError, match="colocated-only"):
+        mk(ps_host="10.0.0.1")
+    with pytest.raises(ValueError, match="socket"):
+        mk(ps_standby=True)
+    with pytest.raises(ValueError, match="socket"):
+        mk(ps_chain_length=2)
+    with pytest.raises(ValueError, match="socket"):
+        from distkeras_tpu.resilience import FaultPlan
+
+        mk(ps_wal_dir="/tmp/x", fault_plan=FaultPlan(
+            seed=0, kill_ps_after_commits=1))
+    with pytest.raises(ValueError, match="shm"):
+        dk.DOWNPOUR(model_spec(), backend="ps", ps_transport="bogus")
+    # and the server itself refuses replication streams
+    ps = ShmParameterServer({"w": np.zeros(4, np.float32)},
+                            DownpourMerge(), 1)
+    with pytest.raises(NotImplementedError, match="colocated-only"):
+        ps.attach_standby("127.0.0.1", 1)
