@@ -1344,7 +1344,7 @@ def run_ps_shard_bench(n_params=10_000_000, workers=4, seconds=4.0,
 
 def run_ps_exchange_bench(n_params=1_000_000, workers=(2, 4), seconds=2.0,
                           transports=("socket", "native", "shm"),
-                          compute_ms=3.0):
+                          compute_ms=3.0, per_round_extra_s=0.0):
     """Exchange-leg microbenchmark (ISSUE 10 + 12): serial (``commit();
     pull()`` — 2 RTTs) vs fused (one EXCHANGE RTT) vs fused+pipelined
     (the exchange overlapped with the NEXT window's simulated device
@@ -1371,7 +1371,13 @@ def run_ps_exchange_bench(n_params=1_000_000, workers=(2, 4), seconds=2.0,
     straight off ``ps.stats()``. ``host_cores`` rides the record
     (PR 6/7/8 honesty treatment): the fold itself still serializes on a
     1-core host, but the overlap claim targets wire+encode latency, not
-    fold CPU."""
+    fold CPU.
+
+    ``per_round_extra_s`` injects a REAL sleep into every exchange op —
+    the perf-regression guard's self-test seam (ISSUE 13): ``bench.py
+    --regress --regress-slowdown X`` measures a genuinely slowed leg
+    and must flag it against the clean baseline (the same role
+    ``FaultPlan`` plays for the chaos tests: measured, not mocked)."""
     import os as _os
     from concurrent.futures import ThreadPoolExecutor
 
@@ -1436,19 +1442,27 @@ def run_ps_exchange_bench(n_params=1_000_000, workers=(2, 4), seconds=2.0,
                 for c in clients:
                     c.pull()  # prime the staleness bookkeeping
 
+                extra_s = float(per_round_extra_s)
+
                 def serial_op(c, i):
                     time.sleep(compute_s)      # the "device" window
+                    if extra_s:
+                        time.sleep(extra_s)    # --regress slowdown seam
                     c.commit(i, delta)         # RTT 1
                     c.pull()                   # RTT 2
 
                 def fused_op(c, i):
                     time.sleep(compute_s)
+                    if extra_s:
+                        time.sleep(extra_s)
                     c.exchange(i, delta)       # ONE RTT
 
                 def pipelined_op(c, i):
                     # launch the next window on the "device", exchange
                     # the previous one while it runs — the depth-1 loop
                     fut = devices[i].submit(time.sleep, compute_s)
+                    if extra_s:
+                        time.sleep(extra_s)
                     c.exchange(i, delta, lag=True)
                     fut.result()
 
@@ -1531,6 +1545,236 @@ def run_ps_exchange_bench(n_params=1_000_000, workers=(2, 4), seconds=2.0,
                    if k.startswith("shm_vs_socket_")},
             }))
     return out
+
+
+# ---------------------------------------------------------------------------
+# --regress: the perf-regression guard (ISSUE 13) — turn the write-only
+# BENCH_*.json trajectory into an enforced contract
+# ---------------------------------------------------------------------------
+
+#: record keys that are identity/shape, never performance
+_REGRESS_SKIP_KEYS = frozenset({
+    "config", "metric", "unit", "workers", "params", "batch",
+    "batch_size", "host_cores", "seq_len", "dim", "heads", "depth",
+    "vocab", "new_tokens", "kv_heads", "window", "compute_ms", "epochs",
+    "num_workers", "trace_path", "invalid", "via", "fused_ce", "remat",
+    "n", "epoch", "target", "reached_target",
+})
+
+
+def metric_direction(key, record=None):
+    """Which way is better for this metric key: ``"higher"``,
+    ``"lower"``, or ``None`` (not a performance metric — skipped). The
+    trajectory's ``value`` headline counts as a rate only when its
+    record says so (``unit`` contains ``/sec``)."""
+    k = str(key).lower()
+    if k in _REGRESS_SKIP_KEYS:
+        return None
+    if k == "value":
+        unit = str((record or {}).get("unit", ""))
+        return "higher" if "/sec" in unit else None
+    if ("per_sec" in k or k.endswith("_rps") or k.startswith("speedup")
+            or k in ("mfu", "spread", "acceptance", "spec_acceptance",
+                     "bound_fraction", "host_ceiling_x")):
+        # spread/acceptance-style ratios: bigger is better or neutral —
+        # judged higher-better so a collapse is visible
+        return "higher"
+    if (k.endswith(("_ms", "_seconds", "_s")) or k.startswith("ms_")
+            or k in ("ms_per_step", "wall_time", "tta_99_seconds")):
+        return "lower"
+    return None
+
+
+def load_trajectory(glob_pat="BENCH_*.json", root="."):
+    """Parse the checked-in BENCH_*.json trajectory into a flat record
+    list. Each trajectory file is a driver capture ``{"parsed": <last
+    stdout JSON>, "tail": <stdout/stderr tail>, ...}`` — every JSON
+    object line in the tail is a per-config record too, so one capture
+    contributes the whole visible history, not just the headline.
+    Records flagged ``invalid`` are dropped (they flagged themselves)."""
+    import glob as _glob
+
+    records = []
+    files = sorted(_glob.glob(os.path.join(root, glob_pat)))
+    for path in files:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        seen = set()
+        cands = []
+        if isinstance(doc.get("parsed"), dict):
+            cands.append(doc["parsed"])
+        for line in str(doc.get("tail", "")).splitlines():
+            line = line.strip()
+            if line.startswith("{") and line.endswith("}"):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    cands.append(rec)
+        for rec in cands:
+            ident = json.dumps(rec, sort_keys=True)
+            if ident in seen:
+                continue  # parsed usually repeats the last tail line
+            seen.add(ident)
+            if rec.get("invalid"):
+                continue
+            rec = dict(rec)
+            rec["_file"] = os.path.basename(path)
+            records.append(rec)
+    return files, records
+
+
+def _record_config(rec):
+    return rec.get("config") or rec.get("metric")
+
+
+def compare_to_trajectory(current_records, baseline_records,
+                          rel_slack=0.12, spread_mult=3.0,
+                          min_samples=2, host_cores=None):
+    """Noise-aware comparison of freshly measured records against a
+    trajectory. For every performance metric on every current record,
+    the baseline pool is the trajectory records with the SAME config
+    and a compatible ``host_cores`` (a number measured on a different
+    core count is not a baseline — the PR 6-12 honesty rule); the
+    verdict is against ``median(pool)`` with a tolerance of
+    ``max(rel_slack × |median|, spread_mult × MAD)`` — the measured
+    spread decides how much regression is noise. Metrics without
+    ``min_samples`` baselines report ``no_baseline`` (the trajectory
+    starts HERE — the next run has a contract), never a failure."""
+    checks = []
+    for cur in current_records:
+        cfg = _record_config(cur)
+        if cfg is None:
+            continue
+        pool = [r for r in baseline_records if _record_config(r) == cfg]
+        for key in sorted(cur):
+            direction = metric_direction(key, cur)
+            if direction is None:
+                continue
+            val = cur.get(key)
+            if not isinstance(val, (int, float)):
+                continue
+            samples, host_skipped = [], 0
+            for r in pool:
+                s = r.get(key)
+                if not isinstance(s, (int, float)):
+                    continue
+                hc = r.get("host_cores")
+                if (host_cores is not None and hc is not None
+                        and int(hc) != int(host_cores)):
+                    host_skipped += 1
+                    continue
+                samples.append(float(s))
+            check = {"config": cfg, "key": key, "direction": direction,
+                     "current": float(val), "n_baseline": len(samples),
+                     "host_skipped": host_skipped}
+            if len(samples) < min_samples:
+                check["status"] = "no_baseline"
+                checks.append(check)
+                continue
+            med = float(np.median(samples))
+            mad = float(np.median(np.abs(np.asarray(samples) - med)))
+            tol = max(rel_slack * abs(med), spread_mult * mad)
+            delta = (float(val) - med if direction == "higher"
+                     else med - float(val))   # negative == worse
+            check.update({
+                "baseline_median": med, "baseline_mad": mad,
+                "tolerance": tol,
+                "delta_frac": (float(val) - med) / med if med else 0.0,
+            })
+            check["status"] = ("regression" if delta < -tol else "ok")
+            checks.append(check)
+    n_reg = sum(1 for c in checks if c["status"] == "regression")
+    return {
+        "checks": checks,
+        "regressions": n_reg,
+        "verdict": "regression" if n_reg else "ok",
+    }
+
+
+def run_regress_bench(repeats=2, seconds=1.0, n_params=200_000,
+                      compute_ms=3.0, slowdown=0.0,
+                      glob_pat="BENCH_*.json", root=".",
+                      rel_slack=0.12, spread_mult=3.0):
+    """``--regress``: measure the exchange leg now, compare against the
+    BENCH_*.json trajectory + this invocation's own clean repeats, and
+    return a verdict record (the stdout blob; CI fails the build on
+    ``verdict != "ok"``).
+
+    The baseline pool is trajectory history PLUS ``repeats`` fresh clean
+    runs: the historical files carry no exchange records yet (they
+    predate this guard), so the clean repeats SEED the contract — with
+    their run-to-run spread measured, not assumed — and every future
+    BENCH capture of a ``--regress`` run grows the historical pool.
+    ``slowdown`` (the self-test seam) injects a real per-round sleep of
+    that fraction of the clean fused round time into the FINAL measured
+    run only: ``--regress-slowdown 0.25`` must come back flagged, and
+    an unmodified HEAD must come back ``ok``."""
+    import os as _os
+
+    host_cores = _os.cpu_count() or 1
+    files, trajectory = load_trajectory(glob_pat, root)
+    log(f"[regress] trajectory: {len(trajectory)} records from "
+        f"{len(files)} files ({glob_pat})")
+
+    def one_exchange_run(extra_s=0.0):
+        out = run_ps_exchange_bench(
+            n_params=n_params, workers=(2,), seconds=seconds,
+            transports=("socket",), compute_ms=compute_ms,
+            per_round_extra_s=extra_s,
+        )
+        return out["ps_exchange_socket_w2"]
+
+    clean = []
+    for k in range(max(1, int(repeats))):
+        log(f"[regress] clean repeat {k + 1}/{repeats}")
+        clean.append(one_exchange_run())
+    extra_s = 0.0
+    if slowdown:
+        fused_med = float(np.median(
+            [r["fused_rounds_per_sec"] for r in clean]
+        ))
+        extra_s = float(slowdown) / max(fused_med, 1e-9)
+        log(f"[regress] injecting {extra_s * 1e3:.2f} ms/round synthetic "
+            f"slowdown (fraction {slowdown} of the clean fused round)")
+    current = one_exchange_run(extra_s)
+    report = compare_to_trajectory(
+        [current], trajectory + clean,
+        rel_slack=rel_slack, spread_mult=spread_mult,
+        host_cores=host_cores,
+    )
+    # coverage honesty: trajectory families this invocation did NOT
+    # re-measure are named, not silently skipped
+    measured = {_record_config(current)}
+    unmeasured = sorted({
+        c for r in trajectory
+        if (c := _record_config(r)) is not None and c not in measured
+    })
+    rec = {
+        "config": "bench_regress",
+        "verdict": report["verdict"],
+        "regressions": report["regressions"],
+        "checks": report["checks"],
+        "repeats": len(clean),
+        "slowdown_injected": float(slowdown),
+        "seconds_per_phase": seconds,
+        "params": n_params,
+        "host_cores": host_cores,
+        "trajectory_files": len(files),
+        "trajectory_records": len(trajectory),
+        "trajectory_configs_not_measured": unmeasured,
+        "rel_slack": rel_slack,
+        "spread_mult": spread_mult,
+    }
+    for c in report["checks"]:
+        log(json.dumps({"regress_check": c}))
+    log(f"[regress] verdict: {rec['verdict']} "
+        f"({rec['regressions']} regression(s))")
+    return rec
 
 
 def run_ps_chaos_bench(n_params=1_000_000, workers=4, seconds=4.0,
@@ -2436,7 +2680,43 @@ def main():
                          "write one Perfetto-loadable Chrome trace JSON "
                          "here; each leg's record (and the headline "
                          "blob) carries its path as trace_path")
+    ap.add_argument("--regress", action="store_true",
+                    help="perf-regression guard (ISSUE 13): measure the "
+                         "PS exchange leg now and compare against the "
+                         "checked-in BENCH_*.json trajectory plus this "
+                         "invocation's own clean repeats (median ± "
+                         "measured spread, host_cores-honest); exits "
+                         "nonzero on a regression so CI fails the build")
+    ap.add_argument("--regress-repeats", type=int, default=2,
+                    help="clean baseline repeats seeding the contract")
+    ap.add_argument("--regress-seconds", type=float, default=1.0,
+                    help="seconds per measured exchange phase")
+    ap.add_argument("--regress-params", type=int, default=200_000,
+                    help="exchange-leg tree size in float32 params")
+    ap.add_argument("--regress-slowdown", type=float, default=0.0,
+                    help="self-test seam: inject a real per-round sleep "
+                         "of this fraction of the clean fused round "
+                         "into the final measured run (0.25 must be "
+                         "flagged)")
+    ap.add_argument("--regress-glob", default="BENCH_*.json",
+                    help="trajectory file glob (repo root)")
     args = ap.parse_args()
+
+    if args.regress:
+        # guard mode: measure → compare → ONE stdout verdict blob, exit
+        # nonzero on regression (the CI contract). Stays ahead of every
+        # other leg: a guard must be cheap enough to run per-commit.
+        rec = run_regress_bench(
+            repeats=args.regress_repeats,
+            seconds=args.regress_seconds,
+            n_params=args.regress_params,
+            slowdown=args.regress_slowdown,
+            glob_pat=args.regress_glob,
+            root=os.path.dirname(os.path.abspath(__file__)),
+        )
+        print(json.dumps(rec))
+        sys.stdout.flush()
+        sys.exit(1 if rec["verdict"] != "ok" else 0)
 
     if args.trace_dir:
         from distkeras_tpu.observability import trace as _obs_trace

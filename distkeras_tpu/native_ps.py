@@ -357,12 +357,15 @@ class NativeSocketParameterServer:
             except BufferError:
                 pass
             seg.unlink()
+            _shm.unregister_segment(seg.name)
             raise OSError("dkps_server_attach_shm failed (server stopped "
                           "or channel table full)")
         self._shm_segments.append(seg)
         return seg
 
     def _release_shm_segments(self) -> None:
+        from distkeras_tpu import shm as _shm
+
         segs, self._shm_segments = self._shm_segments, []
         for seg in segs:
             try:
@@ -373,6 +376,7 @@ class NativeSocketParameterServer:
                 seg.unlink()
             except FileNotFoundError:
                 pass
+            _shm.unregister_segment(seg.name)
 
     def __del__(self):
         if getattr(self, "_handle", None) is not None:

@@ -15,25 +15,50 @@ SLO-aware scheduling, shm transport) are debugged against:
   via the ``metrics`` wire action on ``SocketParameterServer`` and
   ``GenerationServer``, plus the single-document
   :func:`~distkeras_tpu.observability.metrics.health_snapshot`.
+- :mod:`distkeras_tpu.observability.timeseries` — the embedded
+  time-series store (fixed-capacity downsampling ring series) and the
+  background :class:`~distkeras_tpu.observability.timeseries.Scraper`
+  sampling the PR 11 metrics surface into series over time.
+- :mod:`distkeras_tpu.observability.watch` — the watchtower (ISSUE 13):
+  declarative typed alert rules (τ p95, commit-rate skew, dup/fenced
+  spikes, WAL fsync tails, shm ring occupancy, per-class serving SLO,
+  loss-slope convergence stall) evaluated over those series, plus the
+  ONE shared definition of rounds/s + straggler ratio that
+  ``ElasticPolicy`` reads too.
 - ``python -m distkeras_tpu.observability`` — ``dump`` / ``tail`` a
-  live server's metrics, or emit the ``health`` snapshot.
+  live server's metrics, emit the ``health`` snapshot, or ``health
+  --watch`` a live server's alert transitions.
 
 Trainer knobs: ``trace=True`` (enable), ``trace_dir=`` (write the
 timeline file, path lands in ``trainer.trace_path_``),
-``trace_sample=`` (deterministic span sampling). ``bench.py`` legs take
-``--trace-dir`` and record ``trace_path`` in their stdout JSON.
+``trace_sample=`` (deterministic span sampling); ``watch=True`` /
+``watch_rules=`` / ``watch_dir=`` / ``scrape_interval=`` /
+``watch_hook=`` run the watchtower over a training run (alerts land in
+``trainer.watch_alerts_``, the dump path in ``trainer.watch_path_``).
+``bench.py`` legs take ``--trace-dir`` and record ``trace_path`` in
+their stdout JSON; ``bench.py --regress`` is the trajectory-enforcing
+perf-regression guard.
 """
 
-from distkeras_tpu.observability import trace
+from distkeras_tpu.observability import timeseries, trace, watch
 from distkeras_tpu.observability.metrics import (
     MetricsRegistry,
     health_snapshot,
     phase_metrics,
     ps_metrics,
     serving_metrics,
+    trace_metrics,
+)
+from distkeras_tpu.observability.timeseries import Scraper, TimeSeriesStore
+from distkeras_tpu.observability.watch import (
+    Watchdog,
+    Watchtower,
+    default_rules,
 )
 
 __all__ = [
-    "trace", "MetricsRegistry", "ps_metrics", "serving_metrics",
-    "phase_metrics", "health_snapshot",
+    "trace", "timeseries", "watch", "MetricsRegistry", "ps_metrics",
+    "serving_metrics", "phase_metrics", "trace_metrics",
+    "health_snapshot", "TimeSeriesStore", "Scraper", "Watchdog",
+    "Watchtower", "default_rules",
 ]

@@ -6,15 +6,24 @@ Usage::
     python -m distkeras_tpu.observability tail --host H --port P \\
         [--interval 2] [--count 0]
     python -m distkeras_tpu.observability health [--wal-dir DIR] \\
-        [--host H --port P]
+        [--host H --port P] [--watch [--interval 2] [--count 0]]
 
 ``dump``/``tail`` speak the ``metrics`` wire action both the
 ``SocketParameterServer`` and the ``GenerationServer`` serve (the framed
 restricted-pickle protocol — ``networking.py``), printing the JSON
 snapshot by default or the Prometheus text exposition with ``--prom``.
 ``health`` folds WAL health (``resilience.wal.verify_tree``), metrics,
-and membership into ONE JSON document (exit code 1 when unhealthy) —
-the artifact CI uploads instead of three separate ad-hoc dumps.
+membership, the trace-overflow counter, and the live shm segment
+inventory into ONE JSON document (exit code 1 when unhealthy) — the
+artifact CI uploads instead of three separate ad-hoc dumps.
+
+``health --watch`` (ISSUE 13) polls a live server's ``metrics`` action
+on ``--interval`` and prints alert TRANSITIONS as JSON lines: the
+scraped counters feed the same time-series store and watchdog rules the
+in-process watchtower runs (observability/watch.py), and any alert
+ledger the server itself carries (a trainer-attached watchtower) is
+relayed with ``"remote": true``. ``--count N`` stops after N polls
+(0 = forever); the exit code is 1 when any alert is still firing.
 """
 
 from __future__ import annotations
@@ -72,6 +81,24 @@ def _cmd_tail(args) -> int:
 
 def _cmd_health(args) -> int:
     from distkeras_tpu.observability.metrics import health_snapshot
+
+    if args.watch:
+        if args.host is None or args.port is None:
+            raise SystemExit("health --watch needs --host/--port")
+        from distkeras_tpu.observability.watch import watch_endpoint
+
+        def emit(alert: dict) -> None:
+            print(json.dumps({"t_unix_s": time.time(), **alert}))
+            sys.stdout.flush()
+
+        dog = watch_endpoint(
+            lambda: _scrape(args.host, args.port),
+            interval=args.interval, count=args.count, emit=emit,
+        )
+        # a firing alert counts wherever it lives: locally derived from
+        # the scraped counters, OR in the server-side ledger (rules the
+        # remote scrape cannot reconstruct — τ ring, shm occupancy)
+        return 1 if dog.active or dog.remote_active else 0
 
     stats = None
     if args.host is not None:
@@ -131,11 +158,19 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser(
         "health",
-        help="one JSON health document: WAL + metrics + membership",
+        help="one JSON health document: WAL + metrics + membership "
+             "(+ --watch: live alert-transition tail)",
     )
     p.add_argument("--wal-dir", default=None,
                    help="WAL directory or sharded root to verify")
     _net(p, required=False)
+    p.add_argument("--watch", action="store_true",
+                   help="poll the server's metrics action and print "
+                        "alert transitions (same watchdog rules as the "
+                        "in-process watchtower)")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--count", type=int, default=0,
+                   help="stop after N polls (0 = forever)")
     p.set_defaults(fn=_cmd_health)
 
     args = ap.parse_args(argv)

@@ -30,7 +30,8 @@ from typing import Any, Iterable
 
 __all__ = [
     "Metric", "MetricsRegistry", "ps_metrics", "serving_metrics",
-    "wal_metrics", "phase_metrics", "health_snapshot",
+    "wal_metrics", "phase_metrics", "trace_metrics", "health_snapshot",
+    "wire_series_samples", "metrics_reply",
 ]
 
 _KINDS = ("counter", "gauge", "histogram")
@@ -304,14 +305,127 @@ def ps_metrics(stats: dict, labels: dict | None = None,
     return reg
 
 
+#: serving latency-summary keys (per SLO class, from the engine's
+#: retired-request ring) → gauge names; the class rides as a label
+_SERVE_LATENCY_KEYS: tuple[tuple[str, str, str], ...] = (
+    ("p50_ms", "dk_serve_latency_p50_ms",
+     "median end-to-end request latency (ms)"),
+    ("p99_ms", "dk_serve_latency_p99_ms",
+     "p99 end-to-end request latency (ms)"),
+    ("queue_ms", "dk_serve_latency_queue_ms",
+     "mean admission-queue wait (ms)"),
+    ("prefill_ms", "dk_serve_latency_prefill_ms",
+     "mean prefill time (ms)"),
+    ("decode_ms", "dk_serve_latency_decode_ms",
+     "mean decode time (ms)"),
+)
+
+
 def serving_metrics(stats: dict, labels: dict | None = None,
                     registry: MetricsRegistry | None = None,
                     ) -> MetricsRegistry:
     """Normalize a ``GenerationServer.stats()`` /
-    ``GenerationEngine.stats()`` dict."""
+    ``GenerationEngine.stats()`` dict — including the per-SLO-class
+    latency summary (``stats["latency"]``), which fans out into
+    ``class``-labeled gauges."""
     reg = registry if registry is not None else MetricsRegistry()
     _apply_schema(reg, _SERVING_SCHEMA, stats, labels)
+    for cls, rec in (stats.get("latency") or {}).items():
+        lbl = dict(labels or {})
+        lbl["class"] = str(cls)
+        for key, name, help_ in _SERVE_LATENCY_KEYS:
+            if key in rec:
+                reg.gauge(name, rec[key], lbl, help_)
+        if "count" in rec:
+            # a gauge, not a counter: the count is of records currently
+            # inside a bounded ring — eviction can shrink a class's
+            # count, and Prometheus rate() over a "counter" would read
+            # that dip as a reset spike
+            reg.gauge("dk_serve_latency_observations",
+                      rec["count"], lbl,
+                      "retired requests behind the latency summary "
+                      "(bounded-ring occupancy, not a lifetime total)")
     return reg
+
+
+def trace_metrics(registry: MetricsRegistry | None = None,
+                  labels: dict | None = None) -> MetricsRegistry:
+    """The flight recorder's own health as metrics: whether tracing is
+    on and — the previously-silent signal — how many spans the
+    drop-oldest ring overflow discarded (``trace_dropped_spans``). Zero
+    dropped means the timeline is complete; anything else says which
+    runs need a bigger ``ring_size``."""
+    from distkeras_tpu.observability import trace
+
+    reg = registry if registry is not None else MetricsRegistry()
+    enabled = trace.enabled()
+    reg.gauge("dk_trace_enabled", int(enabled), labels,
+              "flight recorder on (1) / off (0)")
+    reg.counter("dk_trace_dropped_spans_total",
+                trace.dropped_spans(), labels,
+                "spans lost to ring-buffer overflow (drop-oldest)")
+    return reg
+
+
+def metrics_reply(registry: MetricsRegistry, watchtower=None) -> dict:
+    """Build THE ``metrics`` wire-action reply — the one shape every
+    server (socket PS, shm PS, generation server) sends, so the wire
+    surfaces cannot drift: the registry (with the flight recorder's
+    overflow counter folded in) as JSON + Prometheus text, plus the
+    alert ledger when a watchtower is attached."""
+    trace_metrics(registry=registry)
+    reply = {
+        "ok": True, "metrics": registry.to_json(),
+        "prom": registry.to_prometheus(),
+    }
+    if watchtower is not None:
+        reply["alerts"] = watchtower.alerts_json()
+    return reply
+
+
+#: wire metric name → (series name, series kind): the inverse of the
+#: schemas above, so a REMOTE scrape of the ``metrics`` action feeds
+#: the same series names the in-process sources use and the watchdog
+#: rules run unchanged (observability/watch.py ``watch_endpoint``).
+_WIRE_TO_SERIES: dict[str, tuple[str, str]] = {
+    name: (f"ps.{key}", "counter" if kind == "counter" else "gauge")
+    for key, name, kind, _ in _PS_SCHEMA
+}
+_WIRE_TO_SERIES.update({
+    name: (f"serve.{key}", "counter" if kind == "counter" else "gauge")
+    for key, name, kind, _ in _SERVING_SCHEMA
+})
+_WIRE_LATENCY_TO_SERIES: dict[str, str] = {
+    name: key for key, name, _ in _SERVE_LATENCY_KEYS
+}
+
+
+def wire_series_samples(metrics_json: dict):
+    """Yield ``(series_name, kind, value)`` for every recognizable
+    sample in a ``metrics`` wire reply's JSON snapshot. Shard-labeled
+    PS samples land under ``ps.shard<id>.<key>``; class-labeled serving
+    latency gauges under ``serve.lat.<class>.<key>`` — the exact names
+    the in-process sources write."""
+    for name, doc in (metrics_json or {}).items():
+        for s in doc.get("samples", ()):
+            value = s.get("value")
+            if not isinstance(value, (int, float)):
+                continue
+            lbl = s.get("labels") or {}
+            if name in _WIRE_LATENCY_TO_SERIES and "class" in lbl:
+                yield (f"serve.lat.{lbl['class']}."
+                       f"{_WIRE_LATENCY_TO_SERIES[name]}",
+                       "gauge", value)
+                continue
+            mapped = _WIRE_TO_SERIES.get(name)
+            if mapped is None:
+                continue
+            series, kind = mapped
+            if "shard" in lbl:
+                base = series[len("ps."):]
+                yield f"ps.shard{lbl['shard']}.{base}", kind, value
+            elif not lbl:
+                yield series, kind, value
 
 
 def phase_metrics(phases: dict, labels: dict | None = None,
@@ -351,14 +465,18 @@ _MEMBERSHIP_KEYS = (
 
 def health_snapshot(wal_root: str | None = None,
                     ps_stats: dict | None = None,
-                    serving_stats: dict | None = None) -> dict:
+                    serving_stats: dict | None = None,
+                    watchtower=None) -> dict:
     """ONE JSON health document: WAL health (``verify_tree`` — CRC-valid
     prefixes, torn tails, record totals), the normalized metrics
-    snapshot, and the membership gauges — replacing the three separate
-    ad-hoc dumps (wal-verify JSON, raw ``ps.stats()``, elastic
-    membership counters) that CI and the chaos tests used to collect
+    snapshot, the membership gauges, the flight recorder's overflow
+    counter, the live shm segment inventory, and — when a
+    :class:`~distkeras_tpu.observability.watch.Watchtower` (or a
+    watchdog / pre-built alert ledger) is passed — the alert ledger.
+    Replaces the separate ad-hoc dumps CI used to collect
     independently. Every section is optional; ``ok`` is the AND of the
-    sections that can fail."""
+    sections that can fail (an ACTIVE alert fails it — that is what an
+    alert is for)."""
     out: dict = {"ok": True, "generated_unix_s": time.time()}
     if wal_root is not None:
         from distkeras_tpu.resilience.wal import verify_tree
@@ -376,6 +494,23 @@ def health_snapshot(wal_root: str | None = None,
     if serving_stats is not None:
         serving_metrics(serving_stats, registry=reg)
         out["serving_stats"] = _json_clean(serving_stats)
+    # the flight recorder's overflow is otherwise silent (satellite):
+    # a truncated timeline must be visible as a number, not a surprise
+    from distkeras_tpu.observability import trace
+
+    out["trace"] = {"enabled": trace.enabled(),
+                    "dropped_spans": trace.dropped_spans()}
+    trace_metrics(registry=reg)
+    # live /dev/shm segment inventory (satellite): the no-leak property
+    # operator-visible — an empty list after a run IS the proof
+    from distkeras_tpu import shm as _shm
+
+    out["shm"] = _shm.segment_inventory()
+    if watchtower is not None:
+        alerts = (watchtower.alerts_json()
+                  if hasattr(watchtower, "alerts_json") else watchtower)
+        out["alerts"] = _json_clean(alerts)
+        out["ok"] = out["ok"] and not alerts.get("active")
     if len(reg):
         out["metrics"] = reg.to_json()
     return out

@@ -51,11 +51,18 @@ from typing import Any
 __all__ = [
     "enable", "disable", "enabled", "span", "record", "instant",
     "set_corr", "current_corr", "add_events", "events", "save",
+    "dropped_spans",
 ]
 
 #: module-global tracer; ``None`` = disabled (the one read every
 #: call-site pays when tracing is off)
 _tracer = None
+
+#: spans lost to ring overflow by recorders that have since been
+#: disabled — ``dropped_spans()`` stays a process-lifetime counter so
+#: the metrics surface never un-counts an overflow by turning tracing
+#: off (the overflow being SILENT was the bug)
+_dropped_retired = 0
 
 
 class _NoopSpan:
@@ -209,9 +216,22 @@ def enable(ring_size: int = 65536, sample: float = 1.0) -> Tracer:
 
 def disable() -> None:
     """Turn tracing off and discard the recorder (hot paths return to
-    the one-global-read no-op)."""
-    global _tracer
+    the one-global-read no-op). The recorder's overflow count retires
+    into the process-lifetime ``dropped_spans()`` counter first."""
+    global _tracer, _dropped_retired
+    if _tracer is not None:
+        _dropped_retired += _tracer.dropped()
     _tracer = None
+
+
+def dropped_spans() -> int:
+    """Process-lifetime spans lost to ring overflow (drop-oldest),
+    across every recorder this process has run — the
+    ``trace_dropped_spans`` counter on the metrics surface. 0 while
+    nothing ever overflowed; monotone otherwise."""
+    tr = _tracer
+    live = tr.dropped() if tr is not None else 0
+    return _dropped_retired + live
 
 
 def span(name: str, cat: str = "", corr: str | None = None,

@@ -43,6 +43,7 @@ lock wait/hold ns) — the same counter set ``native/dkps.cpp`` tracks.
 
 from __future__ import annotations
 
+import collections
 import pickle
 import threading
 import time
@@ -370,6 +371,14 @@ class ParameterServer:
         # count (a poll-based kill can miss a fast run entirely), and
         # mid-service, so in-flight ACKs tear exactly like a real kill.
         self.post_commit_hook = None
+        # Continuous observability (ISSUE 13): a bounded ring of recent
+        # per-commit DynSGD τ samples (appended under the center lock —
+        # one O(1) deque append per fold), read by the watchtower's
+        # scraper into the ps.tau_p95 series; and the watchtower itself
+        # when a trainer/operator attaches one — the `metrics` wire
+        # action then carries the alert ledger to remote scrapers.
+        self._tau_recent: collections.deque = collections.deque(maxlen=512)
+        self.watchtower = None
         # shard-map handshake record (distkeras_tpu/sharding): when this
         # server holds ONE SHARD of a partitioned center, the group sets
         # {"shard_id", "num_shards", "ring"} here; ping and the
@@ -881,6 +890,7 @@ class ParameterServer:
                 else:
                     pull_version = self._pull_versions.get(worker_id, 0)
                 staleness = self.num_updates - pull_version
+                self._tau_recent.append(int(staleness))
                 self.center = utils.tree_to_numpy(
                     self.rule.fold(
                         self.center, work.payload, self.num_workers,
@@ -1276,7 +1286,16 @@ class ParameterServer:
             self._n_fused += fused
             self._n_batched_folds += batched_folds
 
-    def stats(self) -> dict:
+    def recent_staleness(self) -> list[int]:
+        """Snapshot of the recent per-commit DynSGD τ ring (newest last)
+        — the watchtower samples its p95 into ``ps.tau_p95``. Lock-free
+        read racing the fold path's appends (the shared retry-on-mutate
+        snapshot helper: a telemetry read must never fail the scrape)."""
+        from distkeras_tpu.observability.timeseries import snapshot_deque
+
+        return snapshot_deque(self._tau_recent)
+
+    def stats(self, settle: bool = True) -> dict:
         """Contention + throughput counters (cheap, approximate under load).
 
         Keys (the native PS exposes the identical set — parity pinned by
@@ -1302,8 +1321,14 @@ class ParameterServer:
           ``joined_workers`` / ``preempted_workers`` (lifetime join /
           drain totals), ``drain_timeouts`` (drains whose deadline
           lapsed into the force-drain path).
+
+        ``settle=False`` skips the delivered-traffic settling barrier —
+        the watchtower's periodic scrape must OBSERVE the run, not
+        synchronize with its in-flight replies (end-of-run reads keep
+        the default exactness).
         """
-        self._settle_stats()
+        if settle:
+            self._settle_stats()
         elapsed = time.monotonic() - self._t_start
         with self._stats_lock:
             pulls = self._n_pulls
@@ -1613,18 +1638,19 @@ class SocketParameterServer(ParameterServer):
                         conn, {"ok": True, "stats": self.stats()}
                     )
                 elif action == "metrics":
-                    # the unified metrics surface (ISSUE 11): the same
-                    # settled counters normalized into typed metrics,
-                    # as a JSON snapshot + Prometheus text exposition
+                    # the unified metrics surface (ISSUE 11/13): the
+                    # settled counters normalized into typed metrics
+                    # (plus the flight recorder's overflow counter), as
+                    # a JSON snapshot + Prometheus text exposition —
+                    # and, with a watchtower attached, the alert ledger
                     from distkeras_tpu.observability.metrics import (
+                        metrics_reply,
                         ps_metrics,
                     )
 
-                    reg = ps_metrics(self.stats())
-                    networking.send_data(conn, {
-                        "ok": True, "metrics": reg.to_json(),
-                        "prom": reg.to_prometheus(),
-                    })
+                    networking.send_data(conn, metrics_reply(
+                        ps_metrics(self.stats()), self.watchtower,
+                    ))
                 elif action == "replicate_stream":
                     # hot-standby replication (StandbySocketParameterServer
                     # overrides; a primary politely refuses)

@@ -237,7 +237,15 @@ class ElasticPolicy:
 
     ``observe(now, per_worker_windows)`` is fed the pool's cumulative
     per-worker window counts; it differentiates against the previous
-    observation and returns at most one action per call:
+    observation and returns at most one action per call. Since ISSUE 13
+    the rounds/s and straggler math is NOT private: differentiation is
+    :func:`observability.watch.rates_from_counts` and the straggler
+    verdict :func:`observability.watch.straggler_workers` — the same two
+    definitions the watchtower's commit-skew alert evaluates over the
+    shared ``worker.<wid>.windows`` series, and
+    :meth:`observe_series` reads its rates straight off that store (the
+    path the :class:`ElasticCoordinator` drives), so the autoscaler and
+    the alerting can never disagree about who is slow. Actions:
 
     - ``("join", None)`` — total rounds/s fell below
       ``grow_margin × target`` with headroom under ``max_workers``;
@@ -260,7 +268,7 @@ class ElasticPolicy:
                  min_workers: int = 1, max_workers: int | None = None,
                  grow_margin: float = 0.85, shrink_margin: float = 1.3,
                  straggler_ratio: float = 0.25, patience: int = 3,
-                 cooldown_s: float = 2.0):
+                 cooldown_s: float = 2.0, window_s: float = 1.0):
         if target_rounds_per_sec is not None and target_rounds_per_sec <= 0:
             raise ValueError(
                 f"target_rounds_per_sec must be positive, got "
@@ -283,6 +291,12 @@ class ElasticPolicy:
         self.straggler_ratio = float(straggler_ratio)
         self.patience = int(patience)
         self.cooldown_s = float(cooldown_s)
+        # trailing-window length for the shared-timeseries observation
+        # path (observe_series): long enough for >= 2 scrape samples at
+        # the coordinator's poll cadence, short enough to track churn
+        if window_s <= 0:
+            raise ValueError(f"window_s must be positive, got {window_s}")
+        self.window_s = float(window_s)
         self._last: tuple[float, dict[int, int]] | None = None
         self._lag: dict[int, int] = {}
         self._last_action_t = -float("inf")
@@ -290,26 +304,54 @@ class ElasticPolicy:
 
     def observe(self, now: float,
                 per_worker_windows: dict[int, int]) -> list[tuple]:
+        from distkeras_tpu.observability.watch import rates_from_counts
+
         if self._last is None:
             self._last = (float(now), dict(per_worker_windows))
             return []
         t0, prev = self._last
-        dt = float(now) - t0
         self._last = (float(now), dict(per_worker_windows))
-        if dt <= 0:
+        rates = rates_from_counts(t0, prev, now, per_worker_windows)
+        if not rates:
             return []
-        rates = {
-            wid: max(0, n - prev.get(wid, 0)) / dt
-            for wid, n in per_worker_windows.items()
-        }
+        return self._decide(now, rates)
+
+    def observe_series(self, store, now: float,
+                       window_s: float | None = None,
+                       wids=None) -> list[tuple]:
+        """Observe off the SHARED timeseries: per-worker rounds/s read
+        from the ``worker.<wid>.windows`` counter series (the store the
+        coordinator's progress sampling feeds and the watchtower's skew
+        rule evaluates) over the trailing window — the single-definition
+        path ``ElasticCoordinator.run`` drives. ``wids`` restricts to
+        the currently-live pool (a drained worker's series lingers for
+        one window; it must not be re-released)."""
+        from distkeras_tpu.observability.watch import worker_rates
+
+        if window_s is None:
+            window_s = self.window_s
+        rates = worker_rates(store, window_s, float(now))
+        if wids is not None:
+            live = set(wids)
+            rates = {w: r for w, r in rates.items() if w in live}
+        if not rates:
+            return []
+        return self._decide(now, rates)
+
+    def _decide(self, now: float, rates: dict) -> list[tuple]:
+        """The decision body, shared by both observation paths."""
+        from distkeras_tpu.observability.watch import straggler_workers
+
         pool = len(rates)
         total = sum(rates.values())
         # straggler bookkeeping runs every observation (cooldown or not):
         # patience counts consecutive slow WINDOWS of observation
         if pool >= 2:
-            med = float(np.median(list(rates.values())))
-            for wid, r in rates.items():
-                if med > 0 and r < self.straggler_ratio * med:
+            _med, lagging = straggler_workers(rates,
+                                              self.straggler_ratio)
+            lag_set = set(lagging)
+            for wid in rates:
+                if wid in lag_set:
                     self._lag[wid] = self._lag.get(wid, 0) + 1
                 else:
                     self._lag.pop(wid, None)
@@ -367,8 +409,21 @@ class ElasticCoordinator:
                  make_drain_client: Callable[[int], Any] | None = None,
                  fault_plan=None, policy: ElasticPolicy | None = None,
                  drain_timeout: float = 5.0, poll_interval: float = 0.1,
-                 max_pool_size: int | None = None):
+                 max_pool_size: int | None = None, store=None):
         self.assigner = assigner
+        # the SHARED progress timeseries (ISSUE 13): every poll samples
+        # live workers' cumulative window counts into
+        # ``worker.<wid>.windows``, and the policy observes rates off
+        # those series — the same store/series the watchtower's
+        # commit-skew rule reads when the trainer runs with watch=True
+        # (pass its store in), so there is ONE definition of rounds/s.
+        if store is None and policy is not None:
+            from distkeras_tpu.observability.timeseries import (
+                TimeSeriesStore,
+            )
+
+            store = TimeSeriesStore()
+        self.store = store
         self._spawn = spawn
         self._make_drain_client = make_drain_client
         self.fault_plan = fault_plan
@@ -570,15 +625,31 @@ class ElasticCoordinator:
                      if t.is_alive() and wid not in abandoned]
             if not alive and not draining:
                 break
-            if self.policy is not None:
-                progress = self._live_progress()
-                if progress:
-                    for action, wid in self.policy.observe(
-                            time.monotonic(), progress):
-                        if action == "join":
-                            self.request_join(reason="autoscaler")
-                        elif action == "release":
-                            self.request_preempt(wid, reason="autoscaler")
+            now = time.monotonic()
+            progress = (self._live_progress()
+                        if self.store is not None or self.policy is not None
+                        else None)
+            if self.store is not None and progress:
+                for wid, n in progress.items():
+                    self.store.sample(f"worker.{wid}.windows", now, n,
+                                      "counter")
+            if self.policy is not None and progress:
+                # the single-definition path: rates come off the shared
+                # series, not a private differentiation
+                actions = (
+                    self.policy.observe_series(
+                        self.store, now,
+                        window_s=max(self.policy.window_s,
+                                     3 * self.poll_interval),
+                        wids=progress.keys())
+                    if self.store is not None
+                    else self.policy.observe(now, progress)
+                )
+                for action, wid in actions:
+                    if action == "join":
+                        self.request_join(reason="autoscaler")
+                    elif action == "release":
+                        self.request_preempt(wid, reason="autoscaler")
             time.sleep(self.poll_interval)
         with self._lock:
             drainers = list(self._drainers)

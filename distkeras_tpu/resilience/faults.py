@@ -69,6 +69,12 @@ class FaultPlan:
     - ``kill_at``: ``{worker_id: window_index}`` — the worker raises
       :class:`WorkerKilled` when it reaches that window (once; a
       restarted worker passing the same index survives).
+    - ``straggle``: ``{worker_id: seconds}`` — the worker sleeps that
+      long at EVERY window boundary: a deterministic persistent
+      straggler (slow host, thermal throttle, noisy neighbor stand-in).
+      This is the fault the watchtower's commit-skew alert and the
+      autoscaler's τ-tail release exist for — same seam as ``kill_at``,
+      no randomness at all.
 
     Elastic-membership faults (consulted by the ``ElasticCoordinator`` —
     resilience/elastic.py — through the worker window loop, so they ride
@@ -108,6 +114,7 @@ class FaultPlan:
                  delay_s: float = 0.0, partition_after: int | None = None,
                  partition_ops: int = 0,
                  kill_at: dict[int, int] | None = None,
+                 straggle: dict[int, float] | None = None,
                  max_faults: int | None = None,
                  kill_ps_after_commits: int | None = None,
                  kill_shard_id: int | None = None,
@@ -125,6 +132,14 @@ class FaultPlan:
         self.partition_after = partition_after
         self.partition_ops = int(partition_ops)
         self.kill_at = dict(kill_at or {})
+        self.straggle = {
+            int(w): float(s) for w, s in (straggle or {}).items()
+        }
+        for w, s in self.straggle.items():
+            if s < 0:
+                raise ValueError(
+                    f"straggle[{w}] must be >= 0 seconds, got {s}"
+                )
         self.max_faults = max_faults
         self.kill_ps_after_commits = (
             None if kill_ps_after_commits is None
@@ -150,6 +165,7 @@ class FaultPlan:
         self._n_delays = 0
         self._n_partition_drops = 0
         self._n_kills = 0
+        self._n_straggles = 0
         self._n_joins = 0
         self._n_preempts = 0
         self._n_ps_kills = 0
@@ -201,6 +217,17 @@ class FaultPlan:
         raise WorkerKilled(
             f"injected kill: worker {worker_id} at window {window_index}"
         )
+
+    def maybe_straggle(self, worker_id: int) -> None:
+        """Sleep the configured straggler delay at a window boundary
+        (no-op for workers without one). Deterministic: every window,
+        same duration — the persistent-straggler shape, not jitter."""
+        s = self.straggle.get(worker_id)
+        if not s:
+            return
+        with self._lock:
+            self._n_straggles += 1
+        time.sleep(s)
 
     # -- elastic-membership hooks (ElasticCoordinator) -----------------------
 
@@ -283,6 +310,7 @@ class FaultPlan:
                 "partition_drops": self._n_partition_drops,
                 "delays": self._n_delays,
                 "kills": self._n_kills,
+                "straggles": self._n_straggles,
                 "joins": self._n_joins,
                 "preempts": self._n_preempts,
                 "ps_kills": self._n_ps_kills,

@@ -49,6 +49,7 @@ whether from disk or from the stream.
 
 from __future__ import annotations
 
+import collections
 import io
 import os
 import pickle
@@ -304,6 +305,13 @@ class CommitLog:
         self.wal_records = 0
         self.wal_fsyncs = 0
         self.wal_group_max = 0      # most commits ever released by one fsync
+        # recent write+fsync durations in ms (bounded ring, appended by
+        # the flusher thread only): the watchtower samples its p95 into
+        # ps.wal_fsync_p95_ms — the fsync-tail alert's series. A deque
+        # append is O(1) and the flusher already owns the timestamps.
+        self.fsync_ms_recent: collections.deque = collections.deque(
+            maxlen=256
+        )
         self._flusher = threading.Thread(
             target=self._flush_loop, daemon=True,
             name="dk-wal-flusher",
@@ -520,12 +528,16 @@ class CommitLog:
             # (ps.wal_wait on the handler thread ends when this closes)
             from distkeras_tpu.observability import trace as _trace
 
+            t_sync = time.perf_counter()
             with _trace.span("wal.fsync", args={"batch": len(batch)}):
                 for chunks in batch:
                     for chunk in chunks:
                         fh.write(chunk)
                 fh.flush()
                 os.fsync(fh.fileno())
+            self.fsync_ms_recent.append(
+                (time.perf_counter() - t_sync) * 1e3
+            )
         except (OSError, ValueError):
             # _io_lock is held, so this is not a close/rotate race — the
             # device genuinely failed the write: abandon (see docstring)

@@ -82,6 +82,45 @@ def per_row_new_token_counts(new_tokens, eos_id: int | None):
     return np.where(hit.any(axis=1), first + 1, T).astype(np.int32)
 
 
+def summarize_latencies(records, window_s: float | None = None,
+                        now: float | None = None) -> dict:
+    """Per-SLO-class latency summary over retired-request records (the
+    engine's ``_retired`` ring — or any iterable of dicts with ``t``,
+    ``slo_class``, ``state``, ``total_s``, ``queue_s``, ``prefill_s``,
+    ``decode_s``): p50/p99 end-to-end plus mean queue/prefill/decode
+    breakdown, in ms, over COMPLETED requests only — a cancelled
+    request's lifetime is how long its client waited before giving up,
+    not a served latency, and pooling it in would let a storm of fast
+    cancels mask a real SLO breach of the requests that finished.
+    ``window_s`` restricts to records retired within the trailing
+    window (None = everything in the ring). Pure function so the
+    watchdog tests feed it synthetic records."""
+    recs = [r for r in records if r.get("state", "done") == "done"]
+    if window_s is not None:
+        t_end = now if now is not None else (
+            max(r["t"] for r in recs) if recs else 0.0)
+        recs = [r for r in recs if r["t"] >= t_end - window_s]
+    out: dict[str, dict] = {}
+    by_cls: dict[str, list] = {}
+    for r in recs:
+        by_cls.setdefault(r.get("slo_class", "default"), []).append(r)
+    for cls, rs in sorted(by_cls.items()):
+        total = np.asarray([r["total_s"] for r in rs], np.float64) * 1e3
+        rec = {
+            "count": len(rs),
+            "p50_ms": float(np.percentile(total, 50)),
+            "p99_ms": float(np.percentile(total, 99)),
+        }
+        for key, out_key in (("queue_s", "queue_ms"),
+                             ("prefill_s", "prefill_ms"),
+                             ("decode_s", "decode_ms")):
+            vals = [r[key] for r in rs if r.get(key) is not None]
+            if vals:
+                rec[out_key] = float(np.mean(vals)) * 1e3
+        out[cls] = rec
+    return out
+
+
 class Request:
     """One generation request moving through the engine.
 
@@ -92,7 +131,8 @@ class Request:
     def __init__(self, prompt: np.ndarray, *, max_new_tokens: int,
                  temperature: float, top_k: int | None,
                  top_p: float | None, seed: int, eos_id: int | None,
-                 request_id: str | None = None):
+                 request_id: str | None = None,
+                 slo_class: str = "default"):
         self.id = request_id if request_id is not None \
             else f"req-{next(_req_ids)}"
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -102,12 +142,17 @@ class Request:
         self.top_p = top_p
         self.seed = int(seed)
         self.eos_id = eos_id
+        # SLO class (ISSUE 13): a label, not a priority — admission stays
+        # strict-FIFO; the label buckets the latency telemetry so the
+        # watchdog can hold each class to ITS bound (interactive vs batch)
+        self.slo_class = str(slo_class)
         self.new_tokens: list[int] = []
         self.state = "queued"
         self.error: str | None = None
         self.t_submit = time.monotonic()
         self.t_admit: float | None = None
         self.t_done: float | None = None
+        self.prefill_s: float | None = None
         self._cancelled = False
         self._event = threading.Event()
 
@@ -241,6 +286,11 @@ class GenerationEngine:
             "occupancy_sum": 0,
             "spec_rounds": 0, "spec_proposed": 0, "spec_accepted": 0,
         }
+        # retired-request latency ring (ISSUE 13): one bounded record
+        # per finalized request — the per-SLO-class p50/p99 +
+        # queue/prefill/decode breakdown the watchtower samples and the
+        # serving SLO rule judges. Appended under the engine lock.
+        self._retired: deque = deque(maxlen=2048)
 
         self._decode_fn, self._decode_fn_greedy = self._make_decode()
         self._prefill_fns: dict[int, object] = {}
@@ -372,12 +422,15 @@ class GenerationEngine:
                temperature: float = 0.0, top_k: int | None = None,
                top_p: float | None = None, seed: int = 0,
                eos_id: int | None = None,
-               request_id: str | None = None) -> Request:
+               request_id: str | None = None,
+               slo_class: str = "default") -> Request:
         """Queue one generation; returns the :class:`Request` handle
         immediately. Raises :class:`ServerBusyError` when the bounded
         admission queue is full (backpressure) and ``ValueError`` on
         malformed requests — both BEFORE the queue, so a rejected request
-        costs the engine nothing."""
+        costs the engine nothing. ``slo_class`` labels the request's
+        latency telemetry (per-class p50/p99 vs SLO in the watchdog);
+        it does not change scheduling."""
         module = self._module
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1:
@@ -427,7 +480,7 @@ class GenerationEngine:
             prompt, max_new_tokens=max_new, temperature=float(temperature),
             top_k=top_k, top_p=top_p, seed=int(seed),
             eos_id=None if eos_id is None else int(eos_id),
-            request_id=request_id,
+            request_id=request_id, slo_class=slo_class,
         )
         with self._wake:
             if self._closed:
@@ -468,6 +521,21 @@ class GenerationEngine:
         self.stats_[key] += 1
         if state == "done":
             self.stats_["tokens_generated"] += len(req.new_tokens)
+        # latency telemetry (ISSUE 13): queue wait + prefill + decode
+        # decompose the end-to-end latency from timestamps the request
+        # already carries — no tracing required
+        queue_s = (req.t_admit - req.t_submit
+                   if req.t_admit is not None else None)
+        total_s = req.t_done - req.t_submit
+        decode_s = None
+        if queue_s is not None:
+            decode_s = total_s - queue_s - (req.prefill_s or 0.0)
+        self._retired.append({
+            "t": req.t_done, "slo_class": req.slo_class, "state": state,
+            "total_s": total_s, "queue_s": queue_s,
+            "prefill_s": req.prefill_s, "decode_s": decode_s,
+            "new_tokens": len(req.new_tokens),
+        })
         if _trace.enabled():
             # whole-lifetime span (submit → retire); time.monotonic and
             # the tracer's perf_counter share CLOCK_MONOTONIC on Linux
@@ -568,7 +636,10 @@ class GenerationEngine:
             if key not in self._prefill_fns:
                 self._prefill_fns[key] = self._make_prefill()
             c, dc = self.cache, getattr(self, "draft_cache", None)
-            t_pf = time.perf_counter_ns() if _trace.enabled() else 0
+            # always timed (one clock read per prefill FORWARD, not per
+            # request): the duration feeds each request's latency
+            # breakdown whether or not tracing is on
+            t_pf = time.perf_counter_ns()
             tok, c.k_pools, c.v_pools, dk, dv = self._prefill_fns[key](
                 self._params, self._draft_params, c.k_pools, c.v_pools,
                 dc.k_pools if dc else (), dc.v_pools if dc else (),
@@ -580,11 +651,13 @@ class GenerationEngine:
             if dc:
                 dc.k_pools, dc.v_pools = dk, dv
             tok = np.asarray(jax.device_get(tok))
-            if _trace.enabled():
-                t1_pf = time.perf_counter_ns()
-                for _, req in grp:
-                    # the group forward, attributed to every request it
-                    # prefilled (same interval, each with its own corr)
+            t1_pf = time.perf_counter_ns()
+            for _, req in grp:
+                # the group forward, attributed to every request it
+                # prefilled (same interval — the latency breakdown and,
+                # when tracing, the span, each with its own corr)
+                req.prefill_s = (t1_pf - t_pf) / 1e9
+                if _trace.enabled():
                     _trace.record("serve.prefill", t_pf, t1_pf,
                                   corr=req.id,
                                   args={"rows": n, "lpad": lpad})
@@ -837,9 +910,20 @@ class GenerationEngine:
                 self._finalize(self._queue.popleft(), "cancelled",
                                "engine stopped")
 
+    def latency_stats(self, window_s: float | None = None) -> dict:
+        """Per-SLO-class latency summary (see
+        :func:`summarize_latencies`) from the retired-request ring."""
+        with self._lock:
+            recs = list(self._retired)
+        return summarize_latencies(recs, window_s=window_s)
+
     def stats(self) -> dict:
         with self._lock:
             s = dict(self.stats_)
+            # snapshot the ring under the lock, summarize AFTER: the
+            # percentile math is O(ring) and the decode loop contends
+            # for this lock — a scrape must not stall token generation
+            retired = list(self._retired)
             s["queued"] = len(self._queue)
             s["active"] = sum(1 for x in self._slots if x is not None)
             s["blocks_in_use"] = self.allocator.used_blocks
@@ -854,4 +938,5 @@ class GenerationEngine:
                     round(s["spec_accepted"] / s["spec_proposed"], 4)
                     if s["spec_proposed"] else 0.0
                 )
-            return s
+        s["latency"] = summarize_latencies(retired)
+        return s

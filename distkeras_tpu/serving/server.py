@@ -39,7 +39,7 @@ from distkeras_tpu.networking import ProtocolError, ServerBusyError
 from distkeras_tpu.serving.scheduler import GenerationEngine, Request
 
 _SAMPLING_KEYS = ("max_new_tokens", "temperature", "top_k", "top_p",
-                  "seed", "eos_id", "request_id")
+                  "seed", "eos_id", "request_id", "slo_class")
 
 
 class GenerationServer:
@@ -77,6 +77,11 @@ class GenerationServer:
         self._running = False
         self.connections_ = 0
         self.dead_connections_ = 0
+        # Watchtower (ISSUE 13): attach one and the `metrics` wire
+        # action carries its alert ledger to remote scrapers (the CLI's
+        # `health --watch` relays server-side alerts it cannot derive
+        # from counters alone)
+        self.watchtower = None
 
     def initialize(self) -> None:
         self._server_sock = socket.socket(socket.AF_INET,
@@ -190,19 +195,19 @@ class GenerationServer:
                     networking.send_data(conn, {"ok": True,
                                                 "stats": self.stats()})
                 elif action == "metrics":
-                    # unified metrics surface (ISSUE 11): the serving
-                    # counters normalized into typed metrics — JSON
-                    # snapshot + Prometheus text, same contract as the
-                    # PS tier's "metrics" action
+                    # unified metrics surface (ISSUE 11/13): the serving
+                    # counters + per-class latency summary normalized
+                    # into typed metrics — the ONE metrics_reply shape
+                    # every server sends, plus the alert ledger when a
+                    # watchtower is attached
                     from distkeras_tpu.observability.metrics import (
+                        metrics_reply,
                         serving_metrics,
                     )
 
-                    reg = serving_metrics(self.stats())
-                    networking.send_data(conn, {
-                        "ok": True, "metrics": reg.to_json(),
-                        "prom": reg.to_prometheus(),
-                    })
+                    networking.send_data(conn, metrics_reply(
+                        serving_metrics(self.stats()), self.watchtower,
+                    ))
                 else:
                     networking.send_data(conn, {
                         "error": "bad_request",
@@ -277,7 +282,8 @@ class GenerationClient:
                  temperature: float = 0.0, top_k: int | None = None,
                  top_p: float | None = None, seed: int = 0,
                  eos_id: int | None = None,
-                 request_id: str | None = None) -> np.ndarray:
+                 request_id: str | None = None,
+                 slo_class: str = "default") -> np.ndarray:
         networking.send_data(self._sock, {
             "action": "generate",
             "prompt": np.asarray(prompt, np.int32),
@@ -285,6 +291,7 @@ class GenerationClient:
             "temperature": float(temperature),
             "top_k": top_k, "top_p": top_p, "seed": int(seed),
             "eos_id": eos_id, "request_id": request_id,
+            "slo_class": str(slo_class),
         })
         r = networking.recv_data(self._sock)
         if r.get("error") == "busy":

@@ -342,10 +342,13 @@ class ShardedPSGroup:
             return None
         return self.plan.join([s.get_ema() for s in self.active_servers])
 
-    def stats(self) -> dict:
+    def stats(self, settle: bool = True) -> dict:
         per = []
         for sid, s in enumerate(self.active_servers):
-            d = dict(s.stats())
+            try:
+                d = dict(s.stats(settle=settle))
+            except TypeError:   # native server: no settling barrier knob
+                d = dict(s.stats())
             d["shard_id"] = sid
             d["shard_nbytes"] = self.plan.shard_nbytes[sid]
             per.append(d)

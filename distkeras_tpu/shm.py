@@ -134,6 +134,50 @@ _LIVENESS_PERIOD = 0.25
 
 _seg_counter = itertools.count()
 
+# process-local segment registry (observability satellite): every mint
+# registers, every unlink path unregisters — segment_inventory() reads
+# /dev/shm where it exists (the cross-process truth) and falls back to
+# this registry elsewhere, so the no-leak property is operator-visible
+# in health_snapshot, not just test-visible
+_SEG_REGISTRY: dict[str, int] = {}
+_SEG_REGISTRY_LOCK = threading.Lock()
+
+
+def unregister_segment(name: str) -> None:
+    """Drop one segment from the live-inventory registry (called by
+    every unlink path — Python lane and native lane)."""
+    with _SEG_REGISTRY_LOCK:
+        _SEG_REGISTRY.pop(name, None)
+
+
+def segment_inventory() -> dict:
+    """Live dkshm segment inventory: names + sizes, from a /dev/shm
+    scan when the OS exposes one (covers segments OTHER processes on
+    this host minted too — the colocated regime's whole truth) or from
+    the process-local registry otherwise. An empty list after a run IS
+    the no-/dev/shm-leak proof, now visible to operators via
+    ``health_snapshot`` instead of only to the leak-check tests."""
+    segs = []
+    shm_dir = "/dev/shm"
+    if os.path.isdir(shm_dir):
+        for fn in sorted(os.listdir(shm_dir)):
+            if not fn.startswith("dkshm"):
+                continue
+            try:
+                size = os.stat(os.path.join(shm_dir, fn)).st_size
+            except OSError:
+                continue  # unlinked between listdir and stat
+            segs.append({"name": fn, "bytes": int(size)})
+    else:
+        with _SEG_REGISTRY_LOCK:
+            segs = [{"name": n, "bytes": b}
+                    for n, b in sorted(_SEG_REGISTRY.items())]
+    return {
+        "count": len(segs),
+        "total_bytes": sum(s["bytes"] for s in segs),
+        "segments": segs,
+    }
+
 
 def mint_segment(name_prefix: str,
                  ring_bytes: int) -> shared_memory.SharedMemory:
@@ -148,6 +192,8 @@ def mint_segment(name_prefix: str,
     )
     _WORD.pack_into(seg.buf, _OFF_MAGIC, _MAGIC)
     _WORD.pack_into(seg.buf, _OFF_CAP, int(ring_bytes))
+    with _SEG_REGISTRY_LOCK:
+        _SEG_REGISTRY[seg.name] = seg.size
     return seg
 
 
@@ -798,6 +844,38 @@ class ShmParameterServer(SocketParameterServer):
             seg.unlink()
         except FileNotFoundError:
             pass
+        unregister_segment(seg.name)
+
+    def ring_occupancy(self) -> list[dict]:
+        """Per-connection ring occupancy read straight off the mapped
+        headers (no locks, no syscalls): used bytes of each direction's
+        ring and the fuller direction's used fraction. The watchtower's
+        scraper samples the max across connections into
+        ``shm.ring_occupancy_frac`` — near 1.0 means a writer is about
+        to block on a stalled reader (or the ring is undersized)."""
+        with self._conns_lock:
+            recs = list(self._segments)
+        out = []
+        for rec in recs:
+            seg = rec["seg"]
+            try:
+                buf = seg.buf
+                cap = _WORD.unpack_from(buf, _OFF_CAP)[0]
+                c2s = (_WORD.unpack_from(buf, _OFF_C2S_HEAD)[0]
+                       - _WORD.unpack_from(buf, _OFF_C2S_TAIL)[0])
+                s2c = (_WORD.unpack_from(buf, _OFF_S2C_HEAD)[0]
+                       - _WORD.unpack_from(buf, _OFF_S2C_TAIL)[0])
+            except (ValueError, TypeError):
+                continue  # racing a release: this segment is going away
+            if cap <= 0:
+                continue
+            out.append({
+                "name": seg.name, "worker_id": rec["wid"],
+                "cap": int(cap), "c2s_used": int(c2s),
+                "s2c_used": int(s2c),
+                "frac": max(int(c2s), int(s2c)) / int(cap),
+            })
+        return out
 
     def stop(self) -> None:
         if not self._running:
@@ -913,14 +991,13 @@ class ShmParameterServer(SocketParameterServer):
                         conn.send_msg({"ok": True, "stats": self.stats()})
                     elif action == "metrics":
                         from distkeras_tpu.observability.metrics import (
+                            metrics_reply,
                             ps_metrics,
                         )
 
-                        reg = ps_metrics(self.stats())
-                        conn.send_msg({
-                            "ok": True, "metrics": reg.to_json(),
-                            "prom": reg.to_prometheus(),
-                        })
+                        conn.send_msg(metrics_reply(
+                            ps_metrics(self.stats()), self.watchtower,
+                        ))
                     elif action in ("stop", "bye"):
                         break
                     else:

@@ -547,6 +547,11 @@ class DistributedTrainer(Trainer):
                  trace: bool = False,
                  trace_dir=None,
                  trace_sample: float = 1.0,
+                 watch: bool = False,
+                 watch_rules=None,
+                 watch_dir=None,
+                 watch_hook=None,
+                 scrape_interval: float = 0.5,
                  tolerate_worker_failures: bool = False,
                  worker_restart_budget: int = 0,
                  worker_restart_delay: float = 0.0,
@@ -734,6 +739,37 @@ class DistributedTrainer(Trainer):
                 f"trace_sample must be in (0, 1], got {trace_sample}"
             )
         self.trace_path_ = None
+        # The watchtower (ISSUE 13, distkeras_tpu/observability/watch):
+        # continuous time-series telemetry + the SLO/anomaly watchdog.
+        # watch=True runs the background scraper at scrape_interval
+        # seconds over the PS stats surface / per-worker progress / the
+        # loss curve, evaluating watch_rules (None = default_rules())
+        # after every scrape; alert transitions land in watch_alerts_,
+        # fire watch_hook, and ride the `metrics` wire action; watch_dir=
+        # dumps series + ledger as one JSON (path in watch_path_). PS
+        # backend only, like trace — the collective backend has no
+        # server-side surface to scrape.
+        self.watch = (bool(watch) or watch_dir is not None
+                      or watch_rules is not None or watch_hook is not None)
+        self.watch_rules = watch_rules
+        self.watch_dir = watch_dir
+        self.watch_hook = watch_hook
+        self.scrape_interval = float(scrape_interval)
+        if self.watch and backend != "ps":
+            raise ValueError(
+                "watch/watch_dir/watch_rules apply to backend='ps' only "
+                "(the watchtower scrapes the PS stats surface; the "
+                "collective backend exposes none)"
+            )
+        if watch_hook is not None and not callable(watch_hook):
+            raise ValueError("watch_hook must be callable")
+        if self.scrape_interval <= 0:
+            raise ValueError(
+                f"scrape_interval must be positive, got {scrape_interval}"
+            )
+        self.watch_alerts_ = None
+        self.watch_path_ = None
+        self.watchtower_ = None
         # Failure tolerance (beyond-reference, SURVEY.md §5.3 — the reference
         # delegated retry wholesale to Spark): on the PS backend, True lets
         # surviving hogwild workers finish the run when a peer dies (the run
@@ -1248,6 +1284,15 @@ class DistributedTrainer(Trainer):
                 from distkeras_tpu.observability import trace as _trace
 
                 _trace.disable()
+            # same contract for the watchtower (ISSUE 13): a run that
+            # dies mid-flight must not leave its scraper thread polling
+            # a stopped server for the rest of the process
+            wt = getattr(tgt, "_watchtower_active_", None)
+            if wt is not None:
+                try:
+                    wt.stop()
+                finally:
+                    tgt._watchtower_active_ = None
             raise
         elapsed = time.perf_counter() - t0
         self.record_training_end()

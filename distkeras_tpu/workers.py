@@ -389,6 +389,10 @@ class AsyncWorker:
                     self.fault_plan.maybe_kill(
                         self.worker_id, self._windows_done
                     )
+                    # deterministic persistent-straggler chaos (ISSUE
+                    # 13): the configured worker sleeps here every
+                    # window — the commit-skew alert's test subject
+                    self.fault_plan.maybe_straggle(self.worker_id)
                 sl = order[w * win_rows : (w + 1) * win_rows]
                 batches = tuple(
                     c[sl].reshape((self.window, self.batch_size) + c.shape[1:])
@@ -521,6 +525,10 @@ class AsyncWorker:
                     self.fault_plan.maybe_kill(
                         self.worker_id, self._windows_done
                     )
+                    # deterministic persistent-straggler chaos (ISSUE
+                    # 13): the configured worker sleeps here every
+                    # window — the commit-skew alert's test subject
+                    self.fault_plan.maybe_straggle(self.worker_id)
                 sl = order[w * win_rows : (w + 1) * win_rows]
                 batches = tuple(
                     c[sl].reshape(
@@ -618,6 +626,10 @@ class AsyncWorker:
                     self.fault_plan.maybe_kill(
                         self.worker_id, self._windows_done
                     )
+                    # deterministic persistent-straggler chaos (ISSUE
+                    # 13): the configured worker sleeps here every
+                    # window — the commit-skew alert's test subject
+                    self.fault_plan.maybe_straggle(self.worker_id)
                 batches = tuple(
                     c[idx].reshape(
                         (self.window, self.batch_size) + c.shape[1:]
@@ -714,6 +726,10 @@ class AsyncWorker:
                     self.fault_plan.maybe_kill(
                         self.worker_id, self._windows_done
                     )
+                    # deterministic persistent-straggler chaos (ISSUE
+                    # 13): the configured worker sleeps here every
+                    # window — the commit-skew alert's test subject
+                    self.fault_plan.maybe_straggle(self.worker_id)
                 batches = tuple(
                     c[idx].reshape(
                         (self.window, self.batch_size) + c.shape[1:]
@@ -1237,6 +1253,57 @@ def run_async_training(trainer, ds, shuffle: bool):
     history: list[dict] = []
     hlock = threading.Lock()
 
+    # The watchtower (ISSUE 13): watch=True / watch_dir= / watch_rules=
+    # run a background scraper sampling the PS stats surface, per-worker
+    # progress, and the training loss into ring-buffered time series,
+    # with the declarative watchdog evaluating its alert rules after
+    # every scrape. Alerts land in trainer.watch_alerts_ (and the
+    # `metrics` wire action, via the server's watchtower attribute);
+    # watch_dir= dumps the series + alert ledger as one JSON artifact
+    # (path in trainer.watch_path_); watch_hook= fires per transition.
+    watch_dir = getattr(trainer, "watch_dir", None)
+    watch_rules = getattr(trainer, "watch_rules", None)
+    watch_on = (bool(getattr(trainer, "watch", False))
+                or watch_dir is not None or watch_rules is not None
+                or getattr(trainer, "watch_hook", None) is not None)
+    watchtower = None
+    trainer.watch_alerts_ = None
+    trainer.watch_path_ = None
+    trainer.watchtower_ = None
+    trainer._watchtower_active_ = None
+    if watch_on:
+        from distkeras_tpu.observability.timeseries import ps_source
+        from distkeras_tpu.observability.watch import Watchtower
+
+        watchtower = Watchtower(
+            rules=watch_rules,
+            interval=float(getattr(trainer, "scrape_interval", 0.5)),
+            hook=getattr(trainer, "watch_hook", None),
+        )
+        if ps is not None:
+            # scrape the ACTIVE server across a failover (the crashed
+            # primary's counters freeze; the promoted one's move)
+            def _watch_ps(_ps=ps):
+                if ps_supervisor is not None:
+                    active = getattr(ps_supervisor, "active", None)
+                    if active is not None:
+                        return active
+                return _ps
+
+            watchtower.add_source("ps", ps_source(_watch_ps))
+            # the wire-visible alert ledger: every Python-served shard/
+            # server carries the one watchtower (the native C++ server
+            # has no Python handler loop — its scrape stays CLI-side)
+            servers = (list(sharded_group.servers)
+                       if sharded_group is not None else [ps])
+            for srv in servers:
+                if hasattr(srv, "watchtower"):
+                    srv.watchtower = watchtower
+        watchtower.add_history(history, hlock)
+        # ownership for crash paths (same contract as _trace_owner_):
+        # trainers._train_ps stops a scraper the failed run left behind
+        trainer._watchtower_active_ = watchtower
+
     workers: list[AsyncWorker] = []
     barrier = None
     snap_client = None
@@ -1357,7 +1424,16 @@ def run_async_training(trainer, ds, shuffle: bool):
                 getattr(trainer, "preempt_drain_timeout", 5.0)
             ),
             max_pool_size=int(max_pool),
+            # ONE progress record: the coordinator samples per-worker
+            # windows into the watchtower's store (when watching), and
+            # the policy observes rates off those series — the same
+            # series the commit-skew alert evaluates
+            store=watchtower.store if watchtower is not None else None,
         )
+        if watchtower is not None:
+            # the coordinator's poll loop feeds worker.* at its own
+            # cadence; the scraper covers the PS/history/τ series
+            watchtower.start()
         coordinator.start(list(range(W)))
         coordinator.run()
         workers = coordinator.all_workers()
@@ -1377,6 +1453,22 @@ def run_async_training(trainer, ds, shuffle: bool):
             )
             for i in range(W)
         ]
+
+    if watchtower is not None and not elastic_mode:
+        # fixed pool: the scraper samples per-worker progress itself
+        # (the elastic coordinator's poll loop does it over there)
+        from distkeras_tpu.observability.timeseries import progress_source
+
+        # only workers still TRAINING are sampled: a finished worker's
+        # flat counter would read as a rate-0 "straggler" to the skew
+        # rule, when it is just done (its series ages out of the rate
+        # window instead); dead workers likewise stop being progress
+        watchtower.add_source("progress", progress_source(
+            lambda: {w.worker_id: int(getattr(w, "_windows_done", 0))
+                     for w in workers
+                     if w.error is None and not hasattr(w, "final_nt")}
+        ))
+        watchtower.start()
 
     def _args_of(i):
         return (i, tuple(col[i] for col in shards), trainer.num_epoch,
@@ -1443,6 +1535,22 @@ def run_async_training(trainer, ds, shuffle: bool):
                 "a shard failover supervisor died while the workers "
                 "survived"
             ) from sup_err
+
+    if watchtower is not None:
+        # one final synchronous tick (end-of-run counters always land in
+        # the series), then publish the ledger — and the one-file
+        # timeseries dump when watch_dir= asked for it
+        watchtower.stop()
+        trainer.watchtower_ = watchtower
+        trainer.watch_alerts_ = watchtower.alerts_json()
+        if watch_dir is not None:
+            import os as _os
+
+            trainer.watch_path_ = watchtower.dump(_os.path.join(
+                watch_dir,
+                f"ps-watch-{_os.getpid()}-{time.time_ns()}.json",
+            ))
+        trainer._watchtower_active_ = None
 
     # Resilience observability, stashed next to ps_stats_: the commit-
     # seqno oracle (logical commits issued vs folds applied — see the

@@ -1,6 +1,7 @@
 """bench.py plumbing tests: the measurement core runs on CPU and the analytic
 FLOP models are sane (guards the driver-facing benchmark against bitrot)."""
 
+import json
 import sys
 from pathlib import Path
 
@@ -167,6 +168,121 @@ def test_ps_elastic_bench_contract():
     assert isinstance(rec["tracking_within_one_worker"], bool)
     # a failed tracking verdict is only acceptable when host-ceiling-capped
     assert rec["tracking_within_one_worker"] or rec["host_ceiling_limited"]
+
+
+def test_regress_metric_direction():
+    """The comparator's direction map: throughput up, latency down,
+    identity/shape keys skipped; the trajectory's `value` headline is a
+    rate only when its record's unit says so."""
+    assert bench.metric_direction("fused_rounds_per_sec") == "higher"
+    assert bench.metric_direction("tokens_per_sec") == "higher"
+    assert bench.metric_direction("throughput_rps") == "higher"
+    assert bench.metric_direction("mfu") == "higher"
+    assert bench.metric_direction("ms_per_step") == "lower"
+    assert bench.metric_direction("p99_ms") == "lower"
+    assert bench.metric_direction("tta_99_seconds") == "lower"
+    assert bench.metric_direction("workers") is None
+    assert bench.metric_direction("host_cores") is None
+    assert bench.metric_direction(
+        "value", {"unit": "samples/sec"}) == "higher"
+    assert bench.metric_direction("value", {"unit": "loss"}) is None
+
+
+def test_regress_comparator_flags_twenty_percent_slowdown():
+    """The acceptance comparator case: a >= 20% drop against a tight
+    trajectory is a regression; a within-noise drop is not; a noisy
+    trajectory widens its own tolerance (measured spread, not an
+    assumed constant)."""
+    base = [{"config": "leg", "fused_rounds_per_sec": v}
+            for v in (100.0, 101.0, 99.0, 100.5)]
+    slow = [{"config": "leg", "fused_rounds_per_sec": 80.0}]
+    r = bench.compare_to_trajectory(slow, base)
+    assert r["verdict"] == "regression" and r["regressions"] == 1
+    ok = bench.compare_to_trajectory(
+        [{"config": "leg", "fused_rounds_per_sec": 97.0}], base)
+    assert ok["verdict"] == "ok"
+    # wide measured spread -> the same 20% drop is within tolerance
+    noisy = [{"config": "leg", "fused_rounds_per_sec": v}
+             for v in (100.0, 60.0, 140.0, 85.0, 115.0)]
+    r2 = bench.compare_to_trajectory(slow, noisy)
+    assert r2["checks"][0]["status"] == "ok"
+
+
+def test_regress_comparator_direction_host_and_baseline_rules():
+    # lower-better: a latency INCREASE regresses
+    base = [{"config": "leg", "p99_ms": v} for v in (10.0, 10.5, 9.8)]
+    r = bench.compare_to_trajectory([{"config": "leg", "p99_ms": 14.0}],
+                                    base)
+    assert r["verdict"] == "regression"
+    r2 = bench.compare_to_trajectory([{"config": "leg", "p99_ms": 9.0}],
+                                     base)
+    assert r2["verdict"] == "ok"
+    # host_cores-honest: samples from a different core count are not a
+    # baseline — with all of them excluded the check is no_baseline
+    alien = [{"config": "leg", "p99_ms": 5.0, "host_cores": 64}
+             for _ in range(3)]
+    r3 = bench.compare_to_trajectory(
+        [{"config": "leg", "p99_ms": 14.0}], alien, host_cores=1)
+    (chk,) = r3["checks"]
+    assert chk["status"] == "no_baseline" and chk["host_skipped"] == 3
+    assert r3["verdict"] == "ok"
+    # fewer than min_samples baselines: the trajectory starts here
+    r4 = bench.compare_to_trajectory(
+        [{"config": "leg", "p99_ms": 14.0}],
+        [{"config": "leg", "p99_ms": 10.0}])
+    assert r4["checks"][0]["status"] == "no_baseline"
+
+
+def test_regress_load_trajectory_parses_parsed_and_tail(tmp_path):
+    doc = {
+        "n": 1, "cmd": "python bench.py", "rc": 0,
+        "parsed": {"config": "a", "tokens_per_sec": 100.0},
+        "tail": "\n".join([
+            "noise line",
+            '{"config": "b", "ms_per_step": 5.0}',
+            '{"config": "bad", "ms_per_step": 9.0, "invalid": true}',
+            '{"config": "a", "tokens_per_sec": 100.0}',  # dup of parsed
+            "{not json}",
+        ]),
+    }
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(doc))
+    files, recs = bench.load_trajectory("BENCH_*.json", str(tmp_path))
+    assert len(files) == 1
+    # dup deduped, invalid dropped, non-JSON ignored
+    assert sorted(r["config"] for r in recs) == ["a", "b"]
+    assert all(r["_file"] == "BENCH_r01.json" for r in recs)
+
+
+def test_regress_bench_smoke_clean_and_synthetic_slowdown(tmp_path):
+    """--regress end to end at toy scale: an unmodified measurement
+    passes against its own clean repeats; the synthetic-slowdown seam
+    (a REAL injected sleep) is flagged. Empty glob -> the clean repeats
+    are the whole baseline, exactly the trajectory-seeding path."""
+    # rel_slack loosened to 25% for the in-suite smoke: the suite's own
+    # load jitters this box well past the guard's 12% default (which CI
+    # runs with the step alone); the injected 1.0 slowdown halves
+    # throughput — far outside either slack
+    rec = bench.run_regress_bench(
+        repeats=2, seconds=0.3, n_params=16_384, slowdown=0.0,
+        glob_pat="NO_SUCH_BENCH_*.json", root=str(tmp_path),
+        rel_slack=0.25,
+    )
+    assert rec["verdict"] == "ok", rec["checks"]
+    assert rec["trajectory_files"] == 0
+    keys = {c["key"] for c in rec["checks"]}
+    assert "fused_rounds_per_sec" in keys
+    slow = bench.run_regress_bench(
+        repeats=2, seconds=0.3, n_params=16_384, slowdown=1.0,
+        glob_pat="NO_SUCH_BENCH_*.json", root=str(tmp_path),
+        rel_slack=0.25,
+    )
+    assert slow["verdict"] == "regression", slow["checks"]
+    flagged = {c["key"] for c in slow["checks"]
+               if c["status"] == "regression"}
+    # the sleep rides inside the measured round on the serial/fused
+    # legs (the pipelined leg may hide part of it in its overlap) —
+    # at least one rounds/s leg must be flagged
+    assert any(k.endswith("_rounds_per_sec") for k in flagged), flagged
 
 
 def test_analytic_flop_models():
