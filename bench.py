@@ -2725,16 +2725,28 @@ def main():
 
     def _finish_trace():
         """Write the recorder out (one file per bench invocation; every
-        leg's spans land in it) and return its path, or None."""
+        leg's spans land in it), run the post-hoc analyzer over it
+        (ISSUE 14 — the regime verdict every traced leg record carries),
+        and return ``(path, verdict)`` — ``(None, None)`` untraced."""
         if not args.trace_dir:
-            return None
+            return None, None
+        from distkeras_tpu.observability import analyze as _obs_analyze
         from distkeras_tpu.observability import trace as _obs_trace
 
         path = _obs_trace.save(os.path.join(
             args.trace_dir, f"bench-trace-{os.getpid()}.json"
         ))
+        verdict = None
+        try:
+            report = _obs_analyze.analyze_events(
+                _obs_trace.events(),
+                dropped=_obs_trace.live_dropped(),
+            )
+            verdict = report["verdict"]
+        except Exception as e:  # diagnosis must not fail the bench
+            log(f"[trace analysis failed] {type(e).__name__}: {e}")
         _obs_trace.disable()
-        return path
+        return path, verdict
 
     if args.ps_bench or args.chaos or args.chaos_ps or args.serve:
         # PS legs are pure host-side numpy/threading; the serve leg runs the
@@ -2787,19 +2799,23 @@ def main():
                 legs=tuple(x for x in args.serve_legs.split(",") if x)))
         serve_only = args.serve and not (args.ps_bench or args.chaos
                                          or args.chaos_ps)
-        trace_path = _finish_trace()
+        trace_path, trace_verdict = _finish_trace()
         if trace_path is not None:
-            # BENCH_* records link to their timeline (ISSUE 11): the one
-            # trace file carries every leg's spans, stamped per leg
+            # BENCH_* records link to their timeline (ISSUE 11) and its
+            # analysis verdict (ISSUE 14): the one trace file carries
+            # every leg's spans; the regime names what bounded the run
             for rec in legs.values():
                 if isinstance(rec, dict):
                     rec["trace_path"] = trace_path
+                    if trace_verdict is not None:
+                        rec["analysis_regime"] = trace_verdict["regime"]
         print(json.dumps({
             "metric": "serve_bench" if serve_only else "ps_bench",
             "unit": "requests/sec" if serve_only else "ops/sec",
             "workers": args.ps_bench_workers,
             "legs": legs,
             "trace_path": trace_path,
+            "analysis": trace_verdict,
         }))
         sys.stdout.flush()
         return
@@ -2953,11 +2969,12 @@ def main():
             leg(title, fn, est)
     if args.scaling:
         run_scaling(accel)
-    trace_path = _finish_trace()
+    trace_path, trace_verdict = _finish_trace()
     if trace_path is not None:
         # the training-headline path writes its timeline too — one
-        # stderr record links the run to its trace file
-        log(json.dumps({"metric": "trace", "trace_path": trace_path}))
+        # stderr record links the run to its trace file + its verdict
+        log(json.dumps({"metric": "trace", "trace_path": trace_path,
+                        "analysis": trace_verdict}))
     log(f"total wall: {time.perf_counter() - t_start:.0f}s")
 
 

@@ -25,9 +25,18 @@ SLO-aware scheduling, shm transport) are debugged against:
   loss-slope convergence stall) evaluated over those series, plus the
   ONE shared definition of rounds/s + straggler ratio that
   ``ElasticPolicy`` reads too.
+- :mod:`distkeras_tpu.observability.analyze` — the analyst (ISSUE 14):
+  post-hoc critical-path attribution over the recorded spans (per-worker
+  waterfalls, pipelining overlap efficiency, center-lock/fsync/straggler
+  wait attribution) ending in a typed regime verdict
+  (compute/wire/fsync/fold-lock/host-core-bound) with knob-keyed
+  recommendations; ``analyze=True`` on a trainer runs it post-run into
+  ``trainer.analysis_``, and ``regime_source`` feeds the live regime
+  series the watchtower's ``BottleneckShiftRule`` fires on.
 - ``python -m distkeras_tpu.observability`` — ``dump`` / ``tail`` a
-  live server's metrics, emit the ``health`` snapshot, or ``health
-  --watch`` a live server's alert transitions.
+  live server's metrics, emit the ``health`` snapshot, ``health
+  --watch`` a live server's alert transitions, or ``analyze`` a saved
+  trace into the bottleneck report.
 
 Trainer knobs: ``trace=True`` (enable), ``trace_dir=`` (write the
 timeline file, path lands in ``trainer.trace_path_``),
@@ -40,7 +49,7 @@ their stdout JSON; ``bench.py --regress`` is the trajectory-enforcing
 perf-regression guard.
 """
 
-from distkeras_tpu.observability import timeseries, trace, watch
+from distkeras_tpu.observability import analyze, timeseries, trace, watch
 from distkeras_tpu.observability.metrics import (
     MetricsRegistry,
     health_snapshot,
@@ -57,7 +66,7 @@ from distkeras_tpu.observability.watch import (
 )
 
 __all__ = [
-    "trace", "timeseries", "watch", "MetricsRegistry", "ps_metrics",
+    "trace", "timeseries", "watch", "analyze", "MetricsRegistry", "ps_metrics",
     "serving_metrics", "phase_metrics", "trace_metrics",
     "health_snapshot", "TimeSeriesStore", "Scraper", "Watchdog",
     "Watchtower", "default_rules",

@@ -7,6 +7,8 @@ Usage::
         [--interval 2] [--count 0]
     python -m distkeras_tpu.observability health [--wal-dir DIR] \\
         [--host H --port P] [--watch [--interval 2] [--count 0]]
+    python -m distkeras_tpu.observability analyze <trace.json[.gz]> \\
+        [--series <dump.json[.gz]>] [--json]
 
 ``dump``/``tail`` speak the ``metrics`` wire action both the
 ``SocketParameterServer`` and the ``GenerationServer`` serve (the framed
@@ -16,6 +18,15 @@ snapshot by default or the Prometheus text exposition with ``--prom``.
 membership, the trace-overflow counter, and the live shm segment
 inventory into ONE JSON document (exit code 1 when unhealthy) — the
 artifact CI uploads instead of three separate ad-hoc dumps.
+
+``analyze`` (ISSUE 14) runs the post-hoc critical-path analyzer
+(observability/analyze.py) over a saved flight-recorder trace — plain
+or gzipped — optionally joined with a watchtower time-series dump:
+per-worker waterfalls, overlap efficiency, lock/fsync/straggler
+attribution, and the typed regime verdict with knob-keyed
+recommendations. ``--json`` prints the full report document (the CI
+artifact); the default is the human-readable summary. Exit code 2 when
+the verdict is degraded (the trace dropped spans), 0 otherwise.
 
 ``health --watch`` (ISSUE 13) polls a live server's ``metrics`` action
 on ``--interval`` and prints alert TRANSITIONS as JSON lines: the
@@ -131,6 +142,27 @@ def _cmd_health(args) -> int:
     return 0 if report["ok"] else 1
 
 
+def _cmd_analyze(args) -> int:
+    from distkeras_tpu.observability.analyze import (
+        analyze_trace,
+        format_report,
+    )
+    from distkeras_tpu.observability.metrics import _json_clean
+
+    try:
+        report = analyze_trace(args.trace, series_path=args.series)
+    except (OSError, ValueError, KeyError) as e:
+        raise SystemExit(
+            f"analyze: cannot read {args.trace!r}: "
+            f"{type(e).__name__}: {e}"
+        ) from e
+    if args.json:
+        print(json.dumps(_json_clean(report), indent=2, sort_keys=True))
+    else:
+        print(format_report(report))
+    return 2 if report["degraded"] else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m distkeras_tpu.observability",
@@ -172,6 +204,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--count", type=int, default=0,
                    help="stop after N polls (0 = forever)")
     p.set_defaults(fn=_cmd_health)
+
+    p = sub.add_parser(
+        "analyze",
+        help="post-hoc critical-path attribution + bottleneck verdict "
+             "over a saved flight-recorder trace (.json or .json.gz)",
+    )
+    p.add_argument("trace", help="Chrome trace file from trace.save()")
+    p.add_argument("--series", default=None,
+                   help="watchtower/timeseries dump to join (counters, "
+                        "alert history; .json or .json.gz)")
+    p.add_argument("--json", action="store_true",
+                   help="full report document instead of the summary")
+    p.set_defaults(fn=_cmd_analyze)
 
     args = ap.parse_args(argv)
     if args.cmd == "health" and args.wal_dir is None \

@@ -34,6 +34,7 @@ Design constraints:
 
 from __future__ import annotations
 
+import gzip
 import json
 import os
 import threading
@@ -42,6 +43,8 @@ import warnings
 from typing import Callable
 
 import numpy as np
+
+from distkeras_tpu.observability import trace as _trace
 
 __all__ = [
     "Series", "TimeSeriesStore", "Scraper",
@@ -259,20 +262,23 @@ class TimeSeriesStore:
 
     def dump(self, path: str, extra: dict | None = None) -> str:
         """Write the store (plus optional extra sections — the watchdog
-        attaches its alert log here) as one JSON document."""
+        attaches its alert log here) as one JSON document. A ``.gz``
+        path is gzip-compressed (long watched runs; ISSUE 14) — ``load``
+        and the ``analyze`` CLI sniff the format, so both read back
+        transparently."""
         doc = self.to_json()
         if extra:
             doc.update(extra)
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
-        with open(path, "w") as f:
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "wt") as f:
             json.dump(doc, f)
         return path
 
     @classmethod
     def load(cls, path: str) -> "TimeSeriesStore":
-        with open(path) as f:
-            doc = json.load(f)
+        doc = _trace.load_json_maybe_gz(path)
         store = cls(doc.get("capacity", 512))
         for n, s in doc.get("series", {}).items():
             store._series[n] = Series.from_json(s)
@@ -406,9 +412,12 @@ def ps_source(ps) -> Callable:
             vals = taus()
             if vals:
                 arr = np.asarray(vals, np.float64)
-                store.sample("ps.tau_p95", now,
-                             float(np.percentile(arr, 95)))
+                p95 = float(np.percentile(arr, 95))
+                store.sample("ps.tau_p95", now, p95)
                 store.sample("ps.tau_max", now, float(arr.max()))
+                # Perfetto counter track (ISSUE 14): the sampled τ tail
+                # renders as a graph alongside the spans (no-op untraced)
+                _trace.counter("ps.tau_p95", p95)
         wal = getattr(target, "_wal", None)
         recent = getattr(wal, "fsync_ms_recent", None)
         if recent:
@@ -422,8 +431,9 @@ def ps_source(ps) -> Callable:
         if occ is not None:
             segs = occ()
             if segs:
-                store.sample("shm.ring_occupancy_frac", now,
-                             max(s["frac"] for s in segs))
+                frac = max(s["frac"] for s in segs)
+                store.sample("shm.ring_occupancy_frac", now, frac)
+                _trace.counter("shm.ring_occupancy_frac", frac)
             store.sample("shm.segments", now, len(segs))
 
     return sample
@@ -489,6 +499,10 @@ def serving_source(engine) -> Callable:
             v = stats.get(key)
             if v is not None:
                 store.sample(f"serve.{key}", now, v, kind)
+        if stats.get("active") is not None:
+            # rows in flight as a Perfetto counter track (ISSUE 14):
+            # batch occupancy over time next to the decode_step spans
+            _trace.counter("serve.rows_in_flight", stats["active"])
         lat = stats.get("latency") or {}
         for cls, rec in lat.items():
             for key in ("p50_ms", "p99_ms", "queue_ms", "prefill_ms",

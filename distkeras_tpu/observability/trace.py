@@ -42,6 +42,7 @@ propagation is never sampled out, only span recording is.
 
 from __future__ import annotations
 
+import gzip
 import json
 import os
 import threading
@@ -50,9 +51,14 @@ from typing import Any
 
 __all__ = [
     "enable", "disable", "enabled", "span", "record", "instant",
-    "set_corr", "current_corr", "add_events", "events", "save",
-    "dropped_spans",
+    "counter", "set_corr", "current_corr", "add_events", "events",
+    "save", "rotate_files", "dropped_spans", "live_dropped",
 ]
+
+#: category marking a ring entry as a sampled counter value rather than
+#: a span — ``save()`` renders these as Chrome ``ph: "C"`` counter
+#: tracks (Perfetto draws them as graphs alongside the spans)
+COUNTER_CAT = "__counter__"
 
 #: module-global tracer; ``None`` = disabled (the one read every
 #: call-site pays when tracing is off)
@@ -150,8 +156,9 @@ class Tracer:
                 self._states.append(st)
         return st
 
-    def _record(self, st: _ThreadState, name, cat, corr, t0, dur, args):
-        if self.sample < 1.0:
+    def _record(self, st: _ThreadState, name, cat, corr, t0, dur, args,
+                sampled: bool = True):
+        if sampled and self.sample < 1.0:
             st.n_seen += 1
             # deterministic counter sampling: record iff the scaled
             # counter crossed an integer — exactly ~sample of spans,
@@ -166,11 +173,16 @@ class Tracer:
         with self._reg_lock:
             self._foreign.extend(evs)
 
-    def events(self) -> list[dict]:
+    def events(self, min_end_ns: int | None = None) -> list[dict]:
         """Every recorded event as a list of dicts (oldest first per
         thread), merged across threads + foreign sources and sorted by
         start time. Keys: name, cat, corr, t0_ns, dur_ns, tid, tname,
-        args."""
+        args. ``min_end_ns`` keeps only events that END after it — the
+        incremental-consumer filter (RegimeTracker): entries land in
+        the ring at span CLOSE, so an end-time cursor never permanently
+        misses a long span whose START predates shorter spans already
+        observed, and stale entries are skipped as raw tuples (no dict
+        built, nothing sorted for them)."""
         out = []
         with self._reg_lock:
             states = list(self._states)
@@ -183,12 +195,18 @@ class Tracer:
                 if ev is None:
                     continue
                 name, cat, corr, t0, dur, args = ev
+                if min_end_ns is not None and t0 + dur <= min_end_ns:
+                    continue
                 out.append({
                     "name": name, "cat": cat, "corr": corr,
                     "t0_ns": t0, "dur_ns": dur,
                     "tid": st.tid, "tname": st.tname, "args": args,
                 })
-        out.extend(foreign)
+        if min_end_ns is not None:
+            out.extend(e for e in foreign
+                       if e["t0_ns"] + e["dur_ns"] > min_end_ns)
+        else:
+            out.extend(foreign)
         out.sort(key=lambda e: e["t0_ns"])
         return out
 
@@ -234,6 +252,14 @@ def dropped_spans() -> int:
     return _dropped_retired + live
 
 
+def live_dropped() -> int:
+    """Spans the CURRENT recorder lost to overflow (0 when off) — the
+    analyzer's degraded-verdict input: a past run's retired overflow
+    must not degrade this run's analysis."""
+    tr = _tracer
+    return tr.dropped() if tr is not None else 0
+
+
 def span(name: str, cat: str = "", corr: str | None = None,
          args: dict | None = None):
     """Open a span: ``with trace.span("ps.fold"): ...``. Returns the
@@ -269,6 +295,22 @@ def instant(name: str, cat: str = "", corr: str | None = None,
     t = time.perf_counter_ns()
     tr._record(st, name, cat, corr if corr is not None else st.corr,
                t, 0, args)
+
+
+def counter(name: str, value, t_ns: int | None = None) -> None:
+    """Record one counter sample (ISSUE 14 satellite): ``save()`` emits
+    these as Chrome ``ph: "C"`` counter-track records so sampled gauges
+    — DynSGD τ p95, shm ring occupancy, serving rows in flight — render
+    as graphs alongside the spans in Perfetto. Never sampled out
+    (a decimated counter track lies about its own shape); no-op when
+    tracing is off."""
+    tr = _tracer
+    if tr is None:
+        return
+    st = tr._state()
+    t = time.perf_counter_ns() if t_ns is None else int(t_ns)
+    tr._record(st, name, COUNTER_CAT, None, t, 0, float(value),
+               sampled=False)
 
 
 def set_corr(corr: str | None) -> None:
@@ -310,21 +352,64 @@ def add_events(evs: list[dict]) -> None:
     tr.add_events(shaped)
 
 
-def events() -> list[dict]:
-    """All recorded events (see :meth:`Tracer.events`); ``[]`` when off."""
+def events(min_end_ns: int | None = None) -> list[dict]:
+    """All recorded events (see :meth:`Tracer.events`); ``[]`` when
+    off. ``min_end_ns`` is the incremental consumer's cursor filter."""
     tr = _tracer
     if tr is None:
         return []
-    return tr.events()
+    return tr.events(min_end_ns)
 
 
-def save(path: str) -> str:
+def open_maybe_gz(path: str):
+    """Open a JSON document that may be gzipped — sniffed by magic
+    bytes, not suffix, so rotated/renamed files read transparently.
+    Shared by every observability reader (trace analysis, the
+    timeseries store, the CLI)."""
+    with open(path, "rb") as f:
+        magic = f.read(2)
+    if magic == b"\x1f\x8b":
+        return gzip.open(path, "rt")
+    return open(path)
+
+
+def load_json_maybe_gz(path: str) -> dict:
+    with open_maybe_gz(path) as f:
+        return json.load(f)
+
+
+def rotate_files(path: str, max_bytes: int, keep: int = 3) -> None:
+    """Size-capped rotation (ISSUE 14 satellite): when ``path`` already
+    holds ``max_bytes`` or more, shift ``path`` → ``path.1`` →
+    ``path.2`` … keeping at most ``keep`` rotated generations — a long
+    watched run re-saving its timeline keeps bounded history instead of
+    growing one file forever (or silently overwriting it)."""
+    if keep < 1 or not os.path.exists(path) \
+            or os.path.getsize(path) < max_bytes:
+        return
+    oldest = f"{path}.{keep}"
+    if os.path.exists(oldest):
+        os.remove(oldest)
+    for k in range(keep - 1, 0, -1):
+        src = f"{path}.{k}"
+        if os.path.exists(src):
+            os.replace(src, f"{path}.{k + 1}")
+    os.replace(path, f"{path}.1")
+
+
+def save(path: str, max_bytes: int | None = None, keep: int = 3) -> str:
     """Write everything recorded so far as Chrome trace-event JSON
     (``{"traceEvents": [...]}``, complete-event ``ph: "X"`` records with
-    µs timestamps) — drag the file into https://ui.perfetto.dev or
-    ``chrome://tracing``. Parent directories are created. Returns
-    ``path``. Raises RuntimeError when tracing is off (nothing to save —
-    a silent empty file would read as "traced, nothing happened")."""
+    µs timestamps, counter samples as ``ph: "C"`` tracks) — drag the
+    file into https://ui.perfetto.dev or ``chrome://tracing``. A path
+    ending in ``.gz`` is gzip-compressed (the long-run growth fix;
+    ``dump``/``analyze`` read both formats transparently), and
+    ``max_bytes`` rotates an existing file first (see
+    :func:`rotate_files`). ``otherData`` carries the dropped-span count
+    and this host's core count — the analyzer's host-honest
+    denominator. Parent directories are created. Returns ``path``.
+    Raises RuntimeError when tracing is off (nothing to save — a silent
+    empty file would read as "traced, nothing happened")."""
     tr = _tracer
     if tr is None:
         raise RuntimeError("tracing is not enabled: nothing to save")
@@ -342,6 +427,13 @@ def save(path: str) -> str:
                 "name": "thread_name", "ph": "M", "pid": pid,
                 "tid": e["tid"], "args": {"name": e["tname"]},
             })
+        if e["cat"] == COUNTER_CAT:
+            out.append({
+                "name": e["name"], "ph": "C", "ts": e["t0_ns"] / 1e3,
+                "pid": pid, "tid": e["tid"],
+                "args": {"value": e["args"]},
+            })
+            continue
         args = dict(e["args"]) if e["args"] else {}
         if e["corr"] is not None:
             args["corr"] = e["corr"]
@@ -352,10 +444,17 @@ def save(path: str) -> str:
         })
     parent = os.path.dirname(os.path.abspath(path))
     os.makedirs(parent, exist_ok=True)
-    with open(path, "w") as f:
-        json.dump({
-            "traceEvents": out,
-            "displayTimeUnit": "ms",
-            "otherData": {"dropped_events": tr.dropped()},
-        }, f)
+    if max_bytes is not None:
+        rotate_files(path, int(max_bytes), keep=keep)
+    doc = {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "dropped_events": tr.dropped(),
+            "host_cores": os.cpu_count() or 1,
+        },
+    }
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "wt") as f:
+        json.dump(doc, f)
     return path

@@ -45,9 +45,10 @@ from distkeras_tpu.observability.timeseries import (
 __all__ = [
     "Alert", "AlertRule", "TauP95Rule", "CommitSkewRule",
     "CommitReplaySpikeRule", "WalFsyncTailRule", "RingOccupancyRule",
-    "ServingSLORule", "LossStallRule", "SLOClass", "default_rules",
-    "Watchdog", "Watchtower", "rates_from_counts", "worker_rates",
-    "rounds_per_sec", "straggler_workers", "watch_endpoint",
+    "ServingSLORule", "LossStallRule", "BottleneckShiftRule", "SLOClass",
+    "default_rules", "Watchdog", "Watchtower", "rates_from_counts",
+    "worker_rates", "rounds_per_sec", "straggler_workers",
+    "watch_endpoint",
 ]
 
 
@@ -390,11 +391,57 @@ class LossStallRule(AlertRule):
         }
 
 
+class BottleneckShiftRule(AlertRule):
+    """The analyst's online twin (ISSUE 14): fires when the DOMINANT
+    regime changes mid-run — the ``analyze.regime_code`` series (fed by
+    :func:`distkeras_tpu.observability.analyze.regime_source` on traced
+    watched runs) stops agreeing with the regime that has held for most
+    of the run so far. A run that starts compute-bound and turns
+    fsync-bound mid-flight is a disk filling its cache, a log device
+    degrading, a straggler arriving — exactly the transition an
+    operator wants paged on, and one no level-threshold rule can see.
+    Resolves when the newest samples return to the run's dominant
+    regime."""
+
+    kind = "bottleneck_shift"
+
+    def __init__(self, min_points: int = 4, **kw):
+        kw.setdefault("persistence", 2)
+        super().__init__(**kw)
+        self.min_points = int(min_points)
+
+    def check(self, store, now):
+        from distkeras_tpu.observability.analyze import REGIMES
+
+        s = store.get("analyze.regime_code")
+        pts = s.points() if s is not None else []
+        if len(pts) < self.min_points:
+            return None, None, None
+        codes = [int(v) for _, v in pts]
+        cur = codes[-1]
+        # the run's dominant regime: the mode of everything BEFORE the
+        # newest sample (so a genuine shift doesn't out-vote itself
+        # only after half the run)
+        prior = codes[:-1]
+        dominant = max(set(prior), key=prior.count)
+        firing = cur != dominant
+
+        def name(c):
+            return REGIMES[c] if 0 <= c < len(REGIMES) else str(c)
+
+        return firing, float(cur), {
+            "from": name(dominant), "to": name(cur),
+            "samples": len(codes),
+        }
+
+
 def default_rules(slo: dict | None = None,
                   tau_bound: float = 16.0) -> list[AlertRule]:
     """The standard rule set — what ``watch=True`` installs. Serving
     rules only judge classes with data, PS rules only servers with the
-    matching series, so one set covers training and serving runs."""
+    matching series (the bottleneck-shift rule needs a traced watched
+    run to feed its regime series), so one set covers training and
+    serving runs."""
     return [
         TauP95Rule(bound=tau_bound),
         CommitSkewRule(),
@@ -403,6 +450,7 @@ def default_rules(slo: dict | None = None,
         RingOccupancyRule(),
         ServingSLORule(slo=slo),
         LossStallRule(),
+        BottleneckShiftRule(),
     ]
 
 

@@ -700,7 +700,10 @@ class GenerationEngine:
         active = [b for b, s in enumerate(self._slots) if s is not None]
         if not active:
             return bool(admitted)
-        _args = {"batch": len(active)} if _trace.enabled() else None
+        # rows-in-flight rides the span (ISSUE 14): the analyzer's
+        # batch-occupancy input ("batch" kept for older readers)
+        _args = ({"batch": len(active), "rows": len(active)}
+                 if _trace.enabled() else None)
         with _trace.span("serve.decode_step", args=_args):
             if self._spec_fn is not None:
                 self._spec_step(active)

@@ -547,6 +547,7 @@ class DistributedTrainer(Trainer):
                  trace: bool = False,
                  trace_dir=None,
                  trace_sample: float = 1.0,
+                 analyze: bool = False,
                  watch: bool = False,
                  watch_rules=None,
                  watch_dir=None,
@@ -726,19 +727,27 @@ class DistributedTrainer(Trainer):
         # trace_path_); trace_sample keeps a deterministic fraction of
         # spans. PS backend only — the collective backend's device
         # timeline is profile_dir's job (jax.profiler).
-        self.trace = bool(trace) or trace_dir is not None
+        # analyze=True (ISSUE 14): run the post-hoc critical-path
+        # analyzer over the recorded spans after the run — implies
+        # trace=True (there is nothing to analyze without the flight
+        # recorder); the report lands in analysis_. Strictly post-hoc:
+        # the hot path pays only the tracing it already opted into.
+        self.analyze = bool(analyze)
+        self.trace = bool(trace) or trace_dir is not None or self.analyze
         self.trace_dir = trace_dir
         self.trace_sample = float(trace_sample)
         if self.trace and backend != "ps":
             raise ValueError(
-                "trace/trace_dir apply to backend='ps' only (use "
-                "profile_dir for the collective backend's XLA timeline)"
+                "trace/trace_dir/analyze apply to backend='ps' only "
+                "(use profile_dir for the collective backend's XLA "
+                "timeline)"
             )
         if not 0.0 < self.trace_sample <= 1.0:
             raise ValueError(
                 f"trace_sample must be in (0, 1], got {trace_sample}"
             )
         self.trace_path_ = None
+        self.analysis_ = None
         # The watchtower (ISSUE 13, distkeras_tpu/observability/watch):
         # continuous time-series telemetry + the SLO/anomaly watchdog.
         # watch=True runs the background scraper at scrape_interval

@@ -185,6 +185,23 @@ class AsyncWorker:
         # so the phase spans (and, via the wire frame / seqno, the PS's
         # fold+WAL spans) stitch into one timeline per exchange
         self._xid = 0
+        # dispatch timestamp of the in-flight window's compute (ISSUE
+        # 14): set at window_fn dispatch, closed into a worker.compute
+        # span at fetch-return — the analyzer's overlap/compute
+        # evidence. Only written while tracing is on (off path stays
+        # allocation-free).
+        self._t_launch: float | None = None
+
+    def _record_compute(self, t_end: float) -> None:
+        """Close the window's dispatch→fetch-return ``worker.compute``
+        span (the interval the device had this window's work
+        outstanding — in the pipelined loop the exchange hides inside
+        it, which is exactly what the analyzer measures). Call only
+        when tracing is enabled."""
+        if self._t_launch is not None:
+            _trace.record("worker.compute",
+                          int(self._t_launch * 1e9), int(t_end * 1e9))
+            self._t_launch = None
 
     def _compress(self, tree, owned: bool = False):
         """→ (wire payload, transmitted tree); updates the residual.
@@ -399,6 +416,8 @@ class AsyncWorker:
                     for c in shard_cols
                 )
                 batches = jax.device_put(batches, self.device)
+                if _trace.enabled():
+                    self._t_launch = time.perf_counter()
                 params, nt, opt, loss = self.window_fn(params, nt, opt, batches)
                 params, center = self._exchange_window(
                     params, center, loss, epoch, elastic
@@ -447,6 +466,8 @@ class AsyncWorker:
             t0 = self._phase("pull", t0)
             host_params = utils.tree_to_numpy(params)
             t0 = self._phase("fetch", t0)
+            if _trace.enabled():
+                self._record_compute(t0)
             diff = self.rule.worker_commit(host_params, center)
             blob, sent = self._compress(diff)
             t0 = self._phase("compress", t0)
@@ -463,6 +484,8 @@ class AsyncWorker:
             t0 = time.perf_counter()
             delta = self._window_delta(params, center)
             t0 = self._phase("fetch", t0)
+            if _trace.enabled():
+                self._record_compute(t0)
             blob, _ = self._compress(delta, owned=True)
             self._phase("compress", t0)
             center = self._do_exchange(blob)
@@ -538,6 +561,8 @@ class AsyncWorker:
                 )
                 batches = jax.device_put(batches, self.device)
                 # async dispatch: the device starts this window NOW...
+                if _trace.enabled():
+                    self._t_launch = time.perf_counter()
                 params, nt, opt, loss = self.window_fn(
                     params, nt, opt, batches
                 )
@@ -550,6 +575,8 @@ class AsyncWorker:
                 t0 = time.perf_counter()
                 delta = self._window_delta(params, base)
                 t0 = self._phase("fetch", t0)
+                if _trace.enabled():
+                    self._record_compute(t0)
                 blob, sent = self._compress(delta, owned=True)
                 self._phase("compress", t0)
                 base = self._rebase_host(center, sent)
@@ -637,6 +664,8 @@ class AsyncWorker:
                     for c in cols
                 )
                 batches = jax.device_put(batches, self.device)
+                if _trace.enabled():
+                    self._t_launch = time.perf_counter()
                 params, nt, opt, loss = self.window_fn(
                     params, nt, opt, batches
                 )
@@ -737,6 +766,8 @@ class AsyncWorker:
                     for c in cols
                 )
                 batches = jax.device_put(batches, self.device)
+                if _trace.enabled():
+                    self._t_launch = time.perf_counter()
                 params, nt, opt, loss = self.window_fn(
                     params, nt, opt, batches
                 )
@@ -749,6 +780,8 @@ class AsyncWorker:
                 t0 = time.perf_counter()
                 delta = self._window_delta(params, base)
                 t0 = self._phase("fetch", t0)
+                if _trace.enabled():
+                    self._record_compute(t0)
                 blob, sent = self._compress(delta, owned=True)
                 self._phase("compress", t0)
                 base = self._rebase_host(center, sent)
@@ -1300,6 +1333,13 @@ def run_async_training(trainer, ds, shuffle: bool):
                 if hasattr(srv, "watchtower"):
                     srv.watchtower = watchtower
         watchtower.add_history(history, hlock)
+        if trace_on:
+            # the analyst's online shadow (ISSUE 14): classify the
+            # recorder's recent spans each scrape tick into the
+            # analyze.regime_code series — BottleneckShiftRule's input
+            from distkeras_tpu.observability.analyze import regime_source
+
+            watchtower.add_source("regime", regime_source())
         # ownership for crash paths (same contract as _trace_owner_):
         # trainers._train_ps stops a scraper the failed run left behind
         trainer._watchtower_active_ = watchtower
@@ -1700,6 +1740,28 @@ def run_async_training(trainer, ds, shuffle: bool):
         trainer.trace_path_ = _trace.save(_os.path.join(
             trace_dir, f"ps-trace-{_os.getpid()}-{time.time_ns()}.json"
         ))
+    trainer.analysis_ = None
+    if trace_on and bool(getattr(trainer, "analyze", False)):
+        # the analyst (ISSUE 14): strictly post-hoc — the run is over,
+        # the recorder still holds every span (native rings already
+        # scraped above), the watchtower store contributes its counter
+        # series. A diagnosis failure must never fail the run it
+        # describes.
+        from distkeras_tpu.observability import analyze as _analyze
+
+        try:
+            trainer.analysis_ = _analyze.analyze_events(
+                _trace.events(), dropped=_trace.live_dropped(),
+                store=watchtower.store if watchtower is not None
+                else None,
+            )
+        except Exception as e:  # noqa: BLE001 — diagnosis is best-effort
+            import warnings
+
+            warnings.warn(
+                f"post-run trace analysis failed "
+                f"({type(e).__name__}: {e})", stacklevel=2,
+            )
     if trace_owner:
         _trace.disable()
         trainer._trace_owner_ = False

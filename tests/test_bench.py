@@ -258,23 +258,27 @@ def test_regress_bench_smoke_clean_and_synthetic_slowdown(tmp_path):
     passes against its own clean repeats; the synthetic-slowdown seam
     (a REAL injected sleep) is flagged. Empty glob -> the clean repeats
     are the whole baseline, exactly the trajectory-seeding path."""
-    # rel_slack loosened to 25% for the in-suite smoke: the suite's own
-    # load jitters this box well past the guard's 12% default (which CI
-    # runs with the step alone); the injected 1.0 slowdown halves
-    # throughput — far outside either slack
+    # rel_slack loosened to 35% for the in-suite smoke (ISSUE 14
+    # jitter-hardening): the suite's own load jitters this box well
+    # past the guard's 12% default (which CI runs with the step alone)
+    # — the known ±15% suite-load envelope lands on top of the clean
+    # repeats' own spread (the watched-fused-dip class of flake), so
+    # the slack budgets both. The injected slowdown therefore grows to
+    # 2.0 ms/round (a measured ~−50% at this round size — a 1.0
+    # injection came back −34% in-suite, INSIDE the widened slack).
     rec = bench.run_regress_bench(
         repeats=2, seconds=0.3, n_params=16_384, slowdown=0.0,
         glob_pat="NO_SUCH_BENCH_*.json", root=str(tmp_path),
-        rel_slack=0.25,
+        rel_slack=0.35,
     )
     assert rec["verdict"] == "ok", rec["checks"]
     assert rec["trajectory_files"] == 0
     keys = {c["key"] for c in rec["checks"]}
     assert "fused_rounds_per_sec" in keys
     slow = bench.run_regress_bench(
-        repeats=2, seconds=0.3, n_params=16_384, slowdown=1.0,
+        repeats=2, seconds=0.3, n_params=16_384, slowdown=2.0,
         glob_pat="NO_SUCH_BENCH_*.json", root=str(tmp_path),
-        rel_slack=0.25,
+        rel_slack=0.35,
     )
     assert slow["verdict"] == "regression", slow["checks"]
     flagged = {c["key"] for c in slow["checks"]

@@ -400,7 +400,11 @@ def test_graceful_drain_completes_inflight(lm):
     out = {}
 
     def go():
-        out["toks"] = c.generate(np.ones(8, np.int32), max_new_tokens=20)
+        try:
+            out["toks"] = c.generate(np.ones(8, np.int32),
+                                     max_new_tokens=20)
+        except Exception as e:  # surfaced below, not a bare KeyError
+            out["err"] = e
 
     t = threading.Thread(target=go)
     t.start()
@@ -409,7 +413,14 @@ def test_graceful_drain_completes_inflight(lm):
     while time.monotonic() < deadline and eng.stats()["active"] == 0:
         time.sleep(0.01)
     srv.stop(drain=True)
-    t.join(10)
+    # generous join + surfaced client error: under full-suite load the
+    # in-flight request's decode (plus any jit compile it triggers) has
+    # been seen to outlast 10 s — a silent join timeout or a swallowed
+    # client exception then reads as a bogus KeyError on out["toks"]
+    # (ISSUE 14 jitter-hardening pass)
+    t.join(60)
+    assert not t.is_alive(), "drained request never completed"
+    assert "err" not in out, out.get("err")
     assert out["toks"].shape == (20,)
     with pytest.raises(networking.ServerBusyError):
         eng.submit(np.ones(4, np.int32))

@@ -612,9 +612,16 @@ def _watch_trainer(plan, tmp_path, rules, workers=4, epochs=3,
 
 
 def _acceptance_rules():
+    # thresholds jitter-hardened to the known ±15% suite-load envelope
+    # (ISSUE 14 satellite): the clean run's τ p95 has been observed up
+    # to ~8 under full-suite GIL scramble (bound raised 8→12 keeps the
+    # straggler's τ≈30+ firing with big headroom while the clean run
+    # stays quiet), and the skew ratio 0.3→0.35 keeps the straggler
+    # below threshold even when suite load halves the healthy median
+    # (a clean run's slowest/median stays ≥ ~0.7, 2× above 0.35)
     return [
-        TauP95Rule(bound=8.0),
-        CommitSkewRule(ratio=0.3, window_s=3.0, min_rounds=4,
+        TauP95Rule(bound=12.0),
+        CommitSkewRule(ratio=0.35, window_s=3.0, min_rounds=4,
                        persistence=1),
         CommitReplaySpikeRule(max_in_window=0.5, window_s=6.0),
         WalFsyncTailRule(p95_ms=10_000.0),
