@@ -1,0 +1,48 @@
+"""Membership directory & routing: one cluster across hosts (ISSUE 15).
+
+A small replicated coordination service mapping ``(role, key)`` —
+``("ps", "shard-01")``, ``("serve", replica)``, ``("shm", segment)`` —
+to ``(endpoint, fence epoch, lease)``:
+
+- :class:`DirectoryServer` / :class:`StandbyDirectoryServer` — the
+  WAL-backed, chain-replicated service (``service.py``);
+- :class:`DirectoryClient` / :class:`DirectoryEndpoint` /
+  :func:`build_ps_client` — discovery: a joiner builds its whole
+  sharded PS client from a lookup, and failover re-resolves through
+  the directory (``client.py``);
+- :class:`RoutedGenerationClient` — prefix-hash cache-affine serving
+  router with health-gated failover (``router.py``);
+- :class:`HostedDirectory` — the trainer-side hosting/registration
+  bundle behind the ``directory=`` knob (``host.py``).
+"""
+
+from distkeras_tpu.directory.client import (
+    DirectoryClient,
+    DirectoryEndpoint,
+    build_ps_client,
+    install_shm_rendezvous,
+    parse_seeds,
+)
+from distkeras_tpu.directory.host import HostedDirectory
+from distkeras_tpu.directory.router import (
+    RoutedGenerationClient,
+    prefix_route_key,
+)
+from distkeras_tpu.directory.service import (
+    DirectoryServer,
+    DirectoryState,
+    StandbyDirectoryServer,
+    apply_directory_record,
+    directory_state_dict,
+    recover_directory_state,
+)
+
+__all__ = [
+    "DirectoryServer", "StandbyDirectoryServer", "DirectoryState",
+    "apply_directory_record", "directory_state_dict",
+    "recover_directory_state",
+    "DirectoryClient", "DirectoryEndpoint", "build_ps_client",
+    "install_shm_rendezvous", "parse_seeds",
+    "RoutedGenerationClient", "prefix_route_key",
+    "HostedDirectory",
+]

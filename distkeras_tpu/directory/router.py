@@ -1,0 +1,320 @@
+"""Cache-affine generation router: spread clients across serving replicas.
+
+The serving tier (PR 6) scales out by running N ``GenerationServer``
+replicas, but until now every client was hand-pointed at one of them.
+:class:`RoutedGenerationClient` spreads requests across the registered
+replicas with **prefix-hash cache affinity**: the route key is a pinned
+hash of the prompt's first ``prefix_tokens`` tokens, placed on a
+consistent-hash ring over the replica set (the ``sharding/ring.py``
+machinery — same pinned ``blake2b``, same successor-walk idiom), so
+requests sharing a prompt prefix land on the SAME replica and its
+paged-KV/prefix cache actually gets to reuse them, while distinct
+prefixes spread by hash. Replica churn moves only ~1/N of the keyspace
+(consistent hashing), so a scale-out event doesn't flush every cache.
+
+Failover is health-gated: a replica that answers
+:class:`~distkeras_tpu.networking.ServerBusyError` or dies mid-stream is
+put in a cooldown and the request replays on the next ring successor
+(generation is one idempotent request/response — a fixed seed makes the
+replayed stream identical), under the standard retry/backoff policy.
+A killed replica therefore DRAINS: its in-flight clients fail over and
+complete on the survivors, and new requests stop routing to it until it
+comes back and answers a probe.
+
+Replicas come from an explicit list or from a directory lookup (role
+``serve`` — see :class:`~distkeras_tpu.directory.DirectoryClient`),
+refreshed on demand so registrations and expirations repoint the router
+without restarting any client.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_left
+from typing import Iterable
+
+import numpy as np
+
+from distkeras_tpu.networking import ProtocolError, ServerBusyError
+from distkeras_tpu.sharding.ring import stable_hash
+
+__all__ = ["RoutedGenerationClient", "prefix_route_key"]
+
+
+def prefix_route_key(prompt, prefix_tokens: int = 16) -> int:
+    """The pinned route key: a ``blake2b`` hash (``sharding.ring.
+    stable_hash`` — never the salted builtin) of the prompt's first
+    ``prefix_tokens`` token ids, so every process routes a shared
+    system-prompt workload identically."""
+    head = np.asarray(prompt).reshape(-1)[: int(prefix_tokens)]
+    ids = ",".join(str(int(t)) for t in head)
+    return stable_hash(f"prefix:{ids}")
+
+
+class _ReplicaRing:
+    """Consistent-hash ring over replica keys (strings), with the same
+    vnode smoothing and distinct-successor walk as ``sharding.ring.
+    HashRing`` — generalized from shard ids to replica names so churn
+    moves ~1/N of prefixes, not all of them."""
+
+    def __init__(self, keys: Iterable[str], vnodes: int = 64):
+        pts = sorted(
+            (stable_hash(f"replica:{k}/vnode:{v}"), k)
+            for k in keys for v in range(int(vnodes))
+        )
+        self._hashes = [h for h, _ in pts]
+        self._owners = [k for _, k in pts]
+        self._distinct = sorted(set(self._owners))
+
+    def successors(self, h: int):
+        n = len(self._hashes)
+        if n == 0:
+            return
+        seen: set[str] = set()
+        i = bisect_left(self._hashes, h)
+        for step in range(n):
+            key = self._owners[(i + step) % n]
+            if key not in seen:
+                seen.add(key)
+                yield key
+                if len(seen) == len(self._distinct):
+                    return
+
+
+class RoutedGenerationClient:
+    """Prefix-affine, health-gated front door over N GenerationServers.
+
+    ``replicas`` is ``{key: (host, port)}`` (or a list of ``(host,
+    port)`` pairs, keyed ``host:port``); alternatively pass
+    ``directory=`` (a :class:`DirectoryClient` or seed list) and the
+    replica set is the directory's ``serve`` role, refreshed whenever a
+    route comes up empty or every ``refresh_interval`` seconds.
+
+    Thread-safe: concurrent callers share the per-replica connections
+    behind per-replica locks (the generation protocol is strictly
+    request/response, so a connection serves one request at a time and
+    concurrent same-replica callers queue on its lock).
+    """
+
+    def __init__(self, replicas=None, directory=None, *,
+                 prefix_tokens: int = 16, vnodes: int = 64,
+                 policy=None, cooldown: float = 1.0,
+                 refresh_interval: float = 2.0,
+                 connect_timeout: float = 5.0):
+        from distkeras_tpu.directory.client import DirectoryClient
+        from distkeras_tpu.resilience.retry import RetryPolicy
+
+        if (replicas is None) == (directory is None):
+            raise ValueError(
+                "pass exactly one of replicas= (explicit endpoints) or "
+                "directory= (discover the 'serve' role)"
+            )
+        self.directory = None
+        if directory is not None:
+            self.directory = (directory
+                              if isinstance(directory, DirectoryClient)
+                              else DirectoryClient(directory))
+        self.prefix_tokens = int(prefix_tokens)
+        self.vnodes = int(vnodes)
+        self.policy = policy if policy is not None else RetryPolicy(
+            max_attempts=40, base_delay=0.02, max_delay=0.4, deadline=60.0,
+        )
+        self.cooldown = float(cooldown)
+        self.refresh_interval = float(refresh_interval)
+        self.connect_timeout = float(connect_timeout)
+        self._lock = threading.Lock()
+        self._replicas: dict[str, tuple[str, int]] = {}
+        self._ring: _ReplicaRing | None = None
+        self._conns: dict[str, object] = {}
+        self._conn_locks: dict[str, threading.Lock] = {}
+        self._down_until: dict[str, float] = {}
+        self._last_refresh = 0.0
+        self._calls = 0
+        self.routed: dict[str, int] = {}   # per-replica request counts
+        self.failovers = 0
+        if replicas is not None:
+            if not isinstance(replicas, dict):
+                replicas = {
+                    f"{h}:{p}": (h, int(p)) for h, p in replicas
+                }
+            self._install(replicas)
+        else:
+            self.refresh(force=True)
+
+    # -- replica set ---------------------------------------------------------
+
+    def _install(self, replicas: dict[str, tuple[str, int]]) -> None:
+        with self._lock:
+            gone = set(self._replicas) - set(replicas)
+            self._replicas = dict(replicas)
+            self._ring = _ReplicaRing(self._replicas, vnodes=self.vnodes)
+            for key in gone:
+                conn = self._conns.pop(key, None)
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                self._down_until.pop(key, None)
+
+    def refresh(self, force: bool = False) -> None:
+        """Re-read the replica set from the directory (no-op for the
+        explicit-list router). A replica whose lease expired drops out
+        of the ring; a new registration joins it."""
+        if self.directory is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_refresh \
+                    < self.refresh_interval:
+                return
+            self._last_refresh = now
+        entries = self.directory.lookup("serve")
+        self._install({
+            e["key"]: (e["host"], int(e["port"])) for e in entries
+        })
+
+    @property
+    def replicas(self) -> dict[str, tuple[str, int]]:
+        with self._lock:
+            return dict(self._replicas)
+
+    # -- routing -------------------------------------------------------------
+
+    def _route_order(self, prompt) -> list[str]:
+        h = prefix_route_key(prompt, self.prefix_tokens)
+        now = time.monotonic()
+        with self._lock:
+            if self._ring is None:
+                return []
+            order = list(self._ring.successors(h))
+            healthy = [k for k in order
+                       if self._down_until.get(k, 0.0) <= now]
+        # every replica cooling down: route anyway (the retry policy's
+        # backoff is the wait — a router must degrade, not deadlock)
+        return healthy or order
+
+    def _conn(self, key: str):
+        from distkeras_tpu.serving.server import GenerationClient
+
+        with self._lock:
+            conn = self._conns.get(key)
+            lock = self._conn_locks.setdefault(key, threading.Lock())
+            endpoint = self._replicas.get(key)
+        if endpoint is None:
+            # a concurrent refresh dropped this replica between routing
+            # and connecting: retryable weather — the caller moves to
+            # the next ring successor, not a crash
+            raise ProtocolError(
+                f"serving replica {key!r} left the directory",
+                retryable=True,
+            )
+        host, port = endpoint
+        if conn is None:
+            conn = GenerationClient(host, port,
+                                    connect_timeout=self.connect_timeout)
+            with self._lock:
+                # a racing builder won: use theirs, close ours
+                live = self._conns.get(key)
+                if live is None:
+                    self._conns[key] = conn
+                else:
+                    conn.close()
+                    conn = live
+        return conn, lock
+
+    def _mark_down(self, key: str) -> None:
+        with self._lock:
+            self._down_until[key] = time.monotonic() + self.cooldown
+            conn = self._conns.pop(key, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def generate(self, prompt, **kw) -> np.ndarray:
+        """Route one request by prefix affinity; on backpressure or a
+        dead replica, fail over to the next ring successor under the
+        retry policy's jittered backoff. Raises the last failure when
+        the policy's deadline/attempts lapse with no replica serving."""
+        from distkeras_tpu.resilience.retry import (
+            RetryDeadlineExceeded,
+            is_retryable,
+        )
+
+        with self._lock:
+            self._calls += 1
+            salt = self._calls
+        delays = self.policy.delays(salt)
+        t0 = time.monotonic()
+        attempt = 0
+        last: BaseException | None = None
+        while True:
+            order = self._route_order(prompt)
+            if not order:
+                self.refresh(force=True)
+                order = self._route_order(prompt)
+            err = None
+            for key in order:
+                try:
+                    conn, lock = self._conn(key)
+                    with lock:
+                        out = conn.generate(prompt, **kw)
+                    with self._lock:
+                        self.routed[key] = self.routed.get(key, 0) + 1
+                    return out
+                except ServerBusyError as e:
+                    # healthy but full: brief cooldown steers the next
+                    # requests to a sibling; this one tries the next
+                    # successor immediately
+                    self._mark_down(key)
+                    err = e
+                except BaseException as e:  # noqa: BLE001 — triaged below
+                    if isinstance(e, ProtocolError) and not e.retryable:
+                        raise
+                    if not is_retryable(e):
+                        raise
+                    self._mark_down(key)
+                    err = e
+                with self._lock:
+                    self.failovers += 1
+            last = err if err is not None else last
+            attempt += 1
+            if attempt >= self.policy.max_attempts:
+                raise RetryDeadlineExceeded(
+                    f"no serving replica answered after {attempt} "
+                    f"route attempts: {last}"
+                ) from last
+            delay = delays.next_delay()
+            if time.monotonic() - t0 + delay > self.policy.deadline:
+                raise RetryDeadlineExceeded(
+                    f"routing deadline of {self.policy.deadline}s "
+                    f"exceeded: {last}"
+                ) from last
+            time.sleep(delay)
+            self.refresh(force=True)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "replicas": {k: list(v)
+                             for k, v in self._replicas.items()},
+                "routed": dict(self.routed),
+                "failovers": self.failovers,
+                "cooling": sorted(
+                    k for k, t in self._down_until.items()
+                    if t > time.monotonic()
+                ),
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass

@@ -466,7 +466,7 @@ _MEMBERSHIP_KEYS = (
 def health_snapshot(wal_root: str | None = None,
                     ps_stats: dict | None = None,
                     serving_stats: dict | None = None,
-                    watchtower=None) -> dict:
+                    watchtower=None, directory=None) -> dict:
     """ONE JSON health document: WAL health (``verify_tree`` — CRC-valid
     prefixes, torn tails, record totals), the normalized metrics
     snapshot, the membership gauges, the flight recorder's overflow
@@ -506,6 +506,15 @@ def health_snapshot(wal_root: str | None = None,
     from distkeras_tpu import shm as _shm
 
     out["shm"] = _shm.segment_inventory()
+    if directory is not None:
+        # membership-directory view (ISSUE 15): per-entry endpoint,
+        # fence epoch, and lease age — an out-of-date registration or a
+        # lapsing lease is operator-visible, not silent. Accepts the
+        # membership dict itself or anything with .membership()
+        # (DirectoryServer, DirectoryClient, HostedDirectory).
+        view = (directory.membership()
+                if hasattr(directory, "membership") else directory)
+        out["directory"] = _json_clean(view)
     if watchtower is not None:
         alerts = (watchtower.alerts_json()
                   if hasattr(watchtower, "alerts_json") else watchtower)

@@ -105,6 +105,21 @@ class FaultPlan:
       kill-one-shard chaos: that shard fails over while its siblings
       keep folding, and the exactly-once oracle must hold per shard.
 
+    Membership-directory faults (consulted by the ``DirectoryServer`` —
+    distkeras_tpu/directory — once per handled op on the PRIMARY):
+
+    - ``kill_directory_after_ops``: crash-stop the directory primary
+      (``_crash()``: connections torn, WAL abandoned) once it has
+      handled this many ops — deterministic in op count. Fires once;
+      the directory failover supervisor then proves the promotion, and
+      every consumer's next lookup re-probes the seeds onto the
+      promoted replica. Requires ``directory=True`` on the trainer.
+    - ``directory_partition_after`` / ``directory_partition_ops``:
+      after N directory ops, the next K all drop (torn connection to
+      the caller) — a deterministic directory partition window. The
+      training hot path must ride it out untouched: the directory is
+      consulted only at build/reconnect time.
+
     ``max_faults`` caps drops+partition hits (delays excluded) so runs
     terminate; ``stats()`` reports what was actually injected.
     """
@@ -119,7 +134,10 @@ class FaultPlan:
                  kill_ps_after_commits: int | None = None,
                  kill_shard_id: int | None = None,
                  join_worker_at_window: dict[int, int] | None = None,
-                 preempt_worker_at_window: dict[int, int] | None = None):
+                 preempt_worker_at_window: dict[int, int] | None = None,
+                 kill_directory_after_ops: int | None = None,
+                 directory_partition_after: int | None = None,
+                 directory_partition_ops: int = 0):
         for name, p in (("drop_send", drop_send), ("drop_recv", drop_recv),
                         ("delay", delay)):
             if not 0.0 <= p <= 1.0:
@@ -154,6 +172,15 @@ class FaultPlan:
         )
         self.join_worker_at_window = dict(join_worker_at_window or {})
         self.preempt_worker_at_window = dict(preempt_worker_at_window or {})
+        self.kill_directory_after_ops = (
+            None if kill_directory_after_ops is None
+            else int(kill_directory_after_ops)
+        )
+        self.directory_partition_after = (
+            None if directory_partition_after is None
+            else int(directory_partition_after)
+        )
+        self.directory_partition_ops = int(directory_partition_ops)
         self._rng = np.random.Generator(np.random.Philox(self.seed))
         self._lock = threading.Lock()
         self._ops = 0
@@ -161,6 +188,7 @@ class FaultPlan:
         self._joined: set[int] = set()
         self._preempted: set[int] = set()
         self._ps_killed = False
+        self._directory_killed = False
         self._n_drops = 0
         self._n_delays = 0
         self._n_partition_drops = 0
@@ -169,6 +197,9 @@ class FaultPlan:
         self._n_joins = 0
         self._n_preempts = 0
         self._n_ps_kills = 0
+        self._n_directory_ops = 0
+        self._n_directory_kills = 0
+        self._n_directory_drops = 0
 
     # -- wire hook (installed into networking._fault_hook) -------------------
 
@@ -280,6 +311,38 @@ class FaultPlan:
             self._ps_killed = True
             self._n_ps_kills += 1
 
+    # -- membership-directory hook (DirectoryServer) -------------------------
+
+    def take_directory_op(self) -> str:
+        """Consulted once per handled op on the directory PRIMARY:
+        ``"kill"`` exactly once when the op count crosses the kill
+        threshold, ``"drop"`` inside the partition window, else
+        ``"ok"``. Deterministic in op count — no wall clock, no rng."""
+        with self._lock:
+            self._n_directory_ops += 1
+            ops = self._n_directory_ops
+            if (self.kill_directory_after_ops is not None
+                    and not self._directory_killed
+                    and ops >= self.kill_directory_after_ops):
+                self._directory_killed = True
+                self._n_directory_kills += 1
+                return "kill"
+            if (self.directory_partition_after is not None
+                    and self.directory_partition_after < ops
+                    <= (self.directory_partition_after
+                        + self.directory_partition_ops)):
+                self._n_directory_drops += 1
+                return "drop"
+        return "ok"
+
+    @property
+    def has_directory_events(self) -> bool:
+        """Whether the plan carries directory faults (they need a hosted
+        directory — without ``directory=True`` nothing ever consults
+        them, so the chaos would silently test nothing)."""
+        return (self.kill_directory_after_ops is not None
+                or self.directory_partition_after is not None)
+
     # -- lifecycle -----------------------------------------------------------
 
     def install(self) -> None:
@@ -314,6 +377,9 @@ class FaultPlan:
                 "joins": self._n_joins,
                 "preempts": self._n_preempts,
                 "ps_kills": self._n_ps_kills,
+                "directory_ops": self._n_directory_ops,
+                "directory_kills": self._n_directory_kills,
+                "directory_drops": self._n_directory_drops,
             }
 
     @property

@@ -53,17 +53,23 @@ class PSFailoverSupervisor:
     reverse direction — someone must watch the PS. A daemon thread pings
     the primary over TCP every ``ping_interval``; ``failover_timeout``
     seconds without a successful ping declares it dead and runs the
-    failover, in this order:
+    failover, in this order (ISSUE 15 — promote, publish, THEN fence):
 
-    1. **fence** the superseded primary (best effort — usually it is
-       simply dead and the connect is refused): commits carrying its
-       epoch are rejected from here on, so a zombie that wakes up cannot
-       ACK folds into a history nobody serves anymore;
-    2. **promote**: the hot standby (``standby.promote(epoch+1)``) if
+    1. **promote**: the hot standby (``standby.promote(epoch+1)``) if
        one was attached, else ``restart_factory()`` — a fresh
        ``SocketParameterServer`` recovering (snapshot, wal) in place;
-    3. **repoint**: ``resolver.update(host, port, epoch+1)`` — every
-       worker's next reconnect re-resolves and adopts the new epoch.
+    2. **publish** (the atomic repoint): ``resolver.update(host, port,
+       epoch+1)`` writes endpoint and epoch as one lock-guarded triple,
+       and the membership-directory entry (when ``publish=`` is wired)
+       lands the same triple — every re-resolve from here on names the
+       new primary at the new epoch;
+    3. **fence** the superseded primary (best effort — usually it is
+       simply dead and the connect is refused; unconfirmed fences are
+       retried every tick): commits carrying its epoch are rejected
+       from here on, so a zombie that wakes up cannot ACK folds into a
+       history nobody serves anymore — and the worker it bounces
+       re-resolves onto an already-published successor instead of
+       spinning against a fenced endpoint.
 
     Restart-in-place shares the WAL directory with the old primary and
     therefore assumes the old process is really gone (the lease lapse is
@@ -76,11 +82,16 @@ class PSFailoverSupervisor:
     count crosses the threshold, then recovers from its own kill.
     """
 
+    #: what this supervisor watches (subclasses rename — the directory
+    #: supervisor reuses the whole machinery on its own wire surface)
+    _kind = "parameter server"
+
     def __init__(self, resolver, primary, standby=None,
                  restart_factory: Callable[[], Any] | None = None,
                  failover_timeout: float = 2.0,
                  ping_interval: float | None = None,
-                 fault_plan=None, max_failovers: int = 4):
+                 fault_plan=None, max_failovers: int = 4,
+                 publish: Callable[[str, int, int], None] | None = None):
         self.resolver = resolver
         self.active = primary
         # `standby` accepts one replica (the PR 5 hot standby) or a LIST —
@@ -115,6 +126,19 @@ class PSFailoverSupervisor:
         # of silently absorbing its still-connected workers' commits
         # into a superseded history forever
         self._pending_fences: list[tuple[str, int, int, dict]] = []
+        # Membership-directory publication (distkeras_tpu/directory,
+        # ISSUE 15): ``publish(host, port, epoch)`` writes this server's
+        # directory entry. Called at failover as part of the atomic
+        # repoint (publish-then-fence — see _failover_impl) and on every
+        # healthy ping as the entry's lease renewal, so a dead primary's
+        # registration ages out while a live one never does. A publish
+        # that fails (the directory itself failing over) goes on the
+        # pending list and is retried each watch tick — best-effort by
+        # design: the directory must never stall the PS failover it
+        # exists to advertise.
+        self._publish_cb = publish
+        self._pending_publish: tuple[str, int, int] | None = None
+        self.publishes = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -156,6 +180,17 @@ class PSFailoverSupervisor:
                 now = time.monotonic()
                 if info is not None and info.get("ok"):
                     deadline = now + self.failover_timeout
+                    if self._publish_cb is not None \
+                            and self._pending_publish is None:
+                        # a healthy ping renews the directory lease (an
+                        # identical re-publish is a renewal server-side);
+                        # best-effort — a directory mid-failover must not
+                        # stall this watch loop
+                        try:
+                            self._publish_cb(*self.resolver.resolve())
+                            self.publishes += 1
+                        except Exception:
+                            pass
                     plan = self.fault_plan
                     if plan is not None and plan.should_kill_ps(
                             int(info.get("num_updates", 0))):
@@ -175,6 +210,8 @@ class PSFailoverSupervisor:
                     deadline = time.monotonic() + self.failover_timeout
                 if self._pending_fences:
                     self._retry_pending_fences()
+                if self._pending_publish is not None:
+                    self._publish_now(*self._pending_publish)
                 self._stop.wait(self.ping_interval)
         except BaseException as e:  # surfaced by run_async_training
             self.error = e
@@ -213,15 +250,26 @@ class PSFailoverSupervisor:
         with _trace.span("ps.failover"):
             self._failover_impl()
 
+    def _publish_now(self, host: str, port: int, epoch: int) -> bool:
+        """Write the directory entry (when wired); a failure parks the
+        triple on the pending slot, retried each watch tick — the
+        eventually-delivered half of publish-then-fence."""
+        if self._publish_cb is None:
+            return True
+        try:
+            self._publish_cb(host, int(port), int(epoch))
+            self.publishes += 1
+            self._pending_publish = None
+            return True
+        except Exception:
+            self._pending_publish = (host, int(port), int(epoch))
+            return False
+
     def _failover_impl(self) -> None:
         t0 = time.monotonic()
         old_host, old_port, old_epoch = self.resolver.resolve()
         epoch = old_epoch + 1
-        # 1. fence the superseded history (best effort NOW: it is
-        # usually a corpse and the connect is refused instantly; an
-        # unconfirmed fence goes on the retry list — see _pending_fences)
-        fence_confirmed = self._try_fence(old_host, old_port, epoch)
-        # 2. promote: the first LIVE not-yet-promoted link of the chain.
+        # 1. promote: the first LIVE not-yet-promoted link of the chain.
         # A crashed/stopped link is skipped, not promoted — promoting a
         # corpse would burn every worker's retry deadline behind a closed
         # listener before the NEXT failover finds the real successor.
@@ -247,12 +295,35 @@ class PSFailoverSupervisor:
             via = "restart"
         else:
             raise RuntimeError(
-                "primary parameter server died with no standby and no "
-                "restart factory (set ps_standby=True or ps_wal_dir)"
+                f"primary {self._kind} died with no standby and no "
+                f"restart factory (set ps_standby=True or ps_wal_dir)"
             )
-        # 3. repoint the workers
+        # 2. PUBLISH-THEN-FENCE (ISSUE 15): the epoch bump is atomic
+        # with the repoint. resolver.update writes (host, port, epoch)
+        # as ONE lock-guarded triple — no reader ever observes the new
+        # endpoint at the old epoch or the old endpoint at the new one —
+        # and the membership-directory publication (when wired) lands
+        # the same triple before any fence is attempted. Ordering
+        # matters: a worker the fence bounces off the old primary
+        # re-resolves IMMEDIATELY, so the system of record must already
+        # name the promoted primary when the first FencedEpochError
+        # lands — the old order (fence first) left re-resolvers pinned
+        # to a fenced endpoint for the whole promotion window, and a
+        # slow worker could still commit to an unfenced old primary
+        # AFTER a fast worker had moved on with nothing published to
+        # arbitrate. With the publish first, any commit the new primary
+        # accepts is at epoch e+1 and every re-resolve — resolver or
+        # directory — yields e+1, so the old history can only ever
+        # absorb commits from clients that never re-resolved, and the
+        # fence (issued right here, retried until confirmed) closes
+        # that door too.
         self.resolver.update(new.host, new.port, epoch)
         self.active = new
+        published = self._publish_now(new.host, new.port, epoch)
+        # 3. fence the superseded history (best effort NOW: it is
+        # usually a corpse and the connect is refused instantly; an
+        # unconfirmed fence goes on the retry list — see _pending_fences)
+        fence_confirmed = self._try_fence(old_host, old_port, epoch)
         latency = time.monotonic() - t0
         self.failovers += 1
         self.failover_latency_s += latency
@@ -262,12 +333,13 @@ class PSFailoverSupervisor:
                 float(getattr(new, "wal_replay_s", 0.0)), 4
             ),
             "fence_confirmed": fence_confirmed,
+            "published": published,
         }
         self.failover_log.append(entry)
         if not fence_confirmed:
             self._pending_fences.append((old_host, old_port, epoch, entry))
         warnings.warn(
-            f"parameter server failed over via {via} to "
+            f"{self._kind} failed over via {via} to "
             f"{new.host}:{new.port} (epoch {epoch}, "
             f"{latency * 1e3:.0f} ms)",
             stacklevel=2,
@@ -278,8 +350,21 @@ class PSFailoverSupervisor:
             "failovers": self.failovers,
             "failover_latency_s": round(self.failover_latency_s, 4),
             "wal_replay_s": round(self.wal_replay_s, 4),
+            "publishes": self.publishes,
             "failover_log": list(self.failover_log),
         }
+
+
+class DirectoryFailoverSupervisor(PSFailoverSupervisor):
+    """The same lease-watch/promote/repoint machinery pointed at a
+    :class:`~distkeras_tpu.directory.DirectoryServer`: the directory
+    speaks the PS admin surface (``ping`` / ``fence`` / promotion on
+    its standby), so watching the watcher costs one subclass and zero
+    new protocol. Clients need no repoint call at all — they re-probe
+    the seed list and prefer the highest fence epoch, which the
+    promotion just bumped."""
+
+    _kind = "membership directory"
 
 
 class WorkerSupervisor:

@@ -247,6 +247,18 @@ class ResilientPSClient:
             self._client.close()
         except Exception:
             pass
+        refresh = getattr(self.resolver, "refresh", None)
+        if refresh is not None:
+            # directory-backed resolver (distkeras_tpu/directory): a
+            # connect failure or FencedEpochError re-resolves through
+            # the directory before the factory rebuilds — the repoint
+            # path for readers with no hand-wired supervisor. Best
+            # effort: a directory mid-failover just leaves the cached
+            # endpoint for this attempt and the next retry asks again.
+            try:
+                refresh()
+            except Exception:
+                pass
         try:
             self._client = self._make_client()
             self.reconnects += 1

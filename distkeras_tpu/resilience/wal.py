@@ -97,6 +97,16 @@ REC_FENCE_FLAT = 11   # u64 epoch
 # live path's exact pipeline: restricted-unpickle -> ["payload"] ->
 # maybe_decode -> tree_to_numpy -> rule.fold.
 REC_COMMIT_WIRE = 12
+# membership-directory records (distkeras_tpu/directory): the replicated
+# (role, key) -> (endpoint, epoch, lease) map logs its state changes
+# through the SAME record framing — pickle-bodied tuples, each carrying
+# the post-apply version so replay detects gaps exactly like the PS log.
+# Lease RENEWALS are deliberately NOT logged (liveness is runtime state,
+# like PS heartbeats); expirations ARE (they change the map).
+REC_DIR_PUT = 20       # (role, key, host, port, epoch, meta, ttl, version)
+REC_DIR_DEL = 21       # (role, key, epoch, version)
+REC_DIR_EXPIRE = 22    # ([(role, key), ...], version)
+REC_DIR_FENCE = 23     # (epoch, version)
 
 _HDR = struct.Struct(">BII")  # type, crc32(body or prefix), len(body)
 # split-checksum prefixes (little-endian: the native writer memcpy's
@@ -944,7 +954,14 @@ _REC_NAMES = {
     REC_DEREG: "dereg", REC_DEREG_FLAT: "dereg",
     REC_EVICT: "evict", REC_EVICT_FLAT: "evict",
     REC_FENCE: "fence", REC_FENCE_FLAT: "fence",
+    REC_DIR_PUT: "dir_put", REC_DIR_DEL: "dir_del",
+    REC_DIR_EXPIRE: "dir_expire", REC_DIR_FENCE: "dir_fence",
 }
+
+#: record-name prefix marking a membership-directory log — ``verify``
+#: flags such directories so an operator reading the aggregate report
+#: can tell the coordination log from the per-shard commit logs
+_DIR_REC_PREFIX = "dir_"
 
 
 def verify_dir(directory: str) -> dict:
@@ -994,6 +1011,12 @@ def verify_dir(directory: str) -> dict:
             }
             report["segments"].append(rec)
     report["record_totals"] = totals
+    # a membership-directory log (distkeras_tpu/directory) walks the same
+    # framing; flag it so the aggregate report names which directory under
+    # a shared root is the coordination log vs a shard's commit log
+    report["directory"] = any(
+        k.startswith(_DIR_REC_PREFIX) for k in totals
+    )
     report["torn_tail_bytes"] = sum(
         s["torn_tail_bytes"] for s in report["segments"]
     )
@@ -1055,6 +1078,9 @@ def verify_tree(root: str) -> dict:
         "ok": ok,
         "dirs": reports,
         "num_wal_dirs": len(reports),
+        "num_directory_dirs": sum(
+            1 for r in reports if r.get("directory")
+        ),
         "record_totals": totals,
         "torn_tail_bytes": sum(r["torn_tail_bytes"] for r in reports),
     }

@@ -82,6 +82,13 @@ class GenerationServer:
         # `health --watch` relays server-side alerts it cannot derive
         # from counters alone)
         self.watchtower = None
+        # Membership directory (ISSUE 15): register_with() publishes this
+        # replica under the "serve" role with a renewed lease, so a
+        # RoutedGenerationClient discovers it — and a killed replica's
+        # entry ages out instead of lying
+        self._dir_reg: tuple | None = None   # (client, key, ttl, epoch)
+        self._dir_renewer: threading.Thread | None = None
+        self._dir_stop = threading.Event()
 
     def initialize(self) -> None:
         self._server_sock = socket.socket(socket.AF_INET,
@@ -182,6 +189,11 @@ class GenerationServer:
                 "error": req.state,
                 "message": req.error or req.state,
                 "request_id": req.id,
+                # a server-side cancel (stop/drain tearing the batch) is
+                # retryable weather to a routed client — the request is
+                # idempotent and a sibling replica can serve it; a
+                # "failed" model error is deterministic and is not
+                "retryable": req.state == "cancelled",
             })
 
     def _handle(self, conn: socket.socket) -> None:
@@ -224,6 +236,52 @@ class GenerationServer:
                 if conn in self._conns:
                     self._conns.remove(conn)
 
+    def register_with(self, directory, key: str | None = None,
+                      ttl: float = 5.0, epoch: int = 0) -> str:
+        """Publish this replica into a membership directory (ISSUE 15):
+        ``("serve", key) → (host, port)`` with a ``ttl`` lease renewed
+        by a background thread at a third of the lease, so the entry
+        expires within one TTL of this replica's death and the router's
+        next refresh drops it. ``stop()`` withdraws cleanly. Returns
+        the registered key."""
+        from distkeras_tpu.directory.client import DirectoryClient
+
+        if not isinstance(directory, DirectoryClient):
+            directory = DirectoryClient(directory)
+        if key is None:
+            key = f"{self.host}:{self.port}"
+        directory.publish("serve", key, self.host, self.port,
+                          epoch=int(epoch), ttl=float(ttl))
+        self._dir_reg = (directory, key, float(ttl), int(epoch))
+        self._dir_stop.clear()
+
+        def renewer():
+            while not self._dir_stop.wait(max(ttl / 3.0, 0.05)):
+                try:
+                    directory.publish("serve", key, self.host, self.port,
+                                      epoch=int(epoch), ttl=float(ttl))
+                except Exception:
+                    pass  # directory weather; the next tick retries
+
+        self._dir_renewer = threading.Thread(
+            target=renewer, daemon=True, name="dk-serve-dir-renew",
+        )
+        self._dir_renewer.start()
+        return key
+
+    def _withdraw_registration(self) -> None:
+        self._dir_stop.set()
+        if self._dir_renewer is not None:
+            self._dir_renewer.join(timeout=2)
+            self._dir_renewer = None
+        reg, self._dir_reg = self._dir_reg, None
+        if reg is not None:
+            directory, key, _ttl, epoch = reg
+            try:
+                directory.withdraw("serve", key, epoch=epoch)
+            except Exception:
+                pass  # the lease expiry is the backstop
+
     def stats(self) -> dict:
         s = self.engine.stats()
         with self._conns_lock:
@@ -235,6 +293,7 @@ class GenerationServer:
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Graceful by default: stop accepting, let every admitted request
         finish and its reply flush, then tear down."""
+        self._withdraw_registration()
         self._running = False
         if self._server_sock is not None:
             try:
@@ -298,12 +357,15 @@ class GenerationClient:
             raise ServerBusyError(r.get("message", "server busy"),
                                   peer=networking._peer_of(self._sock))
         if "error" in r:
-            # bad_request / cancelled / failed: replaying the same frame
-            # can only fail the same way
+            # bad_request / failed: replaying the same frame can only
+            # fail the same way. A server-side "cancelled" (stop/drain)
+            # carries retryable=True — a routed/resilient client replays
+            # it against whoever serves next.
             raise ProtocolError(
                 f"server rejected request: {r['error']}: "
                 f"{r.get('message', '')}",
-                peer=networking._peer_of(self._sock), retryable=False,
+                peer=networking._peer_of(self._sock),
+                retryable=bool(r.get("retryable")),
             )
         return np.asarray(r["tokens"], np.int32)
 

@@ -223,12 +223,20 @@ class ShardedPSGroup:
     # -- failover supervision ------------------------------------------------
 
     def start_supervision(self, fault_plan=None,
-                          failover_timeout: float = 2.0) -> None:
+                          failover_timeout: float = 2.0,
+                          directory=None) -> None:
         """One ``PSFailoverSupervisor`` per shard (socket transport):
         promote down the shard's chain, else restart from the shard's
         WAL. A ``fault_plan`` carrying ``kill_ps_after_commits`` arms the
         in-commit-path kill on the shard it names (``kill_shard_id``,
-        default 0) — the deterministic kill-one-shard chaos."""
+        default 0) — the deterministic kill-one-shard chaos.
+
+        ``directory`` (a :class:`~distkeras_tpu.directory.
+        HostedDirectory`, ISSUE 15) registers every shard primary as
+        ``("ps", "shard-NN")`` and hands each supervisor the publish
+        callable: promotions land in the directory atomically with the
+        epoch bump (publish-then-fence), healthy pings renew the lease,
+        and a dead shard's entry expires instead of lying."""
         if self.transport != "socket":
             raise ValueError(
                 "per-shard failover supervision needs transport='socket'"
@@ -251,11 +259,15 @@ class ShardedPSGroup:
                     new.initialize()
                     new.start()
                     return new
+            publish = None
+            if directory is not None:
+                publish = directory.register_shard(sid, srv, self.plan)
             sup = PSFailoverSupervisor(
                 self.resolvers[sid], srv,
                 standby=self.chains[sid] or None,
                 restart_factory=factory,
                 failover_timeout=float(failover_timeout),
+                publish=publish,
             )
             sup.start()
             self.supervisors.append(sup)

@@ -197,6 +197,7 @@ class ShardPlan:
             p: int(np.asarray(node).nbytes) for p, node in pairs
         }
         self.ring = HashRing(num_shards, vnodes=vnodes)
+        self.bound = float(bound)
         self.assignment = self.ring.assign(self.sizes, bound=bound)
         self.num_shards = int(num_shards)
         self.shard_paths = [

@@ -142,12 +142,46 @@ _seg_counter = itertools.count()
 _SEG_REGISTRY: dict[str, int] = {}
 _SEG_REGISTRY_LOCK = threading.Lock()
 
+# Cross-process rendezvous (ISSUE 15, ROADMAP item 5 residual): when a
+# membership directory is configured, every mint publishes the segment
+# name under the directory's "shm" role and every unlink withdraws it —
+# SEPARATE trainer processes on one host can then find each other's ring
+# segments by name (`DirectoryClient.shm_segments()`) instead of passing
+# them by hand. The process-local registry above stays the fallback when
+# no directory is installed. Installed via `set_rendezvous` (see
+# `distkeras_tpu.directory.install_shm_rendezvous`); both callbacks are
+# best-effort by design — a directory outage must never fail a mint.
+_RENDEZVOUS: tuple | None = None   # (publish(name, size), withdraw(name))
+
+
+def set_rendezvous(publish, withdraw) -> None:
+    """Install the named-rendezvous callbacks for this process's shm
+    segments (exactly one rendezvous at a time — the directory is a
+    singleton per process by construction)."""
+    global _RENDEZVOUS
+    _RENDEZVOUS = (publish, withdraw)
+
+
+def clear_rendezvous(publish=None) -> None:
+    """Uninstall the rendezvous (matching ``publish`` when given, so a
+    stale uninstaller cannot clobber a newer installation)."""
+    global _RENDEZVOUS
+    if publish is None or (_RENDEZVOUS is not None
+                           and _RENDEZVOUS[0] is publish):
+        _RENDEZVOUS = None
+
 
 def unregister_segment(name: str) -> None:
     """Drop one segment from the live-inventory registry (called by
     every unlink path — Python lane and native lane)."""
     with _SEG_REGISTRY_LOCK:
         _SEG_REGISTRY.pop(name, None)
+    rdv = _RENDEZVOUS
+    if rdv is not None:
+        try:
+            rdv[1](name)
+        except Exception:
+            pass  # best-effort: the directory lease is the backstop
 
 
 def segment_inventory() -> dict:
@@ -194,6 +228,12 @@ def mint_segment(name_prefix: str,
     _WORD.pack_into(seg.buf, _OFF_CAP, int(ring_bytes))
     with _SEG_REGISTRY_LOCK:
         _SEG_REGISTRY[seg.name] = seg.size
+    rdv = _RENDEZVOUS
+    if rdv is not None:
+        try:
+            rdv[0](seg.name, seg.size)
+        except Exception:
+            pass  # best-effort: mint must not fail on a directory outage
     return seg
 
 

@@ -573,6 +573,9 @@ class DistributedTrainer(Trainer):
                  autoscale_target=None,
                  preempt_drain_timeout: float = 5.0,
                  max_pool_size: int | None = None,
+                 directory: bool = False,
+                 directory_standby: bool = True,
+                 ps_directory=None,
                  prefetch: int = 1, ema_decay: float | None = None,
                  clipnorm=None, clipvalue=None, validation_data=None):
         super().__init__(keras_model, loss, worker_optimizer,
@@ -1084,6 +1087,72 @@ class DistributedTrainer(Trainer):
                     f"fault_plan.kill_shard_id={ks} is out of range for "
                     f"ps_num_shards={self.ps_num_shards}"
                 )
+        # Membership directory (distkeras_tpu/directory; DESIGN.md
+        # "Membership directory & routing", ISSUE 15):
+        # - directory=True: host the replicated coordination service next
+        #   to the PS fleet — a WAL-backed DirectoryServer (plus a
+        #   standby fed by the apply-and-forward stream unless
+        #   directory_standby=False) mapping ("ps", "shard-NN") →
+        #   (endpoint, fence epoch, lease). Every worker's client is
+        #   minted from a directory LOOKUP (zero endpoint constructor
+        #   args — elastic joiners on other hosts discover the fleet),
+        #   failover supervisors publish promotions to it atomically
+        #   with the epoch bump (publish-then-fence), and their healthy
+        #   pings renew the lease so a dead shard's entry expires.
+        # - directory_standby: replicate the directory itself (default
+        #   True — an unreplicated directory would reintroduce exactly
+        #   the one-process topology knowledge this removes).
+        # - ps_directory=seeds ("host:port" or (host, port), singly or
+        #   a list): discover an EXTERNAL fleet through its directory —
+        #   the serving-process analogue of ps_host with the wiring
+        #   looked up instead of hand-passed.
+        self.directory = bool(directory)
+        self.directory_standby = bool(directory_standby)
+        self.ps_directory = ps_directory
+        if self.directory or ps_directory is not None:
+            if backend != "ps":
+                raise ValueError(
+                    "directory/ps_directory apply to backend='ps' only"
+                )
+            if self.directory and ps_transport != "socket":
+                raise ValueError(
+                    "directory=True requires ps_transport='socket' (the "
+                    "directory registers TCP endpoints; the in-process "
+                    "and shm transports have no cross-host endpoints to "
+                    "publish)"
+                )
+            if self.directory and ps_directory is not None:
+                raise ValueError(
+                    "directory=True hosts the directory; ps_directory= "
+                    "discovers an external one — set exactly one"
+                )
+            if ps_host is not None:
+                raise ValueError(
+                    "directory/ps_directory replace ps_host: endpoints "
+                    "come from the directory, not constructor arguments"
+                )
+            if ps_directory is not None and (
+                    sharded or ps_standby or ps_wal_dir is not None):
+                raise ValueError(
+                    "ps_directory discovers a fleet some OTHER process "
+                    "hosts — the server-side knobs (ps_num_shards, "
+                    "ps_chain_length, ps_standby, ps_wal_dir) belong to "
+                    "that owner"
+                )
+            if ps_directory is not None \
+                    and ps_transport not in ("socket",):
+                raise ValueError(
+                    "ps_directory requires ps_transport='socket' (the "
+                    "discovered endpoints are TCP servers)"
+                )
+        if fault_plan is not None \
+                and getattr(fault_plan, "has_directory_events", False) \
+                and not self.directory:
+            raise ValueError(
+                "fault_plan carries directory kill/partition events but "
+                "directory=True is not set — nothing would ever consult "
+                "them, so the chaos would silently test nothing"
+            )
         if backend != "ps" and (
                 worker_restart_budget or retry_policy is not None
                 or heartbeat_interval is not None or lease_timeout is not None
@@ -1375,6 +1444,13 @@ class DistributedTrainer(Trainer):
                 "backend='ps' are not supported yet (the shim points every "
                 "controller at ONE process-0 server; a sharded group needs "
                 "per-shard endpoint broadcast)"
+            )
+        if self.directory or self.ps_directory is not None:
+            raise NotImplementedError(
+                "directory/ps_directory under the multi-process shim are "
+                "not supported yet (the shim broadcasts process 0's one "
+                "endpoint; the directory is the mechanism that would "
+                "replace that broadcast)"
             )
         W_local = self.num_workers // pc
         transport = "native" if self.ps_transport == "native" else "socket"

@@ -958,6 +958,22 @@ def run_async_training(trainer, ds, shuffle: bool):
         )
     kill_ps_chaos = (fault_plan is not None and getattr(
         fault_plan, "kill_ps_after_commits", None) is not None)
+    # Membership directory (distkeras_tpu/directory, ISSUE 15): the
+    # trainer either HOSTS the replicated coordination service next to
+    # the fleet it describes (directory=True — primary + standby +
+    # directory failover supervision, every PS endpoint registered with
+    # a lease) or DISCOVERS an external fleet through one
+    # (ps_directory=seeds). In both modes worker clients are minted
+    # from directory lookups — zero endpoint constructor args — and a
+    # FencedEpochError or connect failure re-resolves THROUGH the
+    # directory, so failover repoints readers without per-worker
+    # plumbing and elastic joiners on other hosts find the fleet.
+    # (fault plans carrying directory events without directory=True are
+    # rejected at trainer construction — see DistributedTrainer)
+    directory_on = bool(getattr(trainer, "directory", False))
+    dir_seeds = getattr(trainer, "ps_directory", None)
+    hosted_directory = None
+    external_directory = None
     # Sharded center (distkeras_tpu/sharding, ISSUE 8): partition the
     # param tree across ps_num_shards servers by consistent hashing over
     # leaf paths, with chain replication (ps_chain_length) per shard.
@@ -970,7 +986,8 @@ def run_async_training(trainer, ds, shuffle: bool):
     shard_supervised = sharded and transport == "socket" and (
         ps_chain_length > 1 or kill_ps_chaos or ps_wal_dir is not None)
     if transport == "socket" \
-            and (ps_standby or kill_ps_chaos or shard_supervised) \
+            and (ps_standby or kill_ps_chaos or shard_supervised
+                 or directory_on or dir_seeds is not None) \
             and retry_policy is None:
         # failover is only survivable through reconnecting clients: a
         # plain client dies with the primary's TCP connection. The
@@ -986,6 +1003,20 @@ def run_async_training(trainer, ds, shuffle: bool):
             max_attempts=100, base_delay=0.05, max_delay=0.5,
             deadline=max(60.0, 20.0 * float(ps_failover_timeout)),
         )
+    if directory_on:
+        import os as _os
+
+        from distkeras_tpu.directory import HostedDirectory
+
+        hosted_directory = HostedDirectory(
+            wal_dir=(None if ps_wal_dir is None
+                     else _os.path.join(ps_wal_dir, "directory")),
+            standby=bool(getattr(trainer, "directory_standby", True)),
+            default_ttl=max(2.0 * float(ps_failover_timeout), 1.0),
+            failover_timeout=float(ps_failover_timeout),
+            fault_plan=fault_plan,
+        )
+        hosted_directory.start()
     ps_resolver = None
     if resilient and transport == "native" and codec is not None:
         raise ValueError(
@@ -1040,7 +1071,15 @@ def run_async_training(trainer, ds, shuffle: bool):
             sharded_group.start_supervision(
                 fault_plan=fault_plan if kill_ps_chaos else None,
                 failover_timeout=float(ps_failover_timeout),
+                directory=hosted_directory,
             )
+        elif hosted_directory is not None:
+            # no supervisors to renew the leases: register non-expiring
+            # entries (discovery still works; nothing ever ages out)
+            for _sid, _srv in enumerate(sharded_group.servers):
+                hosted_directory.register_shard(
+                    _sid, _srv, sharded_group.plan, supervised=False,
+                )
         ps = sharded_group
 
         def make_client(i):
@@ -1052,6 +1091,17 @@ def run_async_training(trainer, ds, shuffle: bool):
                 retry_policy=retry_policy, heartbeat_interval=hb_interval,
                 resilient=resilient,
             )
+    elif dir_seeds is not None:
+        # External fleet discovered through a membership directory
+        # (ISSUE 15): no local server and NO endpoint constructor args —
+        # the directory seeds are the only bootstrap, the fleet shape
+        # (shard count, ring digest) comes from the registrations, and
+        # build_client below mints each worker's fully-wired client
+        # from a lookup.
+        from distkeras_tpu.directory import DirectoryClient, parse_seeds
+
+        ps = None
+        external_directory = DirectoryClient(parse_seeds(dir_seeds))
     elif external_host is not None:
         # External PS (another process/host — the reference's driver-hosted
         # PS serving remote executors): this process contributes W workers;
@@ -1172,6 +1222,15 @@ def run_async_training(trainer, ds, shuffle: bool):
     # degrades to no-WAL — see NativeSocketParameterServer)
     ps_standby_server = None
     ps_supervisor = None
+    ps_publish = None
+    if hosted_directory is not None and ps is not None \
+            and sharded_group is None:
+        # single-PS registration: shard 0 of 1. Supervised entries lease
+        # out and are renewed by the supervisor's pings; without one the
+        # entry is non-expiring (nobody would renew it).
+        ps_publish = hosted_directory.register_shard(
+            0, ps, None, supervised=(ps_standby or kill_ps_chaos),
+        )
     if transport == "socket" and ps is not None and sharded_group is None \
             and (ps_standby or kill_ps_chaos):
         from distkeras_tpu.resilience.recovery import PSFailoverSupervisor
@@ -1230,6 +1289,7 @@ def run_async_training(trainer, ds, shuffle: bool):
             ps_resolver, ps, standby=ps_standby_server,
             restart_factory=restart_factory,
             failover_timeout=float(ps_failover_timeout),
+            publish=ps_publish,
         )
         ps_supervisor.start()
 
@@ -1248,7 +1308,26 @@ def run_async_training(trainer, ds, shuffle: bool):
         """One worker's FULLY-WIRED client (any id — the elastic
         coordinator mints clients for live joiners too): the sharded
         fan-out arrives wrapped from the group; otherwise the resilient
-        wrapper (reconnect + seqno dedup + heartbeats) goes on here."""
+        wrapper (reconnect + seqno dedup + heartbeats) goes on here.
+        With a directory (hosted or external) EVERY client — initial
+        workers and live joiners alike — is minted from a directory
+        lookup, zero endpoint constructor args: the PR 9 follow-up
+        (joiners on other hosts discover the fleet) by construction."""
+        if hosted_directory is not None:
+            return hosted_directory.build_worker_client(
+                params, offset + i, retry_policy=retry_policy,
+                heartbeat_interval=hb_interval,
+                pull_compression=pull_comp,
+            )
+        if external_directory is not None:
+            from distkeras_tpu.directory import build_ps_client
+
+            return build_ps_client(
+                external_directory, params, offset + i,
+                retry_policy=retry_policy,
+                heartbeat_interval=hb_interval,
+                pull_compression=pull_comp,
+            )
         if sharded_group is not None:
             # resilience lives per shard INSIDE the fan-out — see
             # ShardedPSGroup.make_client
@@ -1364,7 +1443,14 @@ def run_async_training(trainer, ds, shuffle: bool):
             # sentinel worker id (no commits ever use it) keeps the
             # snapshot read version-neutral for the real workers.
             SNAP_WID = 2**32 - 1
-            if transport == "native":
+            if external_directory is not None:
+                from distkeras_tpu.directory import build_ps_client
+
+                snap_client = build_ps_client(
+                    external_directory, params, SNAP_WID,
+                    retry_policy=retry_policy,
+                )
+            elif transport == "native":
                 from distkeras_tpu.native_ps import NativePSClient
 
                 snap_client = NativePSClient(
@@ -1597,6 +1683,9 @@ def run_async_training(trainer, ds, shuffle: bool):
     # chaos tests), client retry/reconnect totals, supervisor restarts,
     # and what the fault plan actually injected.
     trainer.resilience_stats_ = None
+    trainer.directory_stats_ = (
+        hosted_directory.stats() if hosted_directory is not None else None
+    )
     if resilient or supervisor is not None or fault_plan is not None \
             or coordinator is not None:
         trainer.resilience_stats_ = {
@@ -1621,6 +1710,9 @@ def run_async_training(trainer, ds, shuffle: bool):
             # exactly-once ledger (resilience/elastic.py)
             "elastic": (coordinator.stats() if coordinator is not None
                         else None),
+            # membership directory (ISSUE 15): registrations, lookups,
+            # the directory's OWN failover log, and the final view
+            "directory": trainer.directory_stats_,
         }
 
     def _surfaced_error(w):
@@ -1733,6 +1825,10 @@ def run_async_training(trainer, ds, shuffle: bool):
         active_ps.stop()
         if getattr(trainer, "ema_decay", None) is not None:
             trainer.ema_params_ = active_ps.get_ema()
+    if hosted_directory is not None:
+        hosted_directory.stop()
+    if external_directory is not None:
+        external_directory.close()
 
     if trace_on and trace_dir is not None:
         import os as _os

@@ -1,0 +1,1004 @@
+"""Membership directory & routing (ISSUE 15).
+
+Pins:
+
+- **WAL replay bit-identity**: a crashed directory's ``(snapshot, wal)``
+  replays to exactly the live server's state, and ``wal verify`` walks a
+  directory root (flagging it as one).
+- **lease expiry under a seeded stalled heartbeat** (injected clock — no
+  wall-time races): an unrenewed entry ages out, a renewed one survives,
+  and the expiry is itself a durable record.
+- **registration races**: two promotions in either arrival order resolve
+  to the higher fence epoch.
+- **chain replication + promotion**: primary → standby → standby applies
+  the same records via the shared apply function; promotion stamps the
+  bumped epoch and keeps streaming down-chain.
+- **publish-then-fence**: the failover's epoch bump is atomic with the
+  repoint and the directory publication lands BEFORE the old primary's
+  fence — and after a failover against a live (zombie) old primary, an
+  old-epoch commit to it is fenced while the new primary serves the new
+  epoch.
+- **discovery**: a client built from a directory lookup alone (no
+  endpoint constructor args) trains against a sharded fleet; a
+  plan-digest mismatch fails fast.
+- **the chaos acceptance**: kill one PS shard AND the directory primary
+  mid-run (elastic, with a mid-run joiner minted from the directory) —
+  completes, exactly-once per shard, WAL-replay center bit-identical.
+- **the router**: ≥8 concurrent clients over 2 GenerationServers show
+  prefix-hash affinity and survive one replica killed mid-stream with
+  every surviving stream completing.
+
+Timing assertions ride injected clocks wherever possible; the few
+wall-clock waits carry the tier-1 suite's ±15% load-jitter margins
+(bounds at 3× the nominal interval).
+"""
+
+import os
+import socket as _socket
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.directory import (
+    DirectoryClient,
+    DirectoryEndpoint,
+    DirectoryServer,
+    RoutedGenerationClient,
+    StandbyDirectoryServer,
+    build_ps_client,
+    parse_seeds,
+    recover_directory_state,
+)
+from distkeras_tpu.networking import (
+    FencedEpochError,
+    ShardMapMismatchError,
+)
+from distkeras_tpu.resilience import wal as walmod
+from distkeras_tpu.resilience.faults import FaultPlan
+from distkeras_tpu.resilience.retry import (
+    PSEndpoint,
+    ResilientPSClient,
+    RetryPolicy,
+)
+from tests.test_trainers import blobs_dataset, final_loss, model_spec
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+def _start(srv):
+    srv.initialize()
+    srv.start()
+    return srv
+
+
+# -- seeds & basic map -------------------------------------------------------
+
+
+def test_parse_seeds_shapes():
+    assert parse_seeds("h:9") == [("h", 9)]
+    assert parse_seeds([("a", 1), "b:2"]) == [("a", 1), ("b", 2)]
+    assert parse_seeds(("a", 1)) == [("a", 1)]
+    with pytest.raises(ValueError, match="host:port"):
+        parse_seeds(["nope"])
+    with pytest.raises(ValueError, match="at least one"):
+        parse_seeds([])
+
+
+def test_publish_lookup_withdraw_roundtrip():
+    srv = _start(DirectoryServer(default_ttl=None))
+    try:
+        c = DirectoryClient([(srv.host, srv.port)])
+        assert c.publish("ps", "shard-00", "10.0.0.1", 7000,
+                         meta={"num_shards": 1})["ok"]
+        es = c.lookup("ps")
+        assert [(e["key"], e["host"], e["port"]) for e in es] \
+            == [("shard-00", "10.0.0.1", 7000)]
+        assert c.lookup("serve") == []
+        assert c.withdraw("ps", "shard-00")["ok"]
+        assert c.lookup("ps") == []
+        # withdrawing an absent entry is idempotent
+        assert c.withdraw("ps", "shard-00")["ok"]
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_lease_expiry_under_stalled_heartbeat():
+    """The seeded stalled-heartbeat scenario on an injected clock: two
+    entries, one renews, one stalls — only the stalled one expires, the
+    expiry is a durable dir_expire record, and a lookup never serves a
+    lapsed lease."""
+    clock = FakeClock()
+    srv = DirectoryServer(default_ttl=2.0, clock=clock)
+    srv.publish("ps", "live", "h", 1)
+    srv.publish("ps", "stalled", "h", 2)
+    for _ in range(4):
+        clock.advance(1.0)          # stalled worker's heartbeats stop
+        srv.renew("ps", "live")     # the live one keeps renewing
+    got = {e["key"] for e in srv.lookup("ps")}
+    assert got == {"live"}
+    assert srv.expired_entries == 1
+    assert srv.stats()["entries"] == 1
+    # the expiry changed the replayed map, not just the runtime view
+    state = srv.state.snapshot()
+    assert ("ps", "stalled") not in state["entries"]
+    # a re-registration (the promoted owner coming back) re-admits
+    srv.publish("ps", "stalled", "h2", 3, epoch=1)
+    assert {e["key"] for e in srv.lookup("ps")} == {"live", "stalled"}
+
+
+def test_registration_race_higher_fence_epoch_wins_both_orders():
+    srv = _start(DirectoryServer(default_ttl=None))
+    try:
+        c = DirectoryClient([(srv.host, srv.port)])
+        # order A: high then low — the stale promotion is REJECTED
+        assert c.publish("ps", "shard-00", "new", 2, epoch=5)["ok"]
+        r = c.publish("ps", "shard-00", "old", 1, epoch=3)
+        assert not r["ok"] and r["error"] == "stale_epoch" \
+            and r["epoch"] == 5
+        assert c.lookup("ps", "shard-00")[0]["host"] == "new"
+        # order B: low then high — the higher epoch replaces
+        assert c.publish("ps", "shard-01", "old", 1, epoch=3)["ok"]
+        assert c.publish("ps", "shard-01", "new", 2, epoch=5)["ok"]
+        assert c.lookup("ps", "shard-01")[0]["host"] == "new"
+        # stale withdraw cannot erase the promoted entry either
+        assert not c.withdraw("ps", "shard-01", epoch=3)["ok"]
+        assert c.lookup("ps", "shard-01")[0]["host"] == "new"
+        assert srv.stale_rejects == 2
+        c.close()
+    finally:
+        srv.stop()
+
+
+# -- durability --------------------------------------------------------------
+
+
+def test_directory_wal_replay_bit_identity(tmp_path):
+    """Crash (no tidy close) after a mixed event history; the recovered
+    state — across a mid-history snapshot truncation — equals the live
+    state exactly, and the verify tool reports the root healthy AND
+    flags it as a directory log."""
+    d = str(tmp_path)
+    srv = _start(DirectoryServer(wal_dir=d, default_ttl=None,
+                                 snapshot_every=3))
+    srv.publish("ps", "shard-00", "h", 1)
+    srv.publish("ps", "shard-01", "h", 2)
+    srv.publish("serve", "r1", "h", 3)
+    srv.publish("ps", "shard-00", "h2", 4, epoch=1)   # failover repoint
+    srv.withdraw("serve", "r1")
+    srv.fence(2)
+    live = srv.state.snapshot()
+    srv._crash()
+
+    rec = recover_directory_state(d)
+    assert rec is not None and rec.snapshot() == live
+    report = walmod.verify_tree(d)
+    assert report["ok"], report
+    assert report["directory"] is True
+    assert report["record_totals"].get("dir_fence") == 1
+    # restart-in-place adopts the same state and keeps serving
+    srv2 = _start(DirectoryServer(wal_dir=d, default_ttl=None))
+    try:
+        assert srv2.recovered_ and srv2.state.snapshot() == live
+        c = DirectoryClient([(srv2.host, srv2.port)])
+        assert {e["key"] for e in c.lookup("ps")} \
+            == {"shard-00", "shard-01"}
+        c.close()
+    finally:
+        srv2.stop()
+
+
+def test_wal_verify_walks_shared_root_with_directory(tmp_path):
+    """A training root holding per-shard commit logs AND the directory's
+    log under ``directory/`` verifies as ONE aggregate report that
+    counts the directory dirs — an out-of-date or torn directory log is
+    operator-visible, not silent."""
+    from distkeras_tpu.parallel.merge_rules import DownpourMerge
+    from distkeras_tpu.parameter_servers import ParameterServer
+
+    root = str(tmp_path)
+    ps = ParameterServer({"w": np.zeros(8, np.float32)}, DownpourMerge(),
+                         1, wal_dir=os.path.join(root, "shard-00"),
+                         wal_group_window=1)
+    ps.pull(0)
+    ps.commit(0, {"w": np.ones(8, np.float32)}, seq=1)
+    ps.stop()
+    dsrv = DirectoryServer(wal_dir=os.path.join(root, "directory"),
+                           default_ttl=None)
+    dsrv.publish("ps", "shard-00", "h", 1)
+    dsrv.stop()
+    rep = walmod.verify_tree(root)
+    assert rep["ok"] and rep.get("sharded")
+    assert rep["num_directory_dirs"] == 1
+    by_dir = {r["dir"]: r for r in rep["dirs"]}
+    assert by_dir["directory"]["directory"] is True
+    assert by_dir["shard-00"]["directory"] is False
+    # a torn directory tail on a NON-live segment must fail the report
+    ddir = os.path.join(root, "directory")
+    seg = sorted(n for n in os.listdir(ddir) if n.startswith("wal-"))[0]
+    with open(os.path.join(ddir, seg), "r+b") as f:
+        f.seek(0, 2)
+        size = f.tell()
+        f.truncate(max(size - 3, 1))
+    with open(os.path.join(ddir, "wal-999999999999.log"), "wb") as f:
+        f.write(b"")  # a later (live) segment makes the torn one non-live
+    assert not walmod.verify_tree(root)["ok"]
+
+
+def test_ttl_only_republish_is_durable(tmp_path):
+    """A re-publish changing ONLY the lease ttl must be a logged (and
+    streamed) record: the recovered/promoted directory re-arms leases
+    from the stored ttl, so a skipped log entry would immortalize (or
+    erase) the entry after a failover."""
+    d = str(tmp_path)
+    srv = DirectoryServer(wal_dir=d, default_ttl=None)
+    srv.publish("ps", "shard-00", "h", 1, ttl=None)
+    srv.publish("ps", "shard-00", "h", 1, ttl=2.0)   # lease-mode flip only
+    live = srv.state.snapshot()
+    assert live["entries"][("ps", "shard-00")]["ttl"] == 2.0
+    srv._crash()
+    rec = recover_directory_state(d)
+    assert rec.snapshot() == live
+
+
+def test_directory_restart_in_place_keeps_seed_address(tmp_path):
+    """directory_standby=False + WAL: the supervisor's restart-in-place
+    must rebind the ORIGINAL primary port — the seed list is every
+    client's only bootstrap, so a replacement on a fresh ephemeral port
+    would be unreachable by construction."""
+    from distkeras_tpu.directory import HostedDirectory
+
+    hosted = HostedDirectory(wal_dir=str(tmp_path), standby=False,
+                             failover_timeout=0.3)
+    hosted.start()
+    try:
+        seeds = hosted.seeds
+        c = DirectoryClient(seeds)
+        c.publish("ps", "shard-00", "h", 7, ttl=None)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")   # the failover notice
+            hosted.primary._crash()
+            # the ONLY addresses we hold are the original seeds; the
+            # restarted (WAL-recovered) primary must answer on them
+            deadline = time.monotonic() + 15.0
+            entries = []
+            while time.monotonic() < deadline:
+                try:
+                    entries = c.lookup("ps", "shard-00")
+                    if entries:
+                        break
+                except ConnectionError:
+                    pass
+                time.sleep(0.1)
+        assert entries and entries[0]["port"] == 7
+        assert hosted.supervisor.failovers == 1
+        assert hosted.active.port == seeds[0][1]
+        c.close()
+    finally:
+        hosted.stop()
+
+
+# -- replication & promotion -------------------------------------------------
+
+
+def test_chain_replication_apply_and_forward_and_promotion():
+    """primary → s1 → s2: every record applies on both links via the
+    shared apply function; promoting s1 stamps the bumped epoch, re-arms
+    leases, and KEEPS forwarding its own writes to s2 (the chain
+    survives its head's promotion)."""
+    srv = _start(DirectoryServer(default_ttl=None))
+    s1 = _start(StandbyDirectoryServer(default_ttl=None))
+    s2 = _start(StandbyDirectoryServer(default_ttl=None))
+    try:
+        s1.attach_standby(s2.host, s2.port)   # tail first
+        srv.attach_standby(s1.host, s1.port)
+        srv.publish("ps", "shard-00", "h", 1)
+        srv.publish("serve", "r", "h", 2)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline \
+                and (len(s2.state.entries) < 2 or len(s1.state.entries) < 2):
+            time.sleep(0.01)
+        assert s1.state.snapshot() == srv.state.snapshot()
+        assert s2.state.snapshot() == srv.state.snapshot()
+        # promote the head of the chain
+        srv._crash()
+        s1.promote(epoch=3)
+        assert s1.fence_epoch == 3 and not s1.is_standby and s1.promoted_
+        # the promoted primary's own writes keep streaming to s2
+        s1.publish("ps", "shard-00", "h9", 9, epoch=3)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline \
+                and s2.state.entries.get(("ps", "shard-00"),
+                                         {}).get("port") != 9:
+            time.sleep(0.01)
+        assert s2.state.entries[("ps", "shard-00")]["port"] == 9
+        assert s2.state.fence_epoch == 3   # the fence rode the chain too
+        # client over the seed list lands on the promoted primary
+        c = DirectoryClient([(srv.host, srv.port), (s1.host, s1.port)])
+        assert c.lookup("ps", "shard-00")[0]["port"] == 9
+        c.close()
+    finally:
+        for s in (srv, s1, s2):
+            s.stop()
+
+
+def test_standby_wal_rebased_on_stream_adoption(tmp_path):
+    """A durable standby whose own WAL holds an OLDER history adopts a
+    newer primary's base: its log is re-based (rotate + snapshot at the
+    adopted version) so streamed records append gap-free and a later
+    recovery replays cleanly — the version-gap hazard pinned."""
+    stb_dir = str(tmp_path)
+    # seed the standby's wal dir with an old history at version 1
+    old = DirectoryServer(wal_dir=stb_dir, default_ttl=None)
+    old.publish("ps", "stale", "h", 1)
+    old.stop()
+    primary = _start(DirectoryServer(default_ttl=None))
+    for i in range(3):
+        primary.publish("ps", f"shard-{i:02d}", "h", 10 + i)
+    stb = _start(StandbyDirectoryServer(wal_dir=stb_dir,
+                                        default_ttl=None))
+    assert stb.recovered_ and stb.state.version == 1
+    try:
+        primary.attach_standby(stb.host, stb.port)   # adopts version 3
+        primary.publish("ps", "shard-03", "h", 13)   # streams record 4
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and stb.state.version < 4:
+            time.sleep(0.01)
+        assert stb.state.snapshot() == primary.state.snapshot()
+        stb._crash()
+        rec = recover_directory_state(stb_dir)   # must not gap-error
+        assert rec is not None
+        assert rec.snapshot() == primary.state.snapshot()
+    finally:
+        primary.stop()
+        stb.stop()
+
+
+def test_client_prefers_highest_epoch_never_a_zombie():
+    """Two serving directories (a promoted replica at epoch 2 and a
+    zombie old primary at epoch 0): the seed probe picks the higher
+    fence epoch regardless of seed order."""
+    zombie = _start(DirectoryServer(default_ttl=None))
+    zombie.publish("ps", "shard-00", "stale", 1)
+    promoted = _start(DirectoryServer(default_ttl=None, fence_epoch=2))
+    promoted.publish("ps", "shard-00", "fresh", 2, epoch=2)
+    try:
+        for seeds in ([(zombie.host, zombie.port),
+                       (promoted.host, promoted.port)],
+                      [(promoted.host, promoted.port),
+                       (zombie.host, zombie.port)]):
+            c = DirectoryClient(seeds)
+            assert c.lookup("ps", "shard-00")[0]["host"] == "fresh"
+            c.close()
+    finally:
+        zombie.stop()
+        promoted.stop()
+
+
+# -- publish-then-fence (the pinned ordering fix) ----------------------------
+
+
+def test_failover_publish_then_fence_ordering():
+    """The supervisor's failover: (promote) → (resolver + directory
+    publish, atomically carrying the bumped epoch) → (fence). At fence
+    time the resolver must already name the new primary at the new
+    epoch and the directory entry must already be written — no consumer
+    can observe the endpoint without the epoch or vice versa."""
+    from distkeras_tpu.resilience.recovery import PSFailoverSupervisor
+
+    events = []
+
+    class FakeStandby:
+        host, port = "newhost", 4242
+        promoted_ = False
+        crashed_ = False
+        _running = True
+
+        def promote(self, epoch):
+            events.append(("promote", epoch))
+            self.promoted_ = True
+
+    resolver = PSEndpoint("oldhost", 1111, epoch=0)
+    published = []
+
+    def publish(host, port, epoch):
+        # the resolver was repointed BEFORE (or atomically with) the
+        # directory publication — never after
+        assert resolver.resolve() == (host, port, epoch)
+        published.append((host, port, epoch))
+        events.append(("publish", epoch))
+
+    sup = PSFailoverSupervisor(resolver, primary=object(),
+                               standby=FakeStandby(), publish=publish)
+
+    def fence(host, port, epoch):
+        events.append(("fence", epoch))
+        # publish-then-fence: by fence time the system of record already
+        # names the new primary at the new epoch
+        assert resolver.resolve() == ("newhost", 4242, 1)
+        assert published == [("newhost", 4242, 1)]
+        assert (host, port) == ("oldhost", 1111)
+        return True
+
+    sup._try_fence = fence
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # the failover notice itself
+        sup._failover_impl()
+    assert [e[0] for e in events] == ["promote", "publish", "fence"]
+    assert sup.failover_log[0]["fence_confirmed"] is True
+    assert sup.failover_log[0]["published"] is True
+    assert sup.publishes == 1
+
+
+def test_zombie_primary_fenced_after_promotion_published():
+    """Against a LIVE (stalled, not dead) old primary: after the
+    failover, a slow worker's old-epoch commit to the zombie is fenced
+    while the promoted primary serves the new epoch — the interleaving
+    the publish-then-fence ordering (plus the fence retry) closes."""
+    from distkeras_tpu.parallel.merge_rules import DownpourMerge
+    from distkeras_tpu.parameter_servers import (
+        ParameterServerClient,
+        SocketParameterServer,
+        StandbySocketParameterServer,
+    )
+    from distkeras_tpu.resilience.recovery import PSFailoverSupervisor
+
+    tree = {"w": np.zeros(16, np.float32)}
+    old = SocketParameterServer(dict(tree), DownpourMerge(), 2)
+    old.initialize()
+    old.start()
+    stb = StandbySocketParameterServer(dict(tree), DownpourMerge(), 2)
+    stb.initialize()
+    stb.start()
+    old.attach_standby("127.0.0.1", stb.port)
+    resolver = PSEndpoint("127.0.0.1", old.port, epoch=0)
+    sup = PSFailoverSupervisor(resolver, old, standby=stb)
+    try:
+        # the supervisor believes the primary dead (stalled pings); the
+        # process itself is alive — the zombie scenario
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            sup._failover_impl()
+        host, port, epoch = resolver.resolve()
+        assert (host, port, epoch) == ("127.0.0.1", stb.port, 1)
+        # fast worker commits to the NEW primary at the new epoch
+        fast = ParameterServerClient("127.0.0.1", stb.port, 0, epoch=1)
+        fast.pull()
+        fast.commit(0, {"w": np.ones(16, np.float32)}, seq=1)
+        # slow worker still wired to the OLD primary at the old epoch:
+        # its commit is FENCED, not folded into the superseded history
+        slow = ParameterServerClient("127.0.0.1", old.port, 1, epoch=0)
+        with pytest.raises(FencedEpochError):
+            slow.commit(1, {"w": np.ones(16, np.float32)}, seq=1)
+        assert old.num_updates == 0 and stb.num_updates == 1
+        assert sup.failover_log[0]["fence_confirmed"] is True
+        fast.close()
+        slow.close()
+    finally:
+        sup.stop()
+        old.stop()
+        stb.stop()
+
+
+# -- directory-backed resolution ---------------------------------------------
+
+
+def test_resilient_client_re_resolves_through_directory():
+    """A ResilientPSClient whose resolver is a DirectoryEndpoint: the
+    primary dies, a replacement registers under a bumped epoch, and the
+    client's next op reconnects through a directory refresh — no
+    hand-wired repoint anywhere."""
+    from distkeras_tpu.parallel.merge_rules import DownpourMerge
+    from distkeras_tpu.parameter_servers import (
+        ParameterServerClient,
+        SocketParameterServer,
+    )
+
+    tree = {"w": np.zeros(16, np.float32)}
+    dsrv = _start(DirectoryServer(default_ttl=None))
+    a = SocketParameterServer(dict(tree), DownpourMerge(), 1)
+    a.initialize()
+    a.start()
+    dc = DirectoryClient([(dsrv.host, dsrv.port)])
+    dc.publish("ps", "shard-00", "127.0.0.1", a.port, epoch=0)
+    resolver = DirectoryEndpoint(dc, "ps", "shard-00")
+
+    def mk():
+        host, port, epoch = resolver.resolve()
+        return ParameterServerClient(host, port, 0, epoch=epoch)
+
+    client = ResilientPSClient(
+        mk, 0, policy=RetryPolicy(max_attempts=60, base_delay=0.01,
+                                  max_delay=0.1, deadline=30.0),
+        resolver=resolver,
+    )
+    b = None
+    try:
+        client.pull()
+        client.commit(0, {"w": np.ones(16, np.float32)})
+        # primary dies; the replacement registers at epoch 1
+        a._crash()
+        b = SocketParameterServer(dict(tree), DownpourMerge(), 1,
+                                  fence_epoch=1)
+        b.initialize()
+        b.start()
+        dc.publish("ps", "shard-00", "127.0.0.1", b.port, epoch=1)
+        client.pull()                      # reconnect → refresh → B
+        client.commit(0, {"w": np.ones(16, np.float32)})
+        assert b.num_updates == 1
+        assert resolver.refreshes >= 1
+        assert resolver.resolve() == ("127.0.0.1", b.port, 1)
+    finally:
+        client.close()
+        dc.close()
+        if b is not None:
+            b.stop()
+        a.stop()
+        dsrv.stop()
+
+
+def test_build_ps_client_from_directory_alone():
+    """The PR 9 follow-up, by construction: a 2-shard fleet registered
+    in the directory; a worker client is minted from the seeds + the
+    local template ONLY (zero endpoint constructor args), passes the
+    shard-map handshake, and trains exactly-once — while a wrong ring
+    digest fails fast instead of mis-folding."""
+    from distkeras_tpu.parallel.merge_rules import DownpourMerge
+    from distkeras_tpu.sharding import ShardedPSGroup
+    from distkeras_tpu.utils import tree_to_numpy
+
+    rng = np.random.default_rng(0)
+    tree = {"emb": rng.normal(size=(64,)).astype(np.float32),
+            "w": rng.normal(size=(24,)).astype(np.float32),
+            "b": rng.normal(size=(8,)).astype(np.float32)}
+    group = ShardedPSGroup(tree, DownpourMerge(), 1, num_shards=2,
+                           transport="socket")
+    group.initialize()
+    group.start()
+    dsrv = _start(DirectoryServer(default_ttl=None))
+    try:
+        dc = DirectoryClient([(dsrv.host, dsrv.port)])
+        meta = {"num_shards": 2, "ring": group.plan.digest,
+                "vnodes": group.plan.ring.vnodes,
+                "bound": group.plan.bound}
+        for sid, srv in enumerate(group.servers):
+            dc.publish("ps", f"shard-{sid:02d}", srv.host, srv.port,
+                       epoch=0, meta=meta)
+        client = build_ps_client([(dsrv.host, dsrv.port)],
+                                 tree_to_numpy(tree), worker_id=0)
+        base = client.pull()
+        delta = {k: np.full_like(v, 0.5) for k, v in base.items()}
+        client.commit(0, delta)
+        got = client.pull()
+        for k in tree:
+            np.testing.assert_allclose(got[k], base[k] + 0.5)
+        s = group.stats()
+        assert s["num_updates"] == s["num_updates_max"] == 1
+        client.close()
+        # a fleet registered under a DIFFERENT plan digest fails fast
+        dc.publish("ps", "shard-00", group.servers[0].host,
+                   group.servers[0].port, epoch=1,
+                   meta={**meta, "ring": "0" * 40})
+        with pytest.raises(ShardMapMismatchError, match="different plan"):
+            build_ps_client([(dsrv.host, dsrv.port)],
+                            tree_to_numpy(tree), worker_id=1)
+        dc.close()
+    finally:
+        dsrv.stop()
+        group.stop()
+
+
+def test_directory_partition_window_is_retried_through():
+    """A deterministic directory partition (op-count window) tears
+    lookups mid-flight; the client's retry/backoff rides it out and the
+    drops are accounted."""
+    plan = FaultPlan(seed=0, directory_partition_after=2,
+                     directory_partition_ops=3)
+    srv = _start(DirectoryServer(default_ttl=None, fault_plan=plan))
+    try:
+        c = DirectoryClient([(srv.host, srv.port)])
+        c.publish("ps", "shard-00", "h", 1)          # op 1
+        c.publish("ps", "shard-01", "h", 2)          # op 2
+        for _ in range(4):                           # ops 3.. partitioned
+            assert len(c.lookup("ps")) == 2
+        assert plan.stats()["directory_drops"] == 3
+        c.close()
+    finally:
+        srv.stop()
+
+
+# -- trainer integration -----------------------------------------------------
+
+
+def test_trainer_directory_run_and_stats():
+    """directory=True end to end on the socket transport: the run
+    trains through directory-minted clients, the registrations and the
+    final membership land in resilience_stats_, and health_snapshot
+    grows the directory section."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.observability.metrics import health_snapshot
+
+    ds = blobs_dataset(n=256)
+    t = dk.ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+                worker_optimizer="sgd", learning_rate=0.1, num_workers=1,
+                batch_size=32, communication_window=2, num_epoch=1,
+                backend="ps", ps_transport="socket", directory=True,
+                ps_num_shards=2)
+    t.train(ds, shuffle=False)
+    dstats = t.directory_stats_
+    assert [tuple(k) for k in dstats["registered"]] \
+        == [("ps", "shard-00"), ("ps", "shard-01")]
+    keys = {e["key"] for e in dstats["membership"]["entries"]}
+    assert keys == {"shard-00", "shard-01"}
+    assert dstats["primary"]["lookups"] >= 1   # clients were minted here
+    snap = health_snapshot(ps_stats=t.ps_stats_,
+                           directory=dstats["membership"])
+    assert {e["key"] for e in snap["directory"]["entries"]} == keys
+    import json
+
+    json.dumps(snap)          # the health artifact must stay JSON-clean
+    json.dumps(t.resilience_stats_)
+
+
+def test_trainer_validates_directory_knobs():
+    import distkeras_tpu as dk
+
+    kw = dict(loss="sparse_softmax_cross_entropy", worker_optimizer="sgd",
+              num_workers=1, backend="ps")
+    with pytest.raises(ValueError, match="socket"):
+        dk.ADAG(model_spec(), directory=True, **kw)
+    with pytest.raises(ValueError, match="exactly one"):
+        dk.ADAG(model_spec(), ps_transport="socket", directory=True,
+                ps_directory="h:1", **kw)
+    with pytest.raises(ValueError, match="ps_host"):
+        dk.ADAG(model_spec(), ps_transport="socket", directory=True,
+                ps_host="10.0.0.1", **kw)
+    with pytest.raises(ValueError, match="owner"):
+        dk.ADAG(model_spec(), ps_transport="socket", ps_directory="h:1",
+                ps_num_shards=2, **kw)
+    with pytest.raises(ValueError, match="backend='ps'"):
+        dk.ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+                worker_optimizer="sgd", num_workers=1, directory=True)
+    # directory chaos without a directory would silently test nothing
+    with pytest.raises(ValueError, match="directory"):
+        dk.ADAG(model_spec(), ps_transport="socket",
+                fault_plan=FaultPlan(kill_directory_after_ops=5), **kw)
+
+
+def test_trainer_ps_directory_discovers_external_fleet():
+    """ps_directory= : the worker process knows ONLY the directory
+    seeds; the PS owner's fleet (here: a group this test hosts) is
+    discovered, trained against, and the final center pulled — the
+    ps_host story with the wiring looked up instead of hand-passed."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.parameter_servers import SocketParameterServer
+
+    spec = model_spec()
+    t_probe = dk.ADAG(spec, loss="sparse_softmax_cross_entropy",
+                      worker_optimizer="sgd", num_workers=2,
+                      backend="ps")
+    params, _ = t_probe.spec.init_np(t_probe.seed)
+    rule = t_probe.allocate_merge_rule()
+    ps = SocketParameterServer(params, rule, 2)
+    ps.initialize()
+    ps.start()
+    dsrv = _start(DirectoryServer(default_ttl=None))
+    try:
+        dc = DirectoryClient([(dsrv.host, dsrv.port)])
+        dc.publish("ps", "shard-00", "127.0.0.1", ps.port, epoch=0,
+                   meta={"num_shards": 1})
+        dc.close()
+        ds = blobs_dataset(n=256)
+        t = dk.ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+                    worker_optimizer="sgd", learning_rate=0.1,
+                    num_workers=2, batch_size=32,
+                    communication_window=2, num_epoch=1, backend="ps",
+                    ps_transport="socket",
+                    ps_directory=f"{dsrv.host}:{dsrv.port}")
+        t.train(ds, shuffle=False)
+        assert ps.num_updates == t.resilience_stats_["logical_commits"] > 0
+    finally:
+        dsrv.stop()
+        ps.stop()
+
+
+@pytest.mark.parametrize("cls_name", ["ADAG", "DOWNPOUR"])
+def test_chaos_kill_shard_and_directory_primary(cls_name, tmp_path):
+    """THE acceptance (ISSUE 15): kill PS shard 1 AND the directory
+    primary mid-run, with a mid-run elastic joiner whose whole sharded
+    client is minted from a directory lookup (no endpoint constructor
+    args anywhere in the worker path). The run completes exactly-once
+    per shard, both failovers are real, and the post-failover center is
+    bit-identical to the durable no-fault oracle (per-shard WAL
+    replay)."""
+    import jax
+
+    import distkeras_tpu as dk
+    from distkeras_tpu.resilience.wal import recover_ps_state
+    from distkeras_tpu.sharding.ring import ShardPlan
+
+    cls = getattr(dk, cls_name)
+    wal = str(tmp_path / "wal")
+    plan = FaultPlan(seed=3, drop_recv=0.01, max_faults=10,
+                     kill_ps_after_commits=8, kill_shard_id=1,
+                     kill_directory_after_ops=25,
+                     join_worker_at_window={0: 2})
+    t = cls(model_spec(), loss="sparse_softmax_cross_entropy",
+            worker_optimizer="sgd", learning_rate=0.05, num_workers=2,
+            batch_size=16, communication_window=2, num_epoch=2,
+            backend="ps", ps_transport="socket", ps_num_shards=2,
+            ps_chain_length=2, ps_wal_dir=wal, ps_failover_timeout=0.5,
+            heartbeat_interval=0.1, elastic=True, directory=True,
+            fault_plan=plan,
+            retry_policy=RetryPolicy(max_attempts=200, base_delay=0.005,
+                                     max_delay=0.2, deadline=120))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # both failover warnings expected
+        with plan:
+            t.train(blobs_dataset(n=768), shuffle=True)
+
+    fs = plan.stats()
+    assert fs["ps_kills"] == 1 and fs["directory_kills"] == 1
+    assert fs["joins"] == 1
+    rs = t.resilience_stats_
+    # (a) both failovers really ran: the shard's chain promoted AND the
+    # directory's standby took over
+    assert rs["ps_failover"]["failovers"] >= 1
+    assert rs["directory"]["failover"]["failovers"] >= 1
+    # (b) exactly-once per shard across both kills + the live join
+    s = t.ps_stats_
+    assert s["num_updates"] == s["num_updates_max"] \
+        == rs["logical_commits"]
+    assert rs["elastic"]["assigner"]["exactly_once"]
+    assert rs["elastic"]["joined"] == 1
+    # (c) the joiner (like every worker) was minted from the directory:
+    # lookups flowed through the surviving replica
+    looked = (rs["directory"]["primary"]["lookups"]
+              + fs["directory_ops"])
+    assert looked > 0
+    # (d) the post-failover center is bit-identical to the durable
+    # oracle: each shard's ACTIVE log replays to exactly its final
+    # sub-center (the repo's no-fault-oracle contract — the state a
+    # never-crashed server holds after the same fold sequence)
+    spec = model_spec()
+    params, _ = t.spec.init_np(t.seed)
+    sp = ShardPlan(params, 2)
+    rule = t.allocate_merge_rule()
+    per = rs["ps_failover"]["per_shard"]
+    parts = []
+    for sid in range(2):
+        d = os.path.join(wal, f"shard-{sid:02d}")
+        if per[sid]["failovers"] \
+                and per[sid]["failover_log"][0]["via"] == "standby":
+            d = os.path.join(d, "chain-1")
+        # replay with the server's CONFIGURED worker count (the fold
+        # scale ADAG uses), not the elastically-grown pool
+        st = recover_ps_state(d, rule, t.num_workers, None,
+                              template=sp.shard_template(params, sid))
+        assert st is not None, d
+        parts.append(st["center"])
+    replayed = sp.join(parts)
+    for a, b in zip(jax.tree.leaves(replayed),
+                    jax.tree.leaves(t.trained_params_)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # (e) the whole root — shard logs, chain logs, directory logs —
+    # verifies as one aggregate report naming the directory dirs
+    rep = walmod.verify_tree(wal)
+    assert rep["ok"], rep
+    assert rep["num_directory_dirs"] >= 1
+    # (f) it still learned something through all of that
+    assert final_loss(t) < 1.5
+
+
+# -- the serving router ------------------------------------------------------
+
+
+VOCAB, MAXLEN = 64, 64
+
+
+@pytest.fixture(scope="module")
+def lm():
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models.lm import transformer_lm
+
+    spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=32, heads=4,
+                          depth=2, dtype=jnp.float32,
+                          pos_embedding="rope", kv_heads=2)
+    params, _ = spec.init_np(0)
+    return spec, params
+
+
+def _serve_replica(spec, params, directory_seeds, key):
+    from distkeras_tpu.serving.scheduler import GenerationEngine
+    from distkeras_tpu.serving.server import GenerationServer
+
+    eng = GenerationEngine(spec, params, max_batch=4, block_size=8,
+                           max_queue=32)
+    srv = GenerationServer(eng, poll_interval=0.02)
+    srv.start()
+    srv.register_with(directory_seeds, key=key, ttl=1.0)
+    return srv
+
+
+def _hard_kill(srv):
+    """Tear a GenerationServer like a process kill: listener and every
+    live connection gone mid-stream (no drain)."""
+    srv._dir_stop.set()       # a corpse renews nothing
+    srv._running = False
+    srv.engine.stop(drain=False, timeout=2)
+    try:
+        srv._server_sock.close()
+    except OSError:
+        pass
+    with srv._conns_lock:
+        conns = list(srv._conns)
+    for c in conns:
+        try:
+            c.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            c.close()
+        except OSError:
+            pass
+
+
+def test_router_prefix_affinity_and_replica_kill(lm):
+    """The router acceptance: ≥8 concurrent clients over 2 replicas —
+    same-prefix requests land on the same replica (prefix-hash
+    affinity), traffic spreads across both, one replica is killed
+    mid-stream, and EVERY surviving stream completes (greedy streams
+    matching the dense oracle — the replayed request is bit-identical
+    to an unrouted one)."""
+    from distkeras_tpu.models.lm import generate
+
+    spec, params = lm
+    dsrv = _start(DirectoryServer(default_ttl=None))
+    seeds = [(dsrv.host, dsrv.port)]
+    a = _serve_replica(spec, params, seeds, "a")
+    b = _serve_replica(spec, params, seeds, "b")
+    router = RoutedGenerationClient(directory=seeds, prefix_tokens=4,
+                                    cooldown=0.5)
+    try:
+        assert set(router.replicas) == {"a", "b"}
+        rng = np.random.default_rng(0)
+        prefixes = [rng.integers(0, VOCAB, (4,)).astype(np.int32)
+                    for _ in range(6)]
+        # warm sequential pass: affinity — repeats of ONE prefix (with
+        # different tails) land on exactly one replica
+        for _ in range(2):
+            router.generate(np.concatenate([
+                prefixes[0], rng.integers(0, VOCAB, (3,)).astype(np.int32),
+            ]), max_new_tokens=2)
+        before = dict(router.stats()["routed"])
+        for _ in range(3):
+            router.generate(np.concatenate([
+                prefixes[0], rng.integers(0, VOCAB, (3,)).astype(np.int32),
+            ]), max_new_tokens=2)
+        after = router.stats()["routed"]
+        moved = {k: after.get(k, 0) - before.get(k, 0) for k in after}
+        assert sum(1 for v in moved.values() if v) == 1, moved
+        # distinct prefixes spread: both replicas see traffic
+        for p in prefixes:
+            router.generate(p, max_new_tokens=2)
+        spread = router.stats()["routed"]
+        assert all(spread.get(k, 0) > 0 for k in ("a", "b")), spread
+
+        # ≥8 concurrent clients; one replica killed mid-stream
+        results: dict[int, np.ndarray] = {}
+        errs: dict[int, BaseException] = {}
+        prompts = []
+
+        def go(i, prompt):
+            try:
+                results[i] = router.generate(prompt, max_new_tokens=12)
+            except BaseException as e:  # noqa: BLE001 — asserted empty
+                errs[i] = e
+
+        threads = []
+        for i in range(10):
+            p = np.concatenate([
+                prefixes[i % len(prefixes)],
+                rng.integers(0, VOCAB, (5,)).astype(np.int32),
+            ])
+            prompts.append(p)
+            th = threading.Thread(target=go, args=(i, p))
+            th.start()
+            threads.append(th)
+        time.sleep(0.05)          # let streams get in flight
+        _hard_kill(a)
+        for th in threads:
+            th.join(timeout=90)
+        assert not errs, errs
+        assert len(results) == 10
+        assert router.stats()["failovers"] >= 1
+        # the replayed greedy streams match the dense oracle
+        for i in (0, 5):
+            oracle = generate(spec, params, prompts[i][None],
+                              12)[0, len(prompts[i]):]
+            np.testing.assert_array_equal(
+                results[i], oracle[: len(results[i])]
+            )
+        # the killed replica DRAINS from discovery: its lease (1.0 s,
+        # renewed at a third) lapses within 3× the TTL even under suite
+        # load, and a refresh then routes around the corpse entirely
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if all(e["key"] != "a" for e in
+                   DirectoryClient(seeds).lookup("serve")):
+                break
+            time.sleep(0.1)
+        router.refresh(force=True)
+        assert set(router.replicas) == {"b"}
+    finally:
+        router.close()
+        _hard_kill(b)
+        dsrv.stop()
+
+
+def test_serving_register_with_withdraws_on_stop(lm):
+    spec, params = lm
+    dsrv = _start(DirectoryServer(default_ttl=None))
+    try:
+        srv = _serve_replica(spec, params, [(dsrv.host, dsrv.port)], "r")
+        c = DirectoryClient([(dsrv.host, dsrv.port)])
+        assert [e["key"] for e in c.lookup("serve")] == ["r"]
+        srv.stop()
+        assert c.lookup("serve") == []    # clean stop withdraws
+        c.close()
+    finally:
+        dsrv.stop()
+
+
+# -- shm rendezvous ----------------------------------------------------------
+
+
+def test_shm_rendezvous_registers_and_withdraws_segments():
+    """ROADMAP item 5 residual: dkshm segments minted while a directory
+    rendezvous is installed are discoverable by name through the
+    directory (so separate trainer processes on one host can share the
+    lane), and every unlink withdraws — the process registry stays the
+    no-directory fallback."""
+    from distkeras_tpu import shm as shmmod
+    from distkeras_tpu.directory import install_shm_rendezvous
+
+    dsrv = _start(DirectoryServer(default_ttl=None))
+    dc = DirectoryClient([(dsrv.host, dsrv.port)])
+    uninstall = install_shm_rendezvous(dc)
+    seg = None
+    try:
+        seg = shmmod.mint_segment("dkshm_rdvtest", 4096)
+        names = [e["key"] for e in dc.shm_segments()]
+        assert seg.name in names
+        assert dc.lookup("shm", seg.name)[0]["meta"]["bytes"] == seg.size
+        seg.close()
+        seg.unlink()
+        shmmod.unregister_segment(seg.name)
+        assert dc.shm_segments() == []
+        seg = None
+    finally:
+        if seg is not None:
+            try:
+                seg.close()
+                seg.unlink()
+                shmmod.unregister_segment(seg.name)
+            except Exception:
+                pass
+        uninstall()
+        # the fallback path is untouched after uninstall
+        assert shmmod._RENDEZVOUS is None
+        dc.close()
+        dsrv.stop()
