@@ -2402,6 +2402,112 @@ def _serve_open_loop(port, prompts, max_new, rate, seconds, seed):
     return lats, wall, errors
 
 
+def run_serve_hotswap_bench(spec, params, prompts, seq_rps, max_new=48,
+                            max_batch=16, block_size=16, seconds=6.0,
+                            swap_interval=None, seed=0):
+    """Hot-swap serving leg (ISSUE 16): the same Poisson open-loop load,
+    with a deployer thread flipping the engine between two weight sets
+    through the refill version gate mid-window. The number that matters:
+    p99 across swap events vs a no-swap window at the SAME offered rate
+    — the end-to-end latency price of a live deployment. A refill swap
+    re-prefills every in-flight row under the new weights, so the
+    penalty is real work (repeated prefill), not queueing artifact;
+    ``swap_events``/``refilled`` off ``engine.stats()`` say how many
+    requests actually paid it. ``host_cores`` rides the record: on a
+    1-core host prefill replay and decode contend for the same core and
+    the penalty reads as an upper bound for the TPU regime."""
+    from distkeras_tpu.serving import (
+        GenerationClient,
+        GenerationEngine,
+        GenerationServer,
+    )
+
+    # a second init of the same spec: identical shapes, so the gate
+    # never recompiles — exactly what a streamed training snapshot is
+    params_b, _ = spec.init_np(seed + 1)
+    engine = GenerationEngine(spec, params, max_batch=max_batch,
+                              block_size=block_size, max_queue=256,
+                              model_version=1)
+    server = GenerationServer(engine)
+    server.start()
+    try:
+        def _warm(i):
+            c = GenerationClient("127.0.0.1", server.port)
+            c.generate(prompts[i % len(prompts)], max_new_tokens=max_new)
+            c.close()
+
+        ws = [threading.Thread(target=_warm, args=(i,))
+              for i in range(max_batch)]
+        for w in ws:
+            w.start()
+        for w in ws:
+            w.join(timeout=300)
+
+        rate = max(0.5, 2.0 * seq_rps)
+        base_lats, base_wall, base_errors = _serve_open_loop(
+            server.port, prompts, max_new, rate, seconds, seed)
+
+        interval = (max(0.5, seconds / 4.0) if swap_interval is None
+                    else float(swap_interval))
+        stop = threading.Event()
+        flips = [params, params_b]
+
+        def deployer():
+            v = 1
+            while not stop.wait(interval):
+                v += 1
+                engine.swap_params(flips[v % 2], v, policy="refill")
+
+        dep = threading.Thread(target=deployer, daemon=True)
+        dep.start()
+        lats, wall, errors = _serve_open_loop(
+            server.port, prompts, max_new, rate, seconds, seed + 1)
+        stop.set()
+        dep.join(timeout=10)
+        stats = engine.stats()
+
+        def _pcts(xs):
+            if not xs:
+                return None, None
+            ms = np.sort(np.asarray(xs)) * 1e3
+            return (round(float(np.percentile(ms, 50)), 1),
+                    round(float(np.percentile(ms, 99)), 1))
+
+        b50, b99 = _pcts(base_lats)
+        s50, s99 = _pcts(lats)
+        rec = {
+            "config": "serve_hotswap",
+            "offered_rps": round(rate, 2),
+            "seconds_per_window": seconds,
+            "swap_interval_s": round(interval, 2),
+            "no_swap": {"completed": len(base_lats),
+                        "errors": len(base_errors),
+                        "throughput_rps": round(
+                            len(base_lats) / base_wall, 2),
+                        "p50_ms": b50, "p99_ms": b99},
+            "swap": {"completed": len(lats), "errors": len(errors),
+                     "throughput_rps": round(
+                         len(lats) / wall, 2) if lats else 0.0,
+                     "p50_ms": s50, "p99_ms": s99},
+            "swap_events": stats["swaps"],
+            "refilled_requests": stats["refilled"],
+            "p99_swap_penalty_ms": (round(s99 - b99, 1)
+                                    if s99 is not None and b99 is not None
+                                    else None),
+            "final_model_version": stats["model_version"],
+            "blocks_in_use_after": stats["blocks_in_use"],
+            "host_cores": os.cpu_count() or 1,
+        }
+        log(f"[serve] hotswap @ {rate:.2f} req/s: p99 "
+            f"{b99} ms no-swap -> {s99} ms across "
+            f"{rec['swap_events']} swaps ({rec['refilled_requests']} "
+            f"requests re-prefilled)")
+        log(json.dumps(rec))
+        return rec
+    finally:
+        server.stop(drain=False, timeout=10)
+
+
 def run_serving_bench(vocab=1024, maxlen=160, dim=512, heads=8, depth=4,
                       dtype_name="f32", prompt_len=16, max_new=48,
                       max_batch=16, block_size=16, n_baseline=6,
@@ -2416,7 +2522,9 @@ def run_serving_bench(vocab=1024, maxlen=160, dim=512, heads=8, depth=4,
     'paged' (the headline), 'int8' (weight-only quantized engine — same
     server, same cache), 'spec' (self-draft speculative serving: the
     acceptance=1.0 upper bound of draft-based serving — a real deployment
-    substitutes a trained draft).
+    substitutes a trained draft), 'hotswap' (live-deployment leg: p99
+    across refill-gate weight swaps vs a no-swap window at the same
+    offered rate — ISSUE 16).
 
     The default model/dtype is sized so a BATCH-1 decode step is WEIGHT-
     STREAMING bound (dim 512 x 4 layers f32: ~50 MB of kernels stream per
@@ -2490,11 +2598,20 @@ def run_serving_bench(vocab=1024, maxlen=160, dim=512, heads=8, depth=4,
                                     spec_tokens=4)
         if leg != "paged":
             raise ValueError(f"unknown serving leg {leg!r} "
-                             f"(choose from paged, int8, spec)")
+                             f"(choose from paged, int8, spec, hotswap)")
         return GenerationEngine(spec, params, max_batch=max_batch,
                                 block_size=block_size, max_queue=256)
 
     out = {}
+    if "hotswap" in legs:
+        # the live-deployment leg rides the same baseline/prompts but
+        # owns its server lifecycle (a deployer thread flips weights
+        # mid-window) — see run_serve_hotswap_bench
+        out["serve_hotswap"] = run_serve_hotswap_bench(
+            spec, params, prompts, seq_rps, max_new=max_new,
+            max_batch=max_batch, block_size=block_size, seconds=seconds,
+            seed=seed)
+        legs = tuple(x for x in legs if x != "hotswap")
     for leg in legs:
         engine = build_engine(leg)
         server = GenerationServer(engine)
@@ -2674,7 +2791,8 @@ def main():
                     help="serving benchmark engine batch slots")
     ap.add_argument("--serve-legs", default="paged,int8,spec",
                     help="comma-separated serving legs to run "
-                         "(paged,int8,spec)")
+                         "(paged,int8,spec,hotswap — hotswap measures "
+                         "p99 across live weight swaps vs no-swap)")
     ap.add_argument("--trace-dir", default=None,
                     help="enable the flight recorder for every leg and "
                          "write one Perfetto-loadable Chrome trace JSON "

@@ -1,0 +1,48 @@
+"""Live deployment: stream weights from the training PS into the serving
+tier, hot-swap them atomically between decode steps, and orchestrate
+canary rollout / SLO-gated rollback across the serving fleet.
+
+Three layers (DESIGN.md "Live deployment"):
+
+- :mod:`~distkeras_tpu.deploy.stream` — serving-side *read replicas* of
+  the training center. A :class:`ReadReplica` speaks the exact
+  chain-replication record protocol the hot standby does (the primary's
+  ``attach_standby`` connects to it), applies records through the one
+  shared ``replay_record``, and forwards raw frames down-chain so N
+  serving hosts share one stream off the trainer. A
+  :class:`WeightStreamer` owns one replica per shard, cuts *versioned
+  model snapshots* at fold-count/epoch boundaries (never per-commit),
+  assembles the sharded consistent cut, and reports the published
+  version back into ``ps.stats()['deploy_lag_folds']``.
+- the serving engine's swap gate —
+  :meth:`~distkeras_tpu.serving.scheduler.GenerationEngine.swap_params`
+  stages ``(params, version)`` and applies them BETWEEN decode steps, so
+  one ``decode_step`` can never mix two weight sets.
+- :mod:`~distkeras_tpu.deploy.rollout` — a pure hysteresis state machine
+  (:class:`RolloutPolicy`, the ``ElasticPolicy`` discipline) plus the
+  :class:`RolloutController` that pins a canary fraction of
+  directory-registered replicas to a candidate version, promotes on
+  watchdog-green, and rolls back on a firing ``ServingSLORule``.
+"""
+
+from distkeras_tpu.deploy.rollout import (  # noqa: F401
+    RolloutController,
+    RolloutPolicy,
+    watchtower_health,
+)
+from distkeras_tpu.deploy.stream import (  # noqa: F401
+    ModelSnapshot,
+    ReadReplica,
+    SnapshotStore,
+    WeightStreamer,
+)
+
+__all__ = [
+    "ModelSnapshot",
+    "ReadReplica",
+    "SnapshotStore",
+    "WeightStreamer",
+    "RolloutPolicy",
+    "RolloutController",
+    "watchtower_health",
+]

@@ -125,6 +125,10 @@ class RoutedGenerationClient:
         self.connect_timeout = float(connect_timeout)
         self._lock = threading.Lock()
         self._replicas: dict[str, tuple[str, int]] = {}
+        # per-replica registration meta (directory-discovered routers):
+        # carries the replica's advertised model_version — the canary
+        # promotion decision reads the per-version routed split below
+        self._meta: dict[str, dict] = {}
         self._ring: _ReplicaRing | None = None
         self._conns: dict[str, object] = {}
         self._conn_locks: dict[str, threading.Lock] = {}
@@ -132,6 +136,10 @@ class RoutedGenerationClient:
         self._last_refresh = 0.0
         self._calls = 0
         self.routed: dict[str, int] = {}   # per-replica request counts
+        # per-model-version request counts (the version each serving
+        # replica ADVERTISED when the request landed on it): the A/B
+        # split observability a canary rollout reads
+        self.routed_by_version: dict[int, int] = {}
         self.failovers = 0
         if replicas is not None:
             if not isinstance(replicas, dict):
@@ -144,10 +152,13 @@ class RoutedGenerationClient:
 
     # -- replica set ---------------------------------------------------------
 
-    def _install(self, replicas: dict[str, tuple[str, int]]) -> None:
+    def _install(self, replicas: dict[str, tuple[str, int]],
+                 meta: dict[str, dict] | None = None) -> None:
         with self._lock:
             gone = set(self._replicas) - set(replicas)
             self._replicas = dict(replicas)
+            self._meta = {k: dict(meta.get(k) or {}) for k in replicas} \
+                if meta is not None else {k: {} for k in replicas}
             self._ring = _ReplicaRing(self._replicas, vnodes=self.vnodes)
             for key in gone:
                 conn = self._conns.pop(key, None)
@@ -171,14 +182,25 @@ class RoutedGenerationClient:
                 return
             self._last_refresh = now
         entries = self.directory.lookup("serve")
-        self._install({
-            e["key"]: (e["host"], int(e["port"])) for e in entries
-        })
+        self._install(
+            {e["key"]: (e["host"], int(e["port"])) for e in entries},
+            meta={e["key"]: e.get("meta") for e in entries},
+        )
 
     @property
     def replicas(self) -> dict[str, tuple[str, int]]:
         with self._lock:
             return dict(self._replicas)
+
+    def replica_versions(self) -> dict[str, int]:
+        """Each replica's advertised ``model_version`` (0 when its
+        registration carries none) — the rollout controller's fleet
+        view, and the key set its canary pick orders."""
+        with self._lock:
+            return {
+                k: int((self._meta.get(k) or {}).get("model_version", 0))
+                for k in self._replicas
+            }
 
     # -- routing -------------------------------------------------------------
 
@@ -264,6 +286,10 @@ class RoutedGenerationClient:
                         out = conn.generate(prompt, **kw)
                     with self._lock:
                         self.routed[key] = self.routed.get(key, 0) + 1
+                        v = int((self._meta.get(key) or {})
+                                .get("model_version", 0))
+                        self.routed_by_version[v] = \
+                            self.routed_by_version.get(v, 0) + 1
                     return out
                 except ServerBusyError as e:
                     # healthy but full: brief cooldown steers the next
@@ -302,6 +328,12 @@ class RoutedGenerationClient:
                 "replicas": {k: list(v)
                              for k, v in self._replicas.items()},
                 "routed": dict(self.routed),
+                "routed_by_version": dict(self.routed_by_version),
+                "replica_versions": {
+                    k: int((self._meta.get(k) or {})
+                           .get("model_version", 0))
+                    for k in self._replicas
+                },
                 "failovers": self.failovers,
                 "cooling": sorted(
                     k for k, t in self._down_until.items()

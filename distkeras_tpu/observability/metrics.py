@@ -229,6 +229,11 @@ _PS_SCHEMA: tuple[tuple[str, str, str, str], ...] = (
      "drains whose deadline lapsed into force-drain"),
     ("elapsed_s", "dk_ps_uptime_seconds", "gauge",
      "seconds since server construction"),
+    ("deploy_version", "dk_ps_deploy_version", "gauge",
+     "newest fold-count version the serving tier reported materialized"),
+    ("deploy_lag_folds", "dk_ps_deploy_lag_folds", "gauge",
+     "folds the center is ahead of the newest served snapshot "
+     "(0 until a deployer reports a version)"),
 )
 
 _SERVING_SCHEMA: tuple[tuple[str, str, str, str], ...] = (

@@ -378,6 +378,7 @@ _PS_SERIES: tuple[tuple[str, str], ...] = (
     ("drain_timeouts", "counter"),
     ("active_workers", "gauge"), ("pool_size", "gauge"),
     ("center_lock_mean_hold_ns", "gauge"), ("wal_group_max", "gauge"),
+    ("deploy_version", "gauge"), ("deploy_lag_folds", "gauge"),
 )
 
 

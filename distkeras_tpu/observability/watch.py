@@ -45,7 +45,8 @@ from distkeras_tpu.observability.timeseries import (
 __all__ = [
     "Alert", "AlertRule", "TauP95Rule", "CommitSkewRule",
     "CommitReplaySpikeRule", "WalFsyncTailRule", "RingOccupancyRule",
-    "ServingSLORule", "LossStallRule", "BottleneckShiftRule", "SLOClass",
+    "DeployLagRule", "ServingSLORule", "LossStallRule",
+    "BottleneckShiftRule", "SLOClass",
     "default_rules", "Watchdog", "Watchtower", "rates_from_counts",
     "worker_rates", "rounds_per_sec", "straggler_workers",
     "watch_endpoint",
@@ -273,6 +274,34 @@ class WalFsyncTailRule(AlertRule):
         return v > self.threshold, v, {"wal_fsync_p95_ms": v}
 
 
+class DeployLagRule(AlertRule):
+    """Serving-tier staleness: the deploy lag (``ps.deploy_lag_folds``
+    — folds the training center is ahead of the newest snapshot the
+    serving tier materialized) crossed ``bound``. A streamer that
+    detached, a stalled publisher thread, or a snapshot cadence far
+    coarser than the fold rate all land here: training keeps moving
+    while served weights quietly age. Silent on training-only runs —
+    until a deployer reports a version (``ps.deploy_version`` > 0)
+    there is nothing to lag behind."""
+
+    kind = "deploy_lag"
+
+    def __init__(self, bound: float = 500.0, **kw):
+        super().__init__(**kw)
+        self.threshold = float(bound)
+
+    def check(self, store, now):
+        dv = store.last("ps.deploy_version")
+        if dv is None or dv <= 0:
+            return None, None, None
+        v = store.last("ps.deploy_lag_folds")
+        if v is None:
+            return None, None, None
+        return v > self.threshold, v, {
+            "deploy_lag_folds": v, "deploy_version": dv,
+        }
+
+
 class RingOccupancyRule(AlertRule):
     """shm ring saturation: the fullest ring's used fraction
     (``shm.ring_occupancy_frac``) crossed ``frac`` — the writer is
@@ -448,6 +477,7 @@ def default_rules(slo: dict | None = None,
         CommitReplaySpikeRule(),
         WalFsyncTailRule(),
         RingOccupancyRule(),
+        DeployLagRule(),
         ServingSLORule(slo=slo),
         LossStallRule(),
         BottleneckShiftRule(),

@@ -379,6 +379,14 @@ class ParameterServer:
         # action then carries the alert ledger to remote scrapers.
         self._tau_recent: collections.deque = collections.deque(maxlen=512)
         self.watchtower = None
+        # Live-deployment accounting (distkeras_tpu/deploy): the newest
+        # center version a read replica MATERIALIZED as a serving
+        # snapshot, reported back via report_deploy_version (in-process)
+        # or the deploy_report wire action. 0 = nothing deployed yet —
+        # stats() then reports deploy_lag_folds as 0, not num_updates,
+        # so training-only runs never look behind. Guarded by
+        # _stats_lock (monotone max, telemetry not durable state).
+        self._deploy_version = 0
         # shard-map handshake record (distkeras_tpu/sharding): when this
         # server holds ONE SHARD of a partitioned center, the group sets
         # {"shard_id", "num_shards", "ring"} here; ping and the
@@ -1156,6 +1164,26 @@ class ParameterServer:
             self._wal.sync()  # the fence ack implies durability
         return out
 
+    def mark_epoch(self, epoch: int) -> None:
+        """Log a training-epoch boundary into the WAL/replication stream
+        (REC_EPOCH). Ordered against the folds by the center lock, so a
+        read replica sees the mark at EXACTLY the fold count the barrier
+        observed — the deployer's epoch-boundary snapshot cut. Cheap
+        no-op when neither a WAL nor a replica stream is attached."""
+        with self._lock:
+            if self._wal is not None or self._replica_sock is not None:
+                from distkeras_tpu.resilience import wal as _wal
+
+                self._log_locked(
+                    _wal.encode_record(_wal.REC_EPOCH, (int(epoch),))
+                )
+
+    def report_deploy_version(self, version: int) -> None:
+        """A read replica reports the newest center version it published
+        as a serving snapshot (monotone max; see deploy/stream.py)."""
+        with self._stats_lock:
+            self._deploy_version = max(self._deploy_version, int(version))
+
     def attach_standby(self, host: str, port: int,
                        timeout: float = 10.0) -> None:
         """Connect the hot-standby replication stream: send the replica a
@@ -1342,6 +1370,7 @@ class ParameterServer:
             joined = self._n_joined
             preempted = self._n_preempted
             drain_to = self._n_drain_timeouts
+            deploy_v = self._deploy_version
         hb = self._registry.stats()
         wal = self._wal
         return build_ps_stats(
@@ -1360,6 +1389,7 @@ class ParameterServer:
             pool_size=pool, joined_workers=joined,
             preempted_workers=preempted, drain_timeouts=drain_to,
             fused_exchanges=fusedx, batched_folds=batched,
+            deploy_version=deploy_v,
         )
 
 
@@ -1375,7 +1405,8 @@ def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
                    joined_workers: int = 0, preempted_workers: int = 0,
                    drain_timeouts: int = 0,
                    fused_exchanges: int = 0,
-                   batched_folds: int = 0) -> dict:
+                   batched_folds: int = 0,
+                   deploy_version: int = 0) -> dict:
     """The ONE stats-dict builder both PS transports share (Python counters
     here, C++ atomics via ``native_ps.NativeSocketParameterServer.stats``):
     key set and derived-value math are pinned by construction, so the
@@ -1440,6 +1471,14 @@ def build_ps_stats(pulls: int, compressed_pulls: int, commits: int,
         # workers' windows folded under < K acquisitions. 0 on the
         # native transport (its C++ fold path is per-commit).
         "batched_folds": batched_folds,
+        # live-deployment lag (distkeras_tpu/deploy): the newest center
+        # version published to the serving tier, and how many folds the
+        # training head is ahead of it. 0/0 until a deployer reports —
+        # the gated DeployLagRule stays silent on training-only runs.
+        "deploy_version": deploy_version,
+        "deploy_lag_folds": (
+            max(0, num_updates - deploy_version) if deploy_version else 0
+        ),
     }
 
 
@@ -1607,6 +1646,17 @@ class SocketParameterServer(ParameterServer):
                         conn, {"ok": True,
                                "epoch": self.fence(int(msg["epoch"]))}
                     )
+                elif action == "mark_epoch":
+                    # trainer epoch barrier: log the boundary into the
+                    # WAL/replication stream (deploy/stream.py cuts its
+                    # epoch snapshots from this mark)
+                    self.mark_epoch(int(msg["epoch"]))
+                    networking.send_data(conn, {"ok": True})
+                elif action == "deploy_report":
+                    # a read replica published a serving snapshot at this
+                    # center version — feeds deploy_lag_folds in stats()
+                    self.report_deploy_version(int(msg["version"]))
+                    networking.send_data(conn, {"ok": True})
                 elif action == "heartbeat":
                     # lease renewal (auto-registers); retries is the
                     # client's cumulative reconnect-and-retry count
@@ -2141,6 +2191,22 @@ class ParameterServerClient:
             self._sock, {"action": "fence", "epoch": int(epoch)}
         )
         return int(networking.recv_data(self._sock).get("epoch", epoch))
+
+    def mark_epoch(self, epoch: int) -> None:
+        """Log a training-epoch boundary into the server's WAL/replication
+        stream (the deployer's epoch-snapshot cut point)."""
+        networking.send_data(
+            self._sock, {"action": "mark_epoch", "epoch": int(epoch)}
+        )
+        networking.recv_data(self._sock)
+
+    def report_deploy_version(self, version: int) -> None:
+        """Report the newest center version published to the serving tier
+        (feeds the server's ``deploy_lag_folds`` gauge)."""
+        networking.send_data(
+            self._sock, {"action": "deploy_report", "version": int(version)}
+        )
+        networking.recv_data(self._sock)
 
     def shard_map(self) -> dict | None:
         """Shard-map handshake: the server's shard record

@@ -91,7 +91,8 @@ class ShardAssigner:
 
     def __init__(self, n_rows: int, window: int, batch_size: int,
                  num_epoch: int, seed: int = 0, shuffle: bool = False,
-                 start_epoch: int = 0):
+                 start_epoch: int = 0,
+                 on_epoch_complete: Callable[[int], None] | None = None):
         self.n_rows = int(n_rows)
         self.window = int(window)
         self.batch_size = int(batch_size)
@@ -117,6 +118,13 @@ class ShardAssigner:
         self._claims = 0
         self._released_blocks = 0
         self._stale_completions = 0
+        #: fired (outside the lock) when the LAST block of an epoch
+        #: confirms — the one membership-independent epoch boundary an
+        #: elastic run has; run_async_training points it at
+        #: ``ps.mark_epoch`` so the deployer's epoch-cut snapshots (and
+        #: the elastic epoch-barrier checkpoint that falls out of them)
+        #: exist without a fixed-pool rendezvous
+        self.on_epoch_complete = on_epoch_complete
 
     def _perm(self, epoch: int) -> np.ndarray:
         """The epoch's row order (cached while the epoch is live). Seeded
@@ -181,6 +189,7 @@ class ShardAssigner:
         already be reassigned; the caller's work stands (its commit
         folded) but the accounting belongs to the new owner."""
         key = (int(epoch), int(block))
+        retired = False
         with self._cv:
             owner = self._inflight.get(key)
             if owner != worker_id:
@@ -191,8 +200,14 @@ class ShardAssigner:
             self._done[epoch].add(block)
             if len(self._done[epoch]) == self.blocks_per_epoch:
                 self._perms.pop(epoch, None)  # epoch retired: free the perm
+                retired = True
             self._cv.notify_all()
-            return True
+        if retired and self.on_epoch_complete is not None:
+            try:
+                self.on_epoch_complete(int(epoch))
+            except Exception:  # noqa: BLE001
+                pass  # the mark is advisory: never fail a completion
+        return True
 
     def release(self, worker_id: int) -> int:
         """Hand the worker's in-flight blocks back to the pool (the

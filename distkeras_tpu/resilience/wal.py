@@ -107,6 +107,12 @@ REC_DIR_PUT = 20       # (role, key, host, port, epoch, meta, ttl, version)
 REC_DIR_DEL = 21       # (role, key, epoch, version)
 REC_DIR_EXPIRE = 22    # ([(role, key), ...], version)
 REC_DIR_FENCE = 23     # (epoch, version)
+# training-epoch boundary marker (distkeras_tpu/deploy): logged by the PS
+# when the trainer's epoch barrier completes, so downstream read replicas
+# see epoch edges IN the replication stream (ordered against the folds)
+# instead of guessing from fold counts. Does not mutate recoverable PS
+# state beyond an advisory mark — old logs without it replay unchanged.
+REC_EPOCH = 24         # (epoch,)
 
 _HDR = struct.Struct(">BII")  # type, crc32(body or prefix), len(body)
 # split-checksum prefixes (little-endian: the native writer memcpy's
@@ -833,6 +839,13 @@ def replay_record(state: dict, rec_type: int, body: Any, rule,
     elif rec_type in (REC_FENCE, REC_FENCE_FLAT):
         (epoch,) = body
         state["fence_epoch"] = max(state["fence_epoch"], epoch)
+    elif rec_type == REC_EPOCH:
+        # advisory training-epoch mark: stored OUTSIDE ps_state_dict's
+        # fixed shape (lazily, only when present) so snapshots from
+        # before the record type existed round-trip byte-identically
+        (epoch,) = body
+        state["epoch_mark"] = max(int(state.get("epoch_mark", -1)),
+                                  int(epoch))
     # unknown types: forward-compat skip
 
 
@@ -956,6 +969,7 @@ _REC_NAMES = {
     REC_FENCE: "fence", REC_FENCE_FLAT: "fence",
     REC_DIR_PUT: "dir_put", REC_DIR_DEL: "dir_del",
     REC_DIR_EXPIRE: "dir_expire", REC_DIR_FENCE: "dir_fence",
+    REC_EPOCH: "epoch",
 }
 
 #: record-name prefix marking a membership-directory log — ``verify``

@@ -146,6 +146,10 @@ class Request:
         # strict-FIFO; the label buckets the latency telemetry so the
         # watchdog can hold each class to ITS bound (interactive vs batch)
         self.slo_class = str(slo_class)
+        # the engine's model version this request was ADMITTED under
+        # (stamped at admission; re-stamped when a hot swap re-prefills
+        # it) — the version its served stream is bit-identical to
+        self.model_version: int | None = None
         self.new_tokens: list[int] = []
         self.state = "queued"
         self.error: str | None = None
@@ -207,7 +211,7 @@ class GenerationEngine:
     def __init__(self, model, params, *, max_batch: int = 8,
                  block_size: int = 16, num_blocks: int | None = None,
                  max_queue: int = 64, draft=None, draft_params=None,
-                 spec_tokens: int = 4):
+                 spec_tokens: int = 4, model_version: int = 0):
         from distkeras_tpu.models.lm import TransformerLM
 
         module = model.module if isinstance(model, ModelSpec) else model
@@ -225,6 +229,13 @@ class GenerationEngine:
             )
         self._module = module
         self._params = params
+        # live-deployment version gate (distkeras_tpu/deploy): _params is
+        # ONLY ever replaced at the top of step(), on the scheduler
+        # thread, under the lock — swap_params from any other thread just
+        # STAGES (params, version, policy) here. One decode_step can
+        # therefore never see two weight sets: the atomic-swap invariant.
+        self.model_version = int(model_version)
+        self._staged_swap: tuple | None = None
         self.max_batch = int(max_batch)
         self.block_size = int(block_size)
         self.max_queue = int(max_queue)
@@ -285,6 +296,7 @@ class GenerationEngine:
             "steps": 0, "prefills": 0, "tokens_generated": 0,
             "occupancy_sum": 0,
             "spec_rounds": 0, "spec_proposed": 0, "spec_accepted": 0,
+            "swaps": 0, "refilled": 0,
         }
         # retired-request latency ring (ISSUE 13): one bounded record
         # per finalized request — the per-SLO-class p50/p99 +
@@ -509,6 +521,78 @@ class GenerationEngine:
             request._cancelled = True
             self._wake.notify_all()
 
+    # -- the hot-swap version gate (distkeras_tpu/deploy) --------------------
+
+    def swap_params(self, params, version: int, policy: str = "drain",
+                    draft_params=None) -> None:
+        """Stage a model swap; the scheduler applies it BETWEEN decode
+        steps (never inside one — old and new weights in a single
+        ``decode_step`` would be a correctness bug, so ``_params`` is
+        only replaced at the top of ``step()`` on the scheduler thread).
+
+        ``policy`` decides what happens to in-flight requests:
+
+        - ``"drain"`` — admission pauses, in-flight rows finish on the
+          OLD weights, the swap lands once the batch is empty. No work
+          is discarded; the swap waits for the longest active request.
+        - ``"refill"`` — in-flight rows are watermarked and re-prefilled
+          under the NEW weights: their blocks are freed, their emitted
+          tokens reset, and they re-enter the queue head in admission
+          order. Re-admission stamps the new ``model_version``; sampling
+          is deterministic per (seed, position), so the re-served stream
+          is bit-identical to an oracle run at the NEW version.
+
+        ``version`` is not required to increase — a rollback re-stages
+        the baseline. Staging twice replaces the earlier staged swap.
+        """
+        if policy not in ("drain", "refill"):
+            raise ValueError(
+                f"policy must be 'drain' or 'refill', got {policy!r}"
+            )
+        with self._wake:
+            self._staged_swap = (params, int(version), policy, draft_params)
+            self._wake.notify_all()
+
+    def _apply_swap_locked(self) -> None:
+        """Apply a staged swap if its policy allows (call under the lock,
+        from the scheduler thread only)."""
+        staged = self._staged_swap
+        if staged is None:
+            return
+        params, version, policy, draft_params = staged
+        active = [b for b, s in enumerate(self._slots) if s is not None]
+        if policy == "drain" and active:
+            return  # admission is paused; the batch drains first
+        if policy == "refill" and active:
+            # watermark: requeue at the FRONT, preserving admission
+            # order, with blocks freed and emitted tokens reset — the
+            # re-prefill under the new weights replays the stream
+            rows = sorted(active,
+                          key=lambda b: self._slots[b].request.t_admit,
+                          reverse=True)
+            for b in rows:
+                slot = self._slots[b]
+                self._slots[b] = None
+                self._tables[b, :] = 0
+                self.allocator.free(slot.blocks)
+                req = slot.request
+                req.new_tokens = []
+                req.state = "queued"
+                req.t_admit = None
+                req.prefill_s = None
+                req.model_version = None
+                self._queue.appendleft(req)
+                self.stats_["refilled"] += 1
+            self._batch_dirty = True
+        self._params = params
+        if draft_params is not None:
+            self._draft_params = draft_params
+        self.model_version = version
+        self._staged_swap = None
+        self.stats_["swaps"] += 1
+        _trace.instant("serve.swap", cat="deploy",
+                       args={"version": version, "policy": policy})
+
     # -- the scheduler loop --------------------------------------------------
 
     def _finalize(self, req: Request, state: str,
@@ -535,6 +619,7 @@ class GenerationEngine:
             "total_s": total_s, "queue_s": queue_s,
             "prefill_s": req.prefill_s, "decode_s": decode_s,
             "new_tokens": len(req.new_tokens),
+            "model_version": req.model_version,
         })
         if _trace.enabled():
             # whole-lifetime span (submit → retire); time.monotonic and
@@ -561,6 +646,9 @@ class GenerationEngine:
         pairs whose prefill still has to run (device work happens outside
         the lock — ``submit`` must never block behind a forward pass)."""
         admitted = []
+        if (self._staged_swap is not None
+                and self._staged_swap[2] == "drain"):
+            return admitted  # draining toward a staged swap: hold the door
         free_rows = [b for b, s in enumerate(self._slots) if s is None]
         while self._queue and free_rows:
             head = self._queue[0]
@@ -582,6 +670,7 @@ class GenerationEngine:
             self._batch_dirty = True
             head.state = "running"
             head.t_admit = time.monotonic()
+            head.model_version = self.model_version
             self.stats_["admitted"] += 1
             if _trace.enabled():
                 # the admission-wait span: submit → admit, per request
@@ -694,6 +783,7 @@ class GenerationEngine:
             for b, slot in enumerate(self._slots):
                 if slot is not None and slot.request._cancelled:
                     self._retire(b, "cancelled", "cancelled by client")
+            self._apply_swap_locked()
             admitted = self._admit()
         if admitted:
             self._run_prefills(admitted)
@@ -850,7 +940,10 @@ class GenerationEngine:
             with self._wake:
                 if self._stop:
                     return
-                if self._idle():
+                if self._idle() and self._staged_swap is None:
+                    # a staged swap on an idle engine still needs one
+                    # step() to land (an activated version must not wait
+                    # for the next request to arrive)
                     self._wake.wait(0.05)
                     continue
             try:
@@ -929,6 +1022,10 @@ class GenerationEngine:
             retired = list(self._retired)
             s["queued"] = len(self._queue)
             s["active"] = sum(1 for x in self._slots if x is not None)
+            s["model_version"] = self.model_version
+            s["staged_version"] = (
+                self._staged_swap[1] if self._staged_swap else None
+            )
             s["blocks_in_use"] = self.allocator.used_blocks
             s["blocks_free"] = self.allocator.free_blocks
             s["blocks_high_water"] = self.allocator.high_water

@@ -89,6 +89,13 @@ class GenerationServer:
         self._dir_reg: tuple | None = None   # (client, key, ttl, epoch)
         self._dir_renewer: threading.Thread | None = None
         self._dir_stop = threading.Event()
+        # Live deployment (distkeras_tpu/deploy): a SnapshotStore of
+        # streamed model versions. With one attached, the
+        # deploy_activate wire action swaps the engine to any stored
+        # version, and the directory registration meta carries the
+        # CURRENT model_version (re-published by the renewer, so a swap
+        # shows up fleet-wide within ttl/3).
+        self.snapshots = None
 
     def initialize(self) -> None:
         self._server_sock = socket.socket(socket.AF_INET,
@@ -206,6 +213,26 @@ class GenerationServer:
                 elif action == "stats":
                     networking.send_data(conn, {"ok": True,
                                                 "stats": self.stats()})
+                elif action == "deploy_activate":
+                    # hot swap: stage a stored snapshot version onto the
+                    # engine (applied between decode steps — the version
+                    # gate). The rollout controller's activation path.
+                    networking.send_data(
+                        conn, self._deploy_activate(msg)
+                    )
+                elif action == "deploy_status":
+                    store = self.snapshots
+                    networking.send_data(conn, {
+                        "ok": True,
+                        "model_version": self.engine.model_version,
+                        "staged_version": (
+                            self.engine._staged_swap[1]
+                            if self.engine._staged_swap else None
+                        ),
+                        "versions": (
+                            store.versions() if store is not None else []
+                        ),
+                    })
                 elif action == "metrics":
                     # unified metrics surface (ISSUE 11/13): the serving
                     # counters + per-class latency summary normalized
@@ -236,30 +263,54 @@ class GenerationServer:
                 if conn in self._conns:
                     self._conns.remove(conn)
 
+    def _deploy_activate(self, msg: dict) -> dict:
+        version = int(msg["version"])
+        policy = msg.get("policy", "drain")
+        store = self.snapshots
+        if store is None:
+            return {"ok": False, "error": "no snapshot store attached"}
+        snap = store.get(version)
+        if snap is None:
+            return {"ok": False, "error": f"unknown version {version}",
+                    "versions": store.versions()}
+        try:
+            self.engine.swap_params(snap.tree, snap.version, policy=policy)
+        except ValueError as e:
+            return {"ok": False, "error": str(e)}
+        return {"ok": True, "version": snap.version, "policy": policy}
+
     def register_with(self, directory, key: str | None = None,
                       ttl: float = 5.0, epoch: int = 0) -> str:
         """Publish this replica into a membership directory (ISSUE 15):
         ``("serve", key) → (host, port)`` with a ``ttl`` lease renewed
         by a background thread at a third of the lease, so the entry
         expires within one TTL of this replica's death and the router's
-        next refresh drops it. ``stop()`` withdraws cleanly. Returns
-        the registered key."""
+        next refresh drops it. The registration meta carries the
+        engine's CURRENT ``model_version`` and is refreshed on every
+        renewal — a hot swap is visible to routers within ``ttl/3``.
+        ``stop()`` withdraws cleanly. Returns the registered key."""
         from distkeras_tpu.directory.client import DirectoryClient
 
         if not isinstance(directory, DirectoryClient):
             directory = DirectoryClient(directory)
         if key is None:
             key = f"{self.host}:{self.port}"
-        directory.publish("serve", key, self.host, self.port,
-                          epoch=int(epoch), ttl=float(ttl))
+
+        def publish():
+            directory.publish(
+                "serve", key, self.host, self.port, epoch=int(epoch),
+                ttl=float(ttl),
+                meta={"model_version": int(self.engine.model_version)},
+            )
+
+        publish()
         self._dir_reg = (directory, key, float(ttl), int(epoch))
         self._dir_stop.clear()
 
         def renewer():
             while not self._dir_stop.wait(max(ttl / 3.0, 0.05)):
                 try:
-                    directory.publish("serve", key, self.host, self.port,
-                                      epoch=int(epoch), ttl=float(ttl))
+                    publish()
                 except Exception:
                     pass  # directory weather; the next tick retries
 
@@ -373,6 +424,22 @@ class GenerationClient:
         networking.send_data(self._sock, {"action": "stats"})
         r = networking.recv_data(self._sock)
         return r["stats"]
+
+    def deploy_activate(self, version: int,
+                        policy: str = "drain") -> dict:
+        """Hot-swap the server to a stored snapshot ``version`` (the
+        rollout controller's activation RPC). Returns the server's reply
+        (``ok=False`` with the available versions on a miss)."""
+        networking.send_data(self._sock, {
+            "action": "deploy_activate", "version": int(version),
+            "policy": str(policy),
+        })
+        return networking.recv_data(self._sock)
+
+    def deploy_status(self) -> dict:
+        """Current/staged model version + stored snapshot versions."""
+        networking.send_data(self._sock, {"action": "deploy_status"})
+        return networking.recv_data(self._sock)
 
     def set_timeout(self, seconds: float | None) -> None:
         self._sock.settimeout(seconds)

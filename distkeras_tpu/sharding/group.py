@@ -354,6 +354,23 @@ class ShardedPSGroup:
             return None
         return self.plan.join([s.get_ema() for s in self.active_servers])
 
+    def mark_epoch(self, epoch: int) -> None:
+        """Log the training-epoch boundary on EVERY shard (the trainer's
+        barrier quiesces the workers first, so all shards mark at the
+        same fold count — the deployer's consistent epoch cut)."""
+        for s in self.active_servers:
+            fn = getattr(s, "mark_epoch", None)
+            if fn is not None:
+                fn(int(epoch))
+
+    def report_deploy_version(self, version: int) -> None:
+        """Fan a read replica's published-version report to every shard
+        (each shard prices its own ``deploy_lag_folds`` from it)."""
+        for s in self.active_servers:
+            fn = getattr(s, "report_deploy_version", None)
+            if fn is not None:
+                fn(int(version))
+
     def stats(self, settle: bool = True) -> dict:
         per = []
         for sid, s in enumerate(self.active_servers):
@@ -516,6 +533,15 @@ def aggregate_ps_stats(per_shard: list[dict]) -> dict:
     # compares it to logical commits); max flags a mid-scatter gap
     out["num_updates"] = min(updates) if updates else 0
     out["num_updates_max"] = max(updates) if updates else 0
+    # live-deployment lag: a serving snapshot exists only at a version
+    # every shard has published (the consistent cut), so the deployed
+    # version is the MIN across shards and the lag is the WORST shard's
+    # (max) — one slow shard's stream delays the whole assembled cut
+    deploys = [int(s.get("deploy_version", 0)) for s in per_shard]
+    out["deploy_version"] = min(deploys) if deploys else 0
+    out["deploy_lag_folds"] = max(
+        (int(s.get("deploy_lag_folds", 0)) for s in per_shard), default=0
+    )
     acq = out["center_lock_acquires"]
     out["center_lock_mean_hold_ns"] = (
         out["center_lock_hold_ns"] // acq if acq else 0

@@ -576,6 +576,7 @@ class DistributedTrainer(Trainer):
                  directory: bool = False,
                  directory_standby: bool = True,
                  ps_directory=None,
+                 deploy_streamer=None,
                  prefetch: int = 1, ema_decay: float | None = None,
                  clipnorm=None, clipvalue=None, validation_data=None):
         super().__init__(keras_model, loss, worker_optimizer,
@@ -1008,6 +1009,17 @@ class DistributedTrainer(Trainer):
         # - max_pool_size: autoscaler/join ceiling (default 2×workers).
         self.elastic = bool(elastic)
         self.autoscale_target = autoscale_target
+        # deploy_streamer= (ISSUE 16): a deploy.WeightStreamer to attach
+        # to the trainer-hosted center(s) before workers start — serving
+        # replicas then stream every fold live (train-while-serve). The
+        # streamer outlives the run; the caller owns its lifecycle.
+        self.deploy_streamer = deploy_streamer
+        if deploy_streamer is not None and ps_host is not None:
+            raise ValueError(
+                "deploy_streamer= streams from the PS this trainer "
+                "hosts; with an external ps_host, attach the streamer "
+                "on the PS owner's side instead"
+            )
         self.preempt_drain_timeout = float(preempt_drain_timeout)
         self.max_pool_size = (
             None if max_pool_size is None else int(max_pool_size)

@@ -1293,6 +1293,23 @@ def run_async_training(trainer, ds, shuffle: bool):
         )
         ps_supervisor.start()
 
+    deploy_streamer = getattr(trainer, "deploy_streamer", None)
+    if deploy_streamer is not None:
+        # deploy/ (ISSUE 16): hook the serving tier's read replicas onto
+        # the live center(s) before any worker folds, so snapshots
+        # stream from fold 1. With a hot standby the chain slot is
+        # taken — the streamer rides the chain TAIL (standby forwards),
+        # keeping failover and serving on one record stream.
+        target = sharded_group if sharded_group is not None else (
+            ps_standby_server if ps_standby_server is not None else ps)
+        if target is None:
+            raise ValueError(
+                "deploy_streamer= needs a trainer-hosted PS to stream "
+                "from (external ps_host / directory-only runs attach "
+                "the streamer on the PS owner's side)"
+            )
+        deploy_streamer.attach_to(target)
+
     if trace_on:
         # native servers keep their span ring in C++ — arm it (no-op on
         # the Python servers, whose spans record directly)
@@ -1481,6 +1498,16 @@ def run_async_training(trainer, ds, shuffle: bool):
             if live is not None:
                 payload["num_updates"] = live.num_updates
             ckpt.save_checkpoint(ckpt_dir, payload, step=epoch)
+            # the rendezvous is the run's one coherent epoch boundary:
+            # log the REC_EPOCH mark so chained read replicas (deploy/)
+            # cut their epoch snapshot at exactly this fold count
+            mk = getattr(live if live is not None else snap_client,
+                         "mark_epoch", None)
+            if mk is not None:
+                try:
+                    mk(int(epoch))
+                except Exception:  # noqa: BLE001
+                    pass  # advisory: never fail the checkpoint barrier
 
         barrier = threading.Barrier(W, action=_checkpoint_action)
 
@@ -1502,10 +1529,26 @@ def run_async_training(trainer, ds, shuffle: bool):
         )
 
         cols_full = tuple(np.asarray(ds[c]) for c in cols)
+
+        def _mark_epoch(epoch: int) -> None:
+            # elastic epoch boundary (every block of the epoch confirmed):
+            # the membership-independent moment the deployer's read
+            # replicas cut epoch snapshots at — and, via the snapshot
+            # store's checkpoint_dir, the resumable elastic epoch-barrier
+            # checkpoint elastic runs never had (ROADMAP item 2 satellite)
+            live = (ps_supervisor.active
+                    if ps_supervisor is not None else ps)
+            mk = getattr(live, "mark_epoch", None)
+            if mk is not None:
+                try:
+                    mk(int(epoch))
+                except Exception:  # noqa: BLE001
+                    pass  # advisory: a mark must never stall training
+
         assigner = ShardAssigner(
             len(ds), trainer.communication_window, trainer.batch_size,
             trainer.num_epoch, seed=trainer.seed, shuffle=shuffle,
-            start_epoch=start_epoch,
+            start_epoch=start_epoch, on_epoch_complete=_mark_epoch,
         )
         max_pool = getattr(trainer, "max_pool_size", None)
         if max_pool is None:

@@ -1,0 +1,776 @@
+"""Live deployment (distkeras_tpu/deploy, ISSUE 16): weight streaming
+from the training PS into the serving tier, the hot-swap version gate,
+and router-orchestrated canary rollout with SLO-gated rollback.
+
+The load-bearing oracles threaded through this file:
+
+- every read replica's center is BIT-IDENTICAL to the training center at
+  every snapshot version (one shared ``replay_record``, no drift);
+- every served stream is bit-identical to a dense-cache ``generate()``
+  oracle run at the version the stream was ADMITTED under — a swap never
+  tears a batch (old+new weights in one decode step) and a refill
+  re-serves the exact stream of the new version;
+- a replica hard-killed mid-swap leaves no leaked KV blocks and no
+  half-swapped state behind.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.deploy import (
+    RolloutController,
+    RolloutPolicy,
+    SnapshotStore,
+    WeightStreamer,
+    watchtower_health,
+)
+from distkeras_tpu.models import generate, transformer_lm
+from distkeras_tpu.parallel.merge_rules import ADAGMerge, DownpourMerge
+from distkeras_tpu.parameter_servers import ParameterServer
+from distkeras_tpu.serving import (
+    GenerationClient,
+    GenerationEngine,
+    GenerationServer,
+)
+
+VOCAB, MAXLEN = 64, 64
+
+
+@pytest.fixture(scope="module")
+def lm():
+    spec = transformer_lm(vocab=VOCAB, maxlen=MAXLEN, dim=32, heads=4,
+                          depth=2, dtype=jnp.float32)
+    p0, _ = spec.init_np(0)
+    p1, _ = spec.init_np(1)
+    return spec, p0, p1
+
+
+def _oracle(spec, params, prompt, max_new):
+    return generate(spec, params, prompt[None], max_new)[0, len(prompt):]
+
+
+# -- WAL epoch marks ----------------------------------------------------------
+
+
+def test_rec_epoch_roundtrip_and_replay():
+    """REC_EPOCH frames round-trip the codec and replay into a monotone
+    ``epoch_mark`` without touching the fold state; logs without any
+    epoch record replay exactly as before (the record is advisory)."""
+    from distkeras_tpu.resilience import wal
+
+    blob = wal.encode_record(wal.REC_EPOCH, (3,))
+    recs = list(wal.iter_records(blob))
+    assert recs == [(wal.REC_EPOCH, (3,))]
+    assert wal._REC_NAMES[wal.REC_EPOCH] == "epoch"
+
+    state = {"center": {"w": np.zeros(2, np.float32)}, "num_updates": 5,
+             "pull_versions": {}, "prev_pull_versions": {}, "last_seq": {}}
+    wal.replay_record(state, wal.REC_EPOCH, (2,), DownpourMerge(), 1, None)
+    assert state["epoch_mark"] == 2 and state["num_updates"] == 5
+    wal.replay_record(state, wal.REC_EPOCH, (1,), DownpourMerge(), 1, None)
+    assert state["epoch_mark"] == 2   # monotone: a late mark never rewinds
+
+
+def test_ps_mark_epoch_logs_only_when_observable(tmp_path):
+    """mark_epoch is a no-op without a WAL or replica (nothing would see
+    it); with a WAL the mark lands in the log and recovery restores it."""
+    from distkeras_tpu.resilience.wal import recover_ps_state
+
+    ps = ParameterServer({"w": np.zeros(2, np.float32)}, DownpourMerge(), 1)
+    ps.mark_epoch(0)   # no WAL, no replica: silently skipped
+
+    ps = ParameterServer({"w": np.zeros(2, np.float32)}, DownpourMerge(), 1,
+                         wal_dir=str(tmp_path))
+    ps.pull(0)
+    ps.commit(0, {"w": np.ones(2, np.float32)})
+    ps.mark_epoch(4)
+    ps.stop()
+    state = recover_ps_state(str(tmp_path), DownpourMerge(), 1, None,
+                             template={"w": np.zeros(2, np.float32)})
+    assert state["epoch_mark"] == 4 and state["num_updates"] == 1
+
+
+# -- deploy-lag accounting ----------------------------------------------------
+
+
+def test_deploy_lag_stats_and_sharded_rollup():
+    """deploy_lag_folds is 0 until a version is reported (training-only
+    runs never look 'behind'), then num_updates − deploy_version; the
+    sharded roll-up takes the min version (consistent cut) and the max
+    lag (worst shard)."""
+    from distkeras_tpu.sharding.group import aggregate_ps_stats
+
+    ps = ParameterServer({"w": np.zeros(2, np.float32)}, DownpourMerge(), 1)
+    for _ in range(3):
+        ps.pull(0)
+        ps.commit(0, {"w": np.ones(2, np.float32)})
+    s = ps.stats()
+    assert s["deploy_version"] == 0 and s["deploy_lag_folds"] == 0
+    ps.report_deploy_version(2)
+    ps.report_deploy_version(1)   # monotone: stale reports never rewind
+    s = ps.stats()
+    assert s["deploy_version"] == 2 and s["deploy_lag_folds"] == 1
+
+    agg = aggregate_ps_stats([
+        {"num_updates": 10, "deploy_version": 8, "deploy_lag_folds": 2,
+         "commits": 10},
+        {"num_updates": 10, "deploy_version": 4, "deploy_lag_folds": 6,
+         "commits": 10},
+    ])
+    assert agg["deploy_version"] == 4 and agg["deploy_lag_folds"] == 6
+
+
+def test_deploy_lag_rule_and_metrics_gauge():
+    """The watchtower side of the satellite: DeployLagRule abstains with
+    no deploy data, fires over the bound; the metrics schema exports the
+    gauges so health_snapshot / remote scrapes carry them."""
+    from distkeras_tpu.observability.metrics import _PS_SCHEMA
+    from distkeras_tpu.observability.timeseries import TimeSeriesStore
+    from distkeras_tpu.observability.watch import (
+        DeployLagRule,
+        Watchdog,
+        default_rules,
+    )
+
+    assert any(k == "deploy_lag_folds" for k, _, _, _ in _PS_SCHEMA)
+    assert any(r.kind == "deploy_lag" for r in default_rules())
+
+    store = TimeSeriesStore()
+    wd = Watchdog(store, rules=[DeployLagRule(bound=100.0)])
+    wd.evaluate(now=1.0)
+    assert not wd.active                    # no data: abstain
+    store.sample("ps.deploy_lag_folds", 2.0, 500.0, "gauge")
+    wd.evaluate(now=2.0)
+    assert not wd.active                    # lag but no deploy_version yet
+    store.sample("ps.deploy_version", 3.0, 7.0, "gauge")
+    wd.evaluate(now=3.0)
+    assert any(a["kind"] == "deploy_lag" for a in wd.active.values())
+    store.sample("ps.deploy_lag_folds", 4.0, 10.0, "gauge")
+    wd.evaluate(now=4.0)
+    assert not wd.active                    # caught up: resolves
+
+
+# -- snapshot store -----------------------------------------------------------
+
+
+def test_snapshot_store_monotone_prune_subscribe():
+    store = SnapshotStore(keep=2)
+    seen = []
+    store.subscribe(lambda s: seen.append(s.version))
+    t = {"w": np.ones(2, np.float32)}
+    assert store.publish(10, t)
+    assert not store.publish(10, t)         # equal version: dropped
+    assert not store.publish(5, t)          # older: dropped
+    assert store.publish(20, t) and store.publish(30, t)
+    assert store.versions() == [20, 30]     # keep=2 pruned v10
+    assert store.latest().version == 30 and store.get(20) is not None
+    assert seen == [10, 20, 30]
+    with pytest.raises(ValueError, match="keep"):
+        SnapshotStore(keep=0)
+
+
+def test_epoch_snapshot_writes_elastic_checkpoint(tmp_path):
+    """Satellite 1: an epoch-boundary snapshot with checkpoint_dir set
+    lands on disk in run_async_training's resume payload shape —
+    workers=[] routes resume through the elastic center-only path."""
+    from distkeras_tpu.checkpoint import restore_checkpoint
+
+    store = SnapshotStore(keep=4, checkpoint_dir=str(tmp_path))
+    tree = {"w": np.arange(4, dtype=np.float32)}
+    store.publish(10, tree, epoch=None)     # fold-count cut: no checkpoint
+    assert store.checkpoints_written == 0
+    store.publish(25, tree, epoch=3)        # epoch cut: checkpointed
+    assert store.checkpoints_written == 1
+    payload, step = restore_checkpoint(str(tmp_path))
+    assert step == 25 and payload["epoch"] == 3
+    assert payload["workers"] == [] and payload["num_updates"] == 25
+    np.testing.assert_array_equal(payload["center"]["w"], tree["w"])
+
+
+# -- weight streaming ---------------------------------------------------------
+
+
+def _drain_to(streamer, version, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if streamer.stats()["latest_version"] >= version:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"streamer never reached v{version}: {streamer.stats()}"
+    )
+
+
+def test_streamer_cuts_at_folds_and_epochs_bit_identical():
+    """Fold-count cuts at snapshot_every multiples, an epoch mark always
+    cuts (carrying the epoch), every snapshot bit-identical to the
+    training center at that version, and the published versions flow
+    back into the PS's deploy-lag accounting."""
+    rule = ADAGMerge()
+    ps = ParameterServer({"w": np.zeros(4, np.float32)}, rule, 2)
+    st = WeightStreamer(ADAGMerge(), 2, snapshot_every=5)
+    st.attach_to(ps)
+    try:
+        d = {"w": np.full(4, 0.5, np.float32)}
+        for _ in range(12):
+            ps.pull(0)
+            ps.commit(0, d)
+        ps.mark_epoch(0)
+        _drain_to(st, 12)
+        assert st.store.versions() == [5, 10, 12]
+        assert st.store.get(12).epoch == 0       # the epoch cut
+        assert st.store.get(10).epoch is None    # a fold-count cut
+        np.testing.assert_array_equal(
+            st.store.latest().tree["w"], ps.get_model()["w"]
+        )
+        s = ps.stats()
+        assert s["deploy_version"] == 12 and s["deploy_lag_folds"] == 0
+        rep = st.stats()["replicas"][0]
+        assert rep["streaming"] and rep["num_updates"] == 12
+    finally:
+        st.close()
+
+
+def test_streamer_chain_shares_one_replica_slot():
+    """Two serving hosts chain off the PS's single replica slot: the
+    downstream streamer sees the same records and publishes the same
+    bits, and a second direct attach is refused (the slot is taken)."""
+    ps = ParameterServer({"w": np.zeros(4, np.float32)}, ADAGMerge(), 2)
+    s1 = WeightStreamer(ADAGMerge(), 2, snapshot_every=4)
+    s2 = WeightStreamer(ADAGMerge(), 2, snapshot_every=4)
+    s1.chain_to(s2)
+    s1.attach_to(ps)
+    try:
+        with pytest.raises(ValueError, match="slot is taken"):
+            WeightStreamer(ADAGMerge(), 2).attach_to(ps)
+        d = {"w": np.ones(4, np.float32)}
+        for _ in range(8):
+            ps.pull(1)
+            ps.commit(1, d)
+        _drain_to(s1, 8)
+        _drain_to(s2, 8)
+        np.testing.assert_array_equal(
+            s1.store.latest().tree["w"], s2.store.latest().tree["w"]
+        )
+        assert s2.store.versions() == [4, 8]
+    finally:
+        s1.close()
+        s2.close()
+
+
+def test_streamer_sharded_consistent_cut():
+    """Sharded center: the streamer subscribes to every shard's stream
+    and publishes only when ALL shards were captured at the same version
+    — the assembled snapshot equals the group's joined center, bitwise."""
+    from distkeras_tpu.sharding.group import ShardedPSGroup
+
+    tree = {"a": np.zeros(6, np.float32), "b": np.zeros((3, 2), np.float32)}
+    group = ShardedPSGroup(tree, DownpourMerge(), 1, num_shards=2,
+                           transport="inprocess")
+    group.initialize()
+    group.start()
+    st = WeightStreamer(DownpourMerge(), 1, plan=group.plan,
+                        snapshot_every=3)
+    st.attach_to(group)
+    try:
+        c = group.make_client(0)
+        d = {"a": np.full(6, 0.25, np.float32),
+             "b": np.full((3, 2), -0.5, np.float32)}
+        for _ in range(6):
+            c.pull()
+            c.commit(0, d)
+        _drain_to(st, 6)
+        assert st.store.versions() == [3, 6]
+        snap = st.store.latest()
+        center = group.get_model()
+        for k in tree:
+            np.testing.assert_array_equal(snap.tree[k], center[k])
+        s = group.stats()
+        assert s["deploy_version"] == 6 and s["deploy_lag_folds"] == 0
+    finally:
+        st.close()
+        group.stop()
+
+
+# -- the hot-swap version gate ------------------------------------------------
+
+
+def test_swap_refill_streams_bit_identical_to_new_version(lm):
+    """Property test (the no-torn-batch oracle): a refill swap mid-batch
+    frees every in-flight row's blocks and re-prefills under the new
+    weights — every served stream (greedy AND seeded-sampled) is then
+    bit-identical to a generate() oracle at the version the request was
+    (re)admitted under."""
+    spec, p0, p1 = lm
+    rng = np.random.default_rng(29)
+    eng = GenerationEngine(spec, p0, max_batch=3, block_size=8,
+                           model_version=1)
+    prompts = [rng.integers(0, VOCAB, (n,)).astype(np.int32)
+               for n in (8, 13, 6, 11)]
+    reqs = [eng.submit(prompts[0], max_new_tokens=12),
+            eng.submit(prompts[1], max_new_tokens=12),
+            eng.submit(prompts[2], max_new_tokens=12,
+                       temperature=0.8, top_k=8, seed=5),
+            eng.submit(prompts[3], max_new_tokens=12)]
+    for _ in range(3):
+        eng.step()          # rows admitted, tokens emitted on v1 weights
+    eng.swap_params(p1, 2, policy="refill")
+    eng.run_until_idle()
+    params_by = {1: p0, 2: p1}
+    for p, r in zip(prompts, reqs):
+        assert r.state == "done" and r.model_version == 2
+        params = params_by[r.model_version]
+        if r.temperature == 0.0:
+            np.testing.assert_array_equal(
+                r.result(0), _oracle(spec, params, p, 12)
+            )
+        else:
+            # deterministic per (seed, position): the refilled sampled
+            # stream equals a fresh same-seed run at the new version
+            eng2 = GenerationEngine(spec, params, max_batch=1, block_size=8)
+            r2 = eng2.submit(p, max_new_tokens=12, temperature=0.8,
+                             top_k=8, seed=5)
+            eng2.run_until_idle()
+            np.testing.assert_array_equal(r.result(0), r2.result(0))
+    s = eng.stats()
+    assert s["swaps"] == 1 and s["refilled"] >= 1
+    assert s["model_version"] == 2 and s["blocks_in_use"] == 0
+
+
+def test_swap_drain_finishes_old_batch_then_swaps(lm):
+    """Drain policy: in-flight rows finish on the OLD weights (their
+    admitted version), admission holds the door, and queued requests run
+    on the NEW weights after the gate — both halves oracle-exact."""
+    spec, p0, p1 = lm
+    rng = np.random.default_rng(31)
+    pa = rng.integers(0, VOCAB, (9,)).astype(np.int32)
+    pb = rng.integers(0, VOCAB, (7,)).astype(np.int32)
+    eng = GenerationEngine(spec, p0, max_batch=2, block_size=8,
+                           model_version=1)
+    ra = eng.submit(pa, max_new_tokens=10)
+    for _ in range(3):
+        eng.step()
+    eng.swap_params(p1, 2, policy="drain")
+    rb = eng.submit(pb, max_new_tokens=10)   # queued behind the gate
+    eng.run_until_idle()
+    assert ra.model_version == 1 and rb.model_version == 2
+    np.testing.assert_array_equal(ra.result(0), _oracle(spec, p0, pa, 10))
+    np.testing.assert_array_equal(rb.result(0), _oracle(spec, p1, pb, 10))
+    s = eng.stats()
+    assert s["refilled"] == 0 and s["model_version"] == 2
+    assert s["blocks_in_use"] == 0
+    with pytest.raises(ValueError, match="policy"):
+        eng.swap_params(p1, 3, policy="nope")
+
+
+def test_swap_applies_while_engine_idle(lm):
+    """A staged swap must not wait for traffic: the scheduler loop wakes
+    and applies it with an empty batch (rollback repins idle replicas)."""
+    spec, p0, p1 = lm
+    eng = GenerationEngine(spec, p0, max_batch=2, block_size=8,
+                           model_version=7)
+    eng.start()
+    try:
+        eng.swap_params(p1, 3, policy="drain")   # version DECREASES: rollback
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and eng.stats()["model_version"] != 3:
+            time.sleep(0.02)
+        assert eng.stats()["model_version"] == 3
+    finally:
+        eng.stop(drain=False)
+
+
+# -- rollout policy (pure state machine) --------------------------------------
+
+
+def test_rollout_policy_canary_bake_promote():
+    pol = RolloutPolicy(canary_fraction=0.5, bake_s=2.0, green_checks=2,
+                        red_checks=1, cooldown_s=5.0)
+    assert pol.observe(0.0, None, True, False) == []        # nothing staged
+    acts = pol.observe(1.0, 4, True, False)
+    assert acts == [{"t": 1.0, "action": "canary", "state": "canary",
+                     "version": 4, "fraction": 0.5}]
+    assert pol.observe(2.0, 4, True, False) == []           # still baking
+    assert pol.observe(3.5, 4, True, False) == []           # 1st green check
+    acts = pol.observe(4.0, 4, True, False)                 # 2nd: promote
+    assert acts[0]["action"] == "promote" and acts[0]["version"] == 4
+    assert pol.state == "idle" and pol.version == 4
+    # stale candidate (<= promoted baseline) never restarts a rollout
+    assert pol.observe(20.0, 4, True, False) == []
+    assert [d["action"] for d in pol.decisions] == ["canary", "promote"]
+
+
+def test_rollout_policy_slo_rollback_and_cooldown():
+    pol = RolloutPolicy(canary_fraction=0.25, bake_s=0.0, green_checks=1,
+                        red_checks=2, cooldown_s=10.0)
+    pol.observe(0.0, 2, True, False)
+    assert pol.state == "canary"
+    assert pol.observe(1.0, 2, False, True) == []     # 1st red: hysteresis
+    acts = pol.observe(2.0, 2, False, True)           # 2nd consecutive red
+    assert acts[0]["action"] == "rollback" and acts[0]["to"] == 0
+    assert pol.state == "idle" and pol.version == 0
+    # cooldown: the same candidate cannot re-canary immediately
+    assert pol.observe(3.0, 2, True, False) == []
+    acts = pol.observe(13.0, 2, True, False)
+    assert acts and acts[0]["action"] == "canary"
+    # a non-green (non-SLO) alert blocks promotion but never rolls back
+    assert pol.observe(14.0, 2, False, False) == []
+    assert pol.state == "canary"
+
+
+def test_rollout_policy_validates():
+    for kw in ({"canary_fraction": 0.0}, {"canary_fraction": 1.5},
+               {"bake_s": -1}, {"green_checks": 0}, {"red_checks": 0},
+               {"cooldown_s": -0.1}):
+        with pytest.raises(ValueError):
+            RolloutPolicy(**kw)
+
+
+def test_watchtower_health_adapter():
+    class FakeDog:
+        active = {}
+
+    assert watchtower_health(FakeDog()) == (True, False)
+    FakeDog.active = {"r1": {"kind": "loss_stall"}}
+    assert watchtower_health(FakeDog()) == (False, False)
+    FakeDog.active = {"r1": {"kind": "serving_slo"}}
+    assert watchtower_health(FakeDog()) == (False, True)
+
+
+# -- serving fleet helpers ----------------------------------------------------
+
+
+def _serve_replica(spec, params, version, store, directory, key):
+    eng = GenerationEngine(spec, params, max_batch=2, block_size=8,
+                           model_version=version)
+    srv = GenerationServer(eng, poll_interval=0.02)
+    srv.snapshots = store
+    srv.start()
+    srv.register_with(directory, key=key, ttl=5.0)
+    return srv
+
+
+def _fleet_versions(router):
+    router.refresh(force=True)
+    return router.replica_versions()
+
+
+def _wait_fleet(router, want, timeout=15.0):
+    """Wait until the advertised version map equals ``want`` (renewer
+    republishes within ttl/3 of a swap)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if _fleet_versions(router) == want:
+            return
+        time.sleep(0.1)
+    raise AssertionError(
+        f"fleet never advertised {want}: {_fleet_versions(router)}"
+    )
+
+
+# -- chaos: hard kill mid-swap ------------------------------------------------
+
+
+def test_chaos_hard_kill_replica_mid_swap(lm):
+    """Seeded chaos at the swap boundary: two replicas serve routed
+    traffic; one is HARD-killed with a refill swap staged and requests
+    in flight. In-flight routed requests fail over and complete on the
+    survivor (bit-identical to its version's oracle), the victim frees
+    every KV block on the way down (no leak, no torn batch), and the
+    router's next refresh drops the corpse."""
+    from distkeras_tpu.directory import DirectoryServer
+    from distkeras_tpu.directory.router import RoutedGenerationClient
+
+    spec, p0, p1 = lm
+    store = SnapshotStore(keep=4)
+    store.publish(1, p0)
+    store.publish(2, p1)
+    dsrv = DirectoryServer(default_ttl=2.0)
+    dsrv.initialize()
+    dsrv.start()
+    seeds = [(dsrv.host, dsrv.port)]
+    srv_a = _serve_replica(spec, p0, 1, store, seeds, "rep-a")
+    srv_b = _serve_replica(spec, p0, 1, store, seeds, "rep-b")
+    router = RoutedGenerationClient(directory=seeds, refresh_interval=0.2)
+    rng = np.random.default_rng(17)
+    results, errs = {}, []
+
+    def client(i):
+        try:
+            p = rng.integers(0, VOCAB, (6 + i,)).astype(np.int32)
+            results[i] = (p, router.generate(p, max_new_tokens=10))
+        except Exception as e:  # surfaced below
+            errs.append((i, e))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(6)]
+    try:
+        for t in threads:
+            t.start()
+        # stage a refill swap on the victim, then kill it mid-swap: the
+        # staged swap + any in-flight rows die with the process image
+        GenerationClient(srv_a.host, srv_a.port).deploy_activate(
+            2, policy="refill")
+        srv_a.stop(drain=False, timeout=5.0)
+        for t in threads:
+            t.join(60)
+        assert not errs, errs
+        assert len(results) == 6
+        for p, toks in results.values():
+            # every stream completed somewhere; whichever replica served
+            # it was at v1 (p0) or v2 (p1) whole — never a mix
+            o1, o2 = (_oracle(spec, p0, p, 10), _oracle(spec, p1, p, 10))
+            assert (np.array_equal(toks, o1) or np.array_equal(toks, o2))
+        # the victim died clean: no leaked blocks, nothing half-swapped
+        va = srv_a.engine.stats()
+        assert va["blocks_in_use"] == 0 and va["active"] == 0
+        sb = srv_b.engine.stats()
+        assert sb["blocks_in_use"] == 0
+        # the corpse ages out of the ring
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            router.refresh(force=True)
+            if set(router.replicas) == {"rep-b"}:
+                break
+            time.sleep(0.2)
+        assert set(router.replicas) == {"rep-b"}
+    finally:
+        router.close()
+        srv_b.stop(drain=False)
+        srv_a.stop(drain=False)
+        dsrv.stop()
+
+
+# -- the end-to-end acceptance ------------------------------------------------
+
+
+def test_e2e_stream_canary_promote_then_slo_rollback(lm):
+    """The ISSUE 16 acceptance path, in-process: async training (ADAG
+    merge rule) folds live while a WeightStreamer materializes versions;
+    two directory-registered replicas serve; a canary rollout promotes
+    on watchdog-green; a second leg with an injected latency fault rolls
+    back on the firing ServingSLORule. Every served stream bit-identical
+    to the oracle at its replica's admitted version, deploy_lag_folds
+    bounded, every transition journaled."""
+    from distkeras_tpu.directory import DirectoryServer
+    from distkeras_tpu.directory.router import RoutedGenerationClient
+    from distkeras_tpu.observability.timeseries import TimeSeriesStore
+    from distkeras_tpu.observability.watch import (
+        ServingSLORule,
+        SLOClass,
+        Watchdog,
+    )
+
+    spec, p0, _ = lm
+    rule = ADAGMerge()
+    ps = ParameterServer(p0, rule, 2)
+    st = WeightStreamer(ADAGMerge(), 2, snapshot_every=4)
+    st.attach_to(ps)
+
+    def train(folds):
+        # two async workers committing tiny deltas: live ADAG training
+        def worker(wid, n):
+            rng = np.random.default_rng(wid)
+            for _ in range(n):
+                center = ps.pull(wid)
+                delta = jax.tree.map(
+                    lambda a: (rng.standard_normal(a.shape) * 1e-3
+                               ).astype(a.dtype),
+                    center,
+                )
+                ps.commit(wid, delta)
+        ts = [threading.Thread(target=worker, args=(w, folds // 2))
+              for w in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+
+    dsrv = DirectoryServer(default_ttl=3.0)
+    dsrv.initialize()
+    dsrv.start()
+    seeds = [(dsrv.host, dsrv.port)]
+    router = None
+    servers = []
+    try:
+        train(8)
+        _drain_to(st, 8)
+        va = st.store.versions()[0]
+        base = st.store.get(va)
+        servers = [
+            _serve_replica(spec, base.tree, va, st.store, seeds, f"rep-{i}")
+            for i in range(2)
+        ]
+        router = RoutedGenerationClient(directory=seeds,
+                                        refresh_interval=0.2)
+        _wait_fleet(router, {"rep-0": va, "rep-1": va})
+
+        # watchtower: the serving SLO is the rollback trigger; feeding
+        # the series by hand makes green/red deterministic
+        tstore = TimeSeriesStore()
+        wd = Watchdog(tstore, rules=[
+            ServingSLORule(slo={"default": SLOClass(p99_ms=500.0)}),
+        ])
+
+        def observe(p99_ms, now):
+            tstore.sample("serve.lat.default.p99_ms", now, p99_ms)
+            wd.evaluate(now=now)
+
+        by_key = {f"rep-{i}": srv for i, srv in enumerate(servers)}
+
+        def activate(key, version):
+            c = GenerationClient(by_key[key].host, by_key[key].port)
+            try:
+                return bool(c.deploy_activate(version,
+                                              policy="refill")["ok"])
+            finally:
+                c.close()
+
+        ctrl = RolloutController(
+            router, activate, lambda: watchtower_health(wd),
+            policy=RolloutPolicy(canary_fraction=0.5, bake_s=0.0,
+                                 green_checks=1, red_checks=1,
+                                 cooldown_s=0.0),
+        )
+
+        def served_bit_identical():
+            # each replica, at whatever version it advertises, serves
+            # the oracle stream of that version's snapshot — streaming
+            # kept every materialized center bit-identical to training
+            rng = np.random.default_rng(5)
+            for key, srv in by_key.items():
+                c = GenerationClient(srv.host, srv.port)
+                try:
+                    v = c.deploy_status()["model_version"]
+                    p = rng.integers(0, VOCAB, (8,)).astype(np.int32)
+                    toks = c.generate(p, max_new_tokens=8)
+                finally:
+                    c.close()
+                np.testing.assert_array_equal(
+                    toks, _oracle(spec, st.store.get(v).tree, p, 8),
+                    err_msg=f"{key} tore the stream at v{v}",
+                )
+
+        # ---- leg 1: train on, canary the new version, promote on green
+        train(8)
+        _drain_to(st, 16)
+        vb = st.store.versions()[-1]
+        assert vb > va
+        ctrl.begin(vb)
+        observe(50.0, 1.0)                       # healthy latency: green
+        acts = ctrl.step(1.0)
+        assert [a["action"] for a in acts] == ["canary"]
+        assert len(ctrl.canary_keys) == 1        # 50% of 2 replicas
+        canary, = ctrl.canary_keys
+        rest, = set(by_key) - {canary}
+        _wait_fleet(router, {canary: vb, rest: va})
+        served_bit_identical()                   # mixed-version fleet
+        observe(60.0, 2.0)
+        acts = ctrl.step(2.0)
+        assert [a["action"] for a in acts] == ["promote"]
+        _wait_fleet(router, {"rep-0": vb, "rep-1": vb})
+        served_bit_identical()
+
+        # ---- leg 2: next candidate canaries, injected latency fires
+        # the SLO, the controller rolls the canary back to vb
+        train(8)
+        _drain_to(st, 24)
+        vc = st.store.versions()[-1]
+        assert vc > vb
+        ctrl.begin(vc)
+        observe(70.0, 3.0)
+        assert [a["action"] for a in ctrl.step(3.0)] == ["canary"]
+        canary2, = ctrl.canary_keys
+        observe(5000.0, 4.0)                     # injected latency fault
+        assert any(a["kind"] == "serving_slo" for a in wd.active.values())
+        acts = ctrl.step(4.0)
+        assert [a["action"] for a in acts] == ["rollback"]
+        _wait_fleet(router, {"rep-0": vb, "rep-1": vb})
+        served_bit_identical()
+
+        # routed traffic over the (now settled) fleet: streams complete
+        # and the per-version routing split lands in router stats
+        rng = np.random.default_rng(23)
+        for _ in range(4):
+            p = rng.integers(0, VOCAB, (7,)).astype(np.int32)
+            toks = router.generate(p, max_new_tokens=6)
+            np.testing.assert_array_equal(
+                toks, _oracle(spec, st.store.get(vb).tree, p, 6)
+            )
+        rs = router.stats()
+        assert sum(rs["routed_by_version"].values()) >= 4
+        assert rs["routed_by_version"].get(vb, 0) >= 4
+        assert set(rs["replica_versions"].values()) == {vb}
+
+        # the journal CI uploads: one record per executed transition
+        assert [j["action"] for j in ctrl.journal] == [
+            "canary", "promote", "canary", "rollback",
+        ]
+        assert all("keys" in j and "activated" in j for j in ctrl.journal)
+        # deploy lag stayed bounded: training is 24 folds in, serving
+        # materialized through v24, gap under one snapshot interval
+        assert ps.stats()["deploy_lag_folds"] <= st.snapshot_every
+    finally:
+        if router is not None:
+            router.close()
+        for srv in servers:
+            srv.stop(drain=False)
+        st.close()
+        dsrv.stop()
+
+
+# -- trainer integration ------------------------------------------------------
+
+
+def test_trainer_deploy_streamer_knob_elastic_epoch_checkpoint(tmp_path):
+    """The trainer-side knob: an elastic ADAG run with deploy_streamer=
+    streams every fold into the snapshot store, the elastic epoch
+    boundary (ShardAssigner retirement → mark_epoch → REC_EPOCH) cuts an
+    epoch snapshot, and the store's checkpoint_dir gets the resumable
+    elastic epoch-barrier checkpoint that closes ROADMAP item 2."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.checkpoint import restore_checkpoint
+    from tests.test_trainers import blobs_dataset, model_spec
+
+    st = WeightStreamer(ADAGMerge(), 2, snapshot_every=0,
+                        checkpoint_dir=str(tmp_path / "deploy-ckpt"))
+    ds = blobs_dataset(n=512)
+    t = dk.ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+                worker_optimizer="sgd", learning_rate=0.05, num_workers=2,
+                batch_size=16, communication_window=2, num_epoch=2,
+                backend="ps", elastic=True, deploy_streamer=st)
+    try:
+        t.train(ds)
+        # both epoch boundaries marked → two epoch cuts, both durable
+        _drain_to(st, 1)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline \
+                and st.store.checkpoints_written < 2:
+            time.sleep(0.05)
+        assert st.store.checkpoints_written == 2
+        snaps = [st.store.get(v) for v in st.store.versions()]
+        # epoch marks are monotone (max) and the retirement callbacks
+        # race outside the assigner lock, so an inverted pair labels
+        # both cuts epoch 1 — the barrier itself is always epoch 1
+        assert all(s.epoch in (0, 1) for s in snaps)
+        assert snaps[-1].epoch == 1
+        payload, step = restore_checkpoint(str(tmp_path / "deploy-ckpt"))
+        assert payload["workers"] == [] and payload["epoch"] == 1
+        assert payload["num_updates"] == step == st.store.latest().version
+        # resume path: center-only elastic restart consumes this payload
+        with pytest.warns(UserWarning, match="elastic resume"):
+            from distkeras_tpu.checkpoint import warn_elastic_resume
+
+            warn_elastic_resume(len(payload["workers"]), 2)
+    finally:
+        st.close()
+
+    with pytest.raises(ValueError, match="deploy_streamer"):
+        dk.ADAG(model_spec(), loss="sparse_softmax_cross_entropy",
+                num_workers=2, backend="ps", ps_transport="socket",
+                ps_host="10.0.0.1", deploy_streamer=object())
