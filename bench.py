@@ -33,6 +33,7 @@ defaults to 197 bf16 TFLOP/s (TPU v5e); override with
 
 import argparse
 import json
+import math
 import os
 import sys
 import threading
@@ -2508,6 +2509,213 @@ def run_serve_hotswap_bench(spec, params, prompts, seq_rps, max_new=48,
         server.stop(drain=False, timeout=10)
 
 
+def run_serve_prefix_bench(spec, params, vocab, max_new=32, max_batch=8,
+                           block_size=16, sys_len=96, tail_len=16,
+                           n_requests=16, prefill_chunk=16, seed=0):
+    """Shared-system-prompt leg (ISSUE 17): every request carries the
+    same ``sys_len``-token system prefix plus a unique ``tail_len``-token
+    user suffix — the workload automatic prefix caching exists for. One
+    ``prefix_cache=True`` engine serves three waves, each under its own
+    ``slo_class`` label so the retired-ring summary keeps them apart:
+    a warmup wave (unique prefixes; fills the jit buckets, uncounted), a
+    COLD wave (unique prefixes again — 0% hit rate, every prompt token
+    prefilled), and a WARM wave (the shared system prompt, seeded by one
+    uncounted request — only the unique tail prefills). Same engine,
+    same chunked-prefill code path, same concurrency: the only variable
+    is the hit rate, and the number that matters is mean prefill ms
+    dropping with it. ``prefill_chunk`` is pinned so every wave runs the
+    same chunk shapes (no compile skew between waves)."""
+    from distkeras_tpu.serving import (
+        GenerationClient,
+        GenerationEngine,
+        GenerationServer,
+    )
+
+    rng = np.random.default_rng(seed)
+
+    def fresh(n):  # unique (prefix, tail) prompts — never cache-hit
+        return [rng.integers(0, vocab, (sys_len + tail_len,)).astype(
+            np.int32) for _ in range(n)]
+
+    system = rng.integers(0, vocab, (sys_len,)).astype(np.int32)
+    shared = [np.concatenate([
+        system, rng.integers(0, vocab, (tail_len,)).astype(np.int32)])
+        for _ in range(n_requests + 1)]
+
+    engine = GenerationEngine(spec, params, max_batch=max_batch,
+                              block_size=block_size, max_queue=256,
+                              prefix_cache=True,
+                              prefill_chunk=prefill_chunk)
+    server = GenerationServer(engine)
+    server.start()
+    try:
+        def one(prompt, slo_class):
+            c = GenerationClient("127.0.0.1", server.port)
+            c.generate(prompt, max_new_tokens=max_new,
+                       slo_class=slo_class, tenant="prefix-bench")
+            c.close()
+
+        def wave(prompts, slo_class):
+            before = engine.stats()
+            ts = [threading.Thread(target=one, args=(p, slo_class))
+                  for p in prompts]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=300)
+            after = engine.stats()
+            lat = engine.latency_stats().get(slo_class, {})
+            d_hit = (after["prefix_hit_tokens"]
+                     - before["prefix_hit_tokens"])
+            d_tot = (after["prefix_prompt_tokens"]
+                     - before["prefix_prompt_tokens"])
+            return {
+                "prefill_ms": round(lat.get("prefill_ms", 0.0), 2),
+                "p50_ms": round(lat.get("p50_ms", 0.0), 1),
+                "p99_ms": round(lat.get("p99_ms", 0.0), 1),
+                "completed": lat.get("count", 0),
+                "hit_rate": round(d_hit / d_tot, 4) if d_tot else 0.0,
+            }
+
+        wave(fresh(max_batch), "warmup")        # jit buckets, uncounted
+        cold = wave(fresh(n_requests), "cold")
+        one(shared[0], "seed")                  # make the prefix resident
+        warm = wave(shared[1:], "warm")
+
+        stats = engine.stats()
+        rec = {
+            "config": "serve_prefix",
+            "sys_len": sys_len, "tail_len": tail_len,
+            "max_new_tokens": max_new, "n_requests": n_requests,
+            "prefill_chunk": prefill_chunk,
+            "cold_prefill_ms": cold["prefill_ms"],
+            "warm_prefill_ms": warm["prefill_ms"],
+            "prefill_speedup": (round(cold["prefill_ms"]
+                                      / warm["prefill_ms"], 2)
+                                if warm["prefill_ms"] else 0.0),
+            "cold_hit_rate": cold["hit_rate"],
+            "warm_hit_rate": warm["hit_rate"],
+            "prefix_cached_blocks": stats["prefix_cached_blocks"],
+            "prefix_evictions": stats["prefix_evictions"],
+            "cow_copies": stats["cow_copies"],
+            "cold": cold, "warm": warm,
+            "host_cores": os.cpu_count() or 1,
+        }
+        log(f"[serve] prefix: mean prefill {cold['prefill_ms']} ms at "
+            f"{cold['hit_rate']:.0%} hit rate -> {warm['prefill_ms']} ms "
+            f"at {warm['hit_rate']:.0%} ({rec['prefill_speedup']}x)")
+        log(json.dumps(rec))
+        return rec
+    finally:
+        server.stop(drain=False, timeout=10)
+
+
+def run_serve_tenants_bench(spec, params, vocab, max_batch=4,
+                            block_size=16, n_batch=10, n_rt=8,
+                            rt_gap_s=0.25, seed=0):
+    """Mixed-tenant SLO leg (ISSUE 17): a best-effort tenant bursts
+    ``n_batch`` LONG requests (64-token prompts, 48 new tokens) into a
+    deliberately block-starved engine, then a realtime tenant's SHORT
+    requests (16+8 tokens) arrive one every ``rt_gap_s``. Under strict
+    FIFO the realtime requests queue behind the burst; under
+    ``admission='slo'`` they jump the queue and, when the block pool is
+    exhausted, preempt best-effort rows (recompute-on-resume keeps the
+    preempted outputs bit-identical). The numbers that matter:
+    realtime p99 bounded under 'slo' vs 'fifo' at the same load, with
+    ``preemptions`` counting what best-effort absorbed to pay for it."""
+    from distkeras_tpu.serving import (
+        GenerationClient,
+        GenerationEngine,
+        GenerationServer,
+    )
+
+    rng = np.random.default_rng(seed)
+    long_prompts = [rng.integers(0, vocab, (64,)).astype(np.int32)
+                    for _ in range(n_batch)]
+    short_prompts = [rng.integers(0, vocab, (16,)).astype(np.int32)
+                     for _ in range(n_rt)]
+    # block-starved on purpose: the pool holds exactly TWO long rows
+    # plus one spare block, so a realtime arrival finds rows free but
+    # blocks exhausted — under FIFO it queues behind the head-of-line
+    # long request; under 'slo' it preempts a best-effort row
+    long_blocks = int(math.ceil((64 + 48) / block_size))
+    num_blocks = 2 * long_blocks + 1
+
+    def measure(admission):
+        engine = GenerationEngine(spec, params, max_batch=max_batch,
+                                  block_size=block_size, max_queue=256,
+                                  num_blocks=num_blocks,
+                                  admission=admission)
+        server = GenerationServer(engine)
+        server.start()
+        try:
+            def one(prompt, max_new, slo_class, tenant):
+                c = GenerationClient("127.0.0.1", server.port)
+                c.generate(prompt, max_new_tokens=max_new,
+                           slo_class=slo_class, tenant=tenant)
+                c.close()
+
+            one(long_prompts[0], 48, "default", "warm")   # compile
+            one(short_prompts[0], 8, "default", "warm")
+            ts = [threading.Thread(
+                target=one,
+                args=(long_prompts[i], 48, "best_effort", "batch"))
+                for i in range(n_batch)]
+            for t in ts:
+                t.start()
+            time.sleep(rt_gap_s)  # let the burst occupy the engine
+            rs = []
+            for i in range(n_rt):
+                r = threading.Thread(
+                    target=one,
+                    args=(short_prompts[i], 8, "realtime", "rt"))
+                r.start()
+                rs.append(r)
+                time.sleep(rt_gap_s)
+            for t in ts + rs:
+                t.join(timeout=300)
+            lat = engine.latency_stats()
+            stats = engine.stats()
+            return {
+                "rt_p50_ms": round(
+                    lat.get("realtime", {}).get("p50_ms", 0.0), 1),
+                "rt_p99_ms": round(
+                    lat.get("realtime", {}).get("p99_ms", 0.0), 1),
+                "be_p99_ms": round(
+                    lat.get("best_effort", {}).get("p99_ms", 0.0), 1),
+                "rt_completed": lat.get("realtime", {}).get("count", 0),
+                "be_completed": lat.get("best_effort", {}).get(
+                    "count", 0),
+                "preemptions": stats.get("preemptions", 0),
+                "blocks_in_use_after": stats["blocks_in_use"],
+            }
+        finally:
+            server.stop(drain=False, timeout=10)
+
+    fifo = measure("fifo")
+    slo = measure("slo")
+    rec = {
+        "config": "serve_tenants",
+        "max_batch": max_batch, "num_blocks": num_blocks,
+        "n_batch_requests": n_batch, "n_rt_requests": n_rt,
+        "fifo_rt_p99_ms": fifo["rt_p99_ms"],
+        "slo_rt_p99_ms": slo["rt_p99_ms"],
+        "fifo_be_p99_ms": fifo["be_p99_ms"],
+        "slo_be_p99_ms": slo["be_p99_ms"],
+        "rt_p99_gain_x": (round(fifo["rt_p99_ms"] / slo["rt_p99_ms"], 2)
+                          if slo["rt_p99_ms"] else 0.0),
+        "preemptions": slo["preemptions"],
+        "fifo": fifo, "slo": slo,
+        "host_cores": os.cpu_count() or 1,
+    }
+    log(f"[serve] tenants: realtime p99 {fifo['rt_p99_ms']} ms FIFO -> "
+        f"{slo['rt_p99_ms']} ms slo admission "
+        f"({rec['rt_p99_gain_x']}x; best-effort absorbed "
+        f"{slo['preemptions']} preemptions)")
+    log(json.dumps(rec))
+    return rec
+
+
 def run_serving_bench(vocab=1024, maxlen=160, dim=512, heads=8, depth=4,
                       dtype_name="f32", prompt_len=16, max_new=48,
                       max_batch=16, block_size=16, n_baseline=6,
@@ -2598,7 +2806,8 @@ def run_serving_bench(vocab=1024, maxlen=160, dim=512, heads=8, depth=4,
                                     spec_tokens=4)
         if leg != "paged":
             raise ValueError(f"unknown serving leg {leg!r} "
-                             f"(choose from paged, int8, spec, hotswap)")
+                             f"(choose from paged, int8, spec, hotswap, "
+                             f"prefix, tenants)")
         return GenerationEngine(spec, params, max_batch=max_batch,
                                 block_size=block_size, max_queue=256)
 
@@ -2612,6 +2821,20 @@ def run_serving_bench(vocab=1024, maxlen=160, dim=512, heads=8, depth=4,
             max_batch=max_batch, block_size=block_size, seconds=seconds,
             seed=seed)
         legs = tuple(x for x in legs if x != "hotswap")
+    if "prefix" in legs:
+        # the shared-system-prompt leg (ISSUE 17) owns its engine pair
+        # (cache-off vs prefix_cache=True) — see run_serve_prefix_bench
+        out["serve_prefix"] = run_serve_prefix_bench(
+            spec, params, vocab, max_batch=max_batch,
+            block_size=block_size, seed=seed)
+        legs = tuple(x for x in legs if x != "prefix")
+    if "tenants" in legs:
+        # the mixed-tenant SLO leg (ISSUE 17): FIFO vs slo admission on
+        # a block-starved engine — see run_serve_tenants_bench
+        out["serve_tenants"] = run_serve_tenants_bench(
+            spec, params, vocab, max_batch=max(2, max_batch // 4),
+            block_size=block_size, seed=seed)
+        legs = tuple(x for x in legs if x != "tenants")
     for leg in legs:
         engine = build_engine(leg)
         server = GenerationServer(engine)
@@ -2791,8 +3014,12 @@ def main():
                     help="serving benchmark engine batch slots")
     ap.add_argument("--serve-legs", default="paged,int8,spec",
                     help="comma-separated serving legs to run "
-                         "(paged,int8,spec,hotswap — hotswap measures "
-                         "p99 across live weight swaps vs no-swap)")
+                         "(paged,int8,spec,hotswap,prefix,tenants — "
+                         "hotswap measures p99 across live weight swaps "
+                         "vs no-swap; prefix measures prefill ms under "
+                         "the shared-system-prompt radix cache; tenants "
+                         "measures realtime p99 under slo admission vs "
+                         "FIFO with best-effort preemption)")
     ap.add_argument("--trace-dir", default=None,
                     help="enable the flight recorder for every leg and "
                          "write one Perfetto-loadable Chrome trace JSON "

@@ -53,12 +53,24 @@ class RolloutPolicy:
 
     - ``{"action": "canary", "version": v, "fraction": f}`` — pin the
       candidate to a ``fraction`` of replicas.
-    - ``{"action": "promote", "version": v}`` — watchdog stayed green
-      for ``green_checks`` consecutive observations after a ``bake_s``
-      soak: activate fleet-wide.
+    - ``{"action": "ramp", "version": v, "fraction": f}`` — the current
+      ramp step stayed green for ``green_checks`` observations after its
+      ``bake_s`` soak: widen the canary to the next fraction (only with
+      a progressive ``fractions=`` ladder).
+    - ``{"action": "promote", "version": v}`` — the LAST ramp step
+      stayed green for ``green_checks`` consecutive observations after a
+      ``bake_s`` soak: activate fleet-wide.
     - ``{"action": "rollback", "version": v, "to": baseline}`` — the
       serving SLO fired ``red_checks`` consecutive observations: repin
       the canaries to the baseline.
+
+    ``fractions`` (ISSUE 17) turns the single static canary fraction
+    into a progressive ramp — e.g. ``[0.01, 0.1, 0.5]`` exposes 1% of
+    the fleet first, and each widening requires a FRESH bake + green
+    streak, so a regression that only shows under real traffic volume
+    is caught while it still touches a sliver of users. ``fractions=
+    None`` (default) is exactly the legacy single-step machine:
+    ``[canary_fraction]``.
 
     Hysteresis on BOTH edges (consecutive-check streaks + the bake
     time) keeps one noisy scrape from promoting a bad model or rolling
@@ -68,7 +80,8 @@ class RolloutPolicy:
 
     def __init__(self, canary_fraction: float = 0.25, bake_s: float = 2.0,
                  green_checks: int = 2, red_checks: int = 1,
-                 cooldown_s: float = 5.0):
+                 cooldown_s: float = 5.0,
+                 fractions: list[float] | None = None):
         if not 0.0 < canary_fraction <= 1.0:
             raise ValueError(
                 f"canary_fraction must be in (0, 1], got {canary_fraction}"
@@ -78,6 +91,19 @@ class RolloutPolicy:
         if green_checks < 1 or red_checks < 1:
             raise ValueError("green_checks and red_checks must be >= 1")
         self.canary_fraction = float(canary_fraction)
+        if fractions is None:
+            fractions = [self.canary_fraction]
+        fractions = [float(f) for f in fractions]
+        if not fractions or any(not 0.0 < f <= 1.0 for f in fractions):
+            raise ValueError(
+                f"fractions must be non-empty, each in (0, 1]: {fractions}"
+            )
+        if any(b <= a for a, b in zip(fractions, fractions[1:])):
+            raise ValueError(
+                f"fractions must be strictly increasing: {fractions}"
+            )
+        self.fractions = fractions
+        self._fi = 0              # index of the ACTIVE ramp step
         self.bake_s = float(bake_s)
         self.green_checks = int(green_checks)
         self.red_checks = int(red_checks)
@@ -110,12 +136,13 @@ class RolloutPolicy:
                 return out  # cooling down from the previous rollout
             self.state = "canary"
             self.candidate = int(candidate)
+            self._fi = 0
             self._t_canary = now
             self._t_last_action = now
             self._green_streak = 0
             self._red_streak = 0
             out.append(self._emit(now, "canary", version=self.candidate,
-                                  fraction=self.canary_fraction))
+                                  fraction=self.fractions[0]))
             return out
         # state == "canary"
         if slo_firing:
@@ -133,6 +160,18 @@ class RolloutPolicy:
         if green and now - self._t_canary >= self.bake_s:
             self._green_streak += 1
             if self._green_streak >= self.green_checks:
+                if self._fi + 1 < len(self.fractions):
+                    # ramp: widen to the next fraction; the new step
+                    # re-bakes and needs a FRESH green streak — each
+                    # widening earns its own soak
+                    self._fi += 1
+                    self._t_canary = now
+                    self._t_last_action = now
+                    self._green_streak = 0
+                    out.append(self._emit(
+                        now, "ramp", version=self.candidate,
+                        fraction=self.fractions[self._fi]))
+                    return out
                 version = self.candidate
                 self.state = "idle"
                 self.version = version
@@ -192,10 +231,13 @@ class RolloutController:
         versions = self.router.replica_versions()
         return sorted(versions, key=lambda k: (stable_hash(k), k))
 
-    def _pick_canaries(self, keys: list[str]) -> list[str]:
+    def _pick_canaries(self, keys: list[str],
+                       fraction: float | None = None) -> list[str]:
         if not keys:
             return []
-        n = max(1, int(math.ceil(self.policy.canary_fraction * len(keys))))
+        if fraction is None:
+            fraction = self.policy.canary_fraction
+        n = max(1, int(math.ceil(float(fraction) * len(keys))))
         return keys[:n]
 
     def _journal(self, now: float, action: dict, keys: list[str],
@@ -218,8 +260,18 @@ class RolloutController:
         for action in actions:
             kind = action["action"]
             if kind == "canary":
-                keys = self._pick_canaries(self._keys())
+                keys = self._pick_canaries(self._keys(),
+                                           action.get("fraction"))
                 self.canary_keys = keys
+            elif kind == "ramp":
+                # widen: activate ONLY the newly-added replicas — the
+                # existing canaries already run the candidate, and
+                # re-activating them would re-stage a no-op swap
+                want = self._pick_canaries(self._keys(),
+                                           action.get("fraction"))
+                have = set(self.canary_keys)
+                keys = [k for k in want if k not in have]
+                self.canary_keys = list(self.canary_keys) + keys
             elif kind == "promote":
                 # the canaries already run the candidate — activate the
                 # remainder of the fleet

@@ -58,10 +58,19 @@ class _ReplicaRing:
     HashRing`` — generalized from shard ids to replica names so churn
     moves ~1/N of prefixes, not all of them."""
 
-    def __init__(self, keys: Iterable[str], vnodes: int = 64):
+    def __init__(self, keys: Iterable[str], vnodes: int = 64,
+                 weights: dict[str, float] | None = None):
+        # weighted vnodes (ISSUE 17): a replica with weight w gets
+        # round(vnodes·w) ring points (floor 1 — never unreachable), so
+        # the router biases NEW prefixes toward replicas whose prefix
+        # caches are already warm. weight 1.0 for everyone reproduces
+        # the unweighted ring point-for-point.
+        weights = weights or {}
         pts = sorted(
             (stable_hash(f"replica:{k}/vnode:{v}"), k)
-            for k in keys for v in range(int(vnodes))
+            for k in keys
+            for v in range(max(1, round(int(vnodes)
+                                        * float(weights.get(k, 1.0)))))
         )
         self._hashes = [h for h, _ in pts]
         self._owners = [k for _, k in pts]
@@ -99,6 +108,7 @@ class RoutedGenerationClient:
 
     def __init__(self, replicas=None, directory=None, *,
                  prefix_tokens: int = 16, vnodes: int = 64,
+                 hit_affinity: float = 0.0,
                  policy=None, cooldown: float = 1.0,
                  refresh_interval: float = 2.0,
                  connect_timeout: float = 5.0):
@@ -117,6 +127,16 @@ class RoutedGenerationClient:
                               else DirectoryClient(directory))
         self.prefix_tokens = int(prefix_tokens)
         self.vnodes = int(vnodes)
+        # hit-rate feedback (ISSUE 17): each replica's ring weight is
+        # 1 + hit_affinity · its advertised prefix_hit_rate, so the
+        # FLEET hit rate climbs — warm replicas attract more of the
+        # keyspace. 0.0 (default) is the exact legacy unweighted ring;
+        # weighting is opt-in because it trades even load for locality.
+        if float(hit_affinity) < 0.0:
+            raise ValueError(
+                f"hit_affinity must be >= 0, got {hit_affinity}"
+            )
+        self.hit_affinity = float(hit_affinity)
         self.policy = policy if policy is not None else RetryPolicy(
             max_attempts=40, base_delay=0.02, max_delay=0.4, deadline=60.0,
         )
@@ -159,7 +179,16 @@ class RoutedGenerationClient:
             self._replicas = dict(replicas)
             self._meta = {k: dict(meta.get(k) or {}) for k in replicas} \
                 if meta is not None else {k: {} for k in replicas}
-            self._ring = _ReplicaRing(self._replicas, vnodes=self.vnodes)
+            weights = None
+            if self.hit_affinity:
+                weights = {
+                    k: 1.0 + self.hit_affinity * float(
+                        (self._meta.get(k) or {})
+                        .get("prefix_hit_rate", 0.0) or 0.0)
+                    for k in replicas
+                }
+            self._ring = _ReplicaRing(self._replicas, vnodes=self.vnodes,
+                                      weights=weights)
             for key in gone:
                 conn = self._conns.pop(key, None)
                 if conn is not None:
@@ -199,6 +228,17 @@ class RoutedGenerationClient:
         with self._lock:
             return {
                 k: int((self._meta.get(k) or {}).get("model_version", 0))
+                for k in self._replicas
+            }
+
+    def replica_hit_rates(self) -> dict[str, float]:
+        """Each replica's advertised prefix-cache hit rate (0.0 when its
+        registration carries none) — the affinity-weight input, exposed
+        for fleet dashboards and the bench."""
+        with self._lock:
+            return {
+                k: float((self._meta.get(k) or {})
+                         .get("prefix_hit_rate", 0.0) or 0.0)
                 for k in self._replicas
             }
 
@@ -332,6 +372,11 @@ class RoutedGenerationClient:
                 "replica_versions": {
                     k: int((self._meta.get(k) or {})
                            .get("model_version", 0))
+                    for k in self._replicas
+                },
+                "replica_hit_rates": {
+                    k: float((self._meta.get(k) or {})
+                             .get("prefix_hit_rate", 0.0) or 0.0)
                     for k in self._replicas
                 },
                 "failovers": self.failovers,

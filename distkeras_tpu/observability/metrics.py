@@ -278,6 +278,23 @@ _SERVING_SCHEMA: tuple[tuple[str, str, str, str], ...] = (
      "KV-cache blocks free in the pool"),
     ("blocks_high_water", "dk_serve_blocks_high_water", "gauge",
      "peak concurrent KV-cache block allocation"),
+    # the serving front door (ISSUE 17): prefix-cache reuse, COW, and
+    # SLO-admission preemption counters — absent keys simply don't emit,
+    # so engines without the front door keep their exact legacy surface
+    ("prefix_hit_tokens", "dk_serve_prefix_hit_tokens_total", "counter",
+     "prompt tokens served from the radix prefix cache"),
+    ("prefix_prompt_tokens", "dk_serve_prefix_prompt_tokens_total",
+     "counter", "prompt tokens admitted (hit-rate denominator)"),
+    ("prefix_hit_rate", "dk_serve_prefix_hit_rate", "gauge",
+     "lifetime token-level prefix-cache hit rate"),
+    ("prefix_cached_blocks", "dk_serve_prefix_cached_blocks", "gauge",
+     "KV blocks currently owned by the radix prefix cache"),
+    ("prefix_evictions", "dk_serve_prefix_evictions_total", "counter",
+     "cached blocks evicted (LRU refcount-0 leaves)"),
+    ("cow_copies", "dk_serve_prefix_cow_copies_total", "counter",
+     "copy-on-write block copies (partial-block divergence)"),
+    ("preemptions", "dk_serve_preemptions_total", "counter",
+     "running rows preempted for higher-SLO admissions"),
 )
 
 

@@ -484,6 +484,11 @@ _SERVE_SERIES: tuple[tuple[str, str], ...] = (
     ("queued", "gauge"), ("active", "gauge"),
     ("blocks_in_use", "gauge"), ("blocks_free", "gauge"),
     ("open_connections", "gauge"),
+    # the serving front door (ISSUE 17) — keys absent on engines
+    # without it, so legacy series sets are unchanged
+    ("prefix_hit_rate", "gauge"), ("prefix_cached_blocks", "gauge"),
+    ("prefix_evictions", "counter"), ("cow_copies", "counter"),
+    ("preemptions", "counter"),
 )
 
 

@@ -45,7 +45,8 @@ from distkeras_tpu.observability.timeseries import (
 __all__ = [
     "Alert", "AlertRule", "TauP95Rule", "CommitSkewRule",
     "CommitReplaySpikeRule", "WalFsyncTailRule", "RingOccupancyRule",
-    "DeployLagRule", "ServingSLORule", "LossStallRule",
+    "DeployLagRule", "ServingSLORule", "PrefixHitRateRule",
+    "LossStallRule",
     "BottleneckShiftRule", "SLOClass",
     "default_rules", "Watchdog", "Watchtower", "rates_from_counts",
     "worker_rates", "rounds_per_sec", "straggler_workers",
@@ -375,6 +376,38 @@ class ServingSLORule(AlertRule):
         return bool(misses), worst, {"misses": misses} if misses else None
 
 
+class PrefixHitRateRule(AlertRule):
+    """The front door's reuse health (ISSUE 17): the engine's lifetime
+    token-level prefix-cache hit rate sits below ``floor`` after at
+    least ``min_admitted`` requests. A cold cache warming up is normal
+    (the admission gate); a WARM replica stuck near zero means the
+    router is spraying prefixes instead of colocating them (affinity
+    off / misconfigured) or eviction is thrashing the tree — either
+    way the fleet is paying full prefill for prompts it already
+    computed. Engines without a prefix cache publish no
+    ``serve.prefix_hit_rate`` series and are never judged."""
+
+    kind = "prefix_hit_rate"
+
+    def __init__(self, floor: float = 0.05, min_admitted: int = 50, **kw):
+        super().__init__(**kw)
+        self.threshold = float(floor)
+        self.min_admitted = int(min_admitted)
+
+    def check(self, store, now):
+        rate = store.last("serve.prefix_hit_rate")
+        if rate is None:
+            return None, None, None     # cache off: nothing to judge
+        admitted = store.last("serve.admitted")
+        if admitted is None or admitted < self.min_admitted:
+            return None, rate, None     # still warming: hold state
+        detail = {"hit_rate": rate, "floor": self.threshold,
+                  "admitted": admitted,
+                  "cached_blocks": store.last("serve.prefix_cached_blocks"),
+                  "evictions": store.last("serve.prefix_evictions")}
+        return rate < self.threshold, rate, detail
+
+
 class LossStallRule(AlertRule):
     """Convergence stall: the least-squares slope of ``train.loss``
     over the trailing window is not meaningfully negative even though
@@ -479,6 +512,7 @@ def default_rules(slo: dict | None = None,
         RingOccupancyRule(),
         DeployLagRule(),
         ServingSLORule(slo=slo),
+        PrefixHitRateRule(),
         LossStallRule(),
         BottleneckShiftRule(),
     ]

@@ -16,6 +16,12 @@ top of it:
   admission into free slots/blocks, mixed prefill+decode across in-flight
   requests, per-row sampling params, per-step retirement, optional greedy
   speculative decoding with per-row advancement.
+- :mod:`~distkeras_tpu.serving.frontdoor` — the admission/reuse layer
+  (ISSUE 17): :class:`RadixPrefixCache`, a content-hash radix tree over
+  full KV blocks (vLLM-lineage automatic prefix caching with
+  copy-on-write), and :class:`TenantQueues`, per-tenant SLO-class
+  priority queues with preemption-by-recompute — switched on per engine
+  via ``prefix_cache=`` / ``prefill_chunk=`` / ``admission="slo"``.
 - :mod:`~distkeras_tpu.serving.server` — :class:`GenerationServer` /
   :class:`GenerationClient` / :class:`ResilientGenerationClient` on the
   hardened ``networking.py`` framing, with bounded-queue backpressure
@@ -26,6 +32,13 @@ Benchmark: ``bench.py --serve`` (Poisson open-loop load, throughput vs
 p50/p99, vs the sequential ``GeneratorPredictor`` baseline).
 """
 
+from distkeras_tpu.serving.frontdoor import (  # noqa: F401
+    SLO_PRIORITY,
+    PrefixMatch,
+    RadixPrefixCache,
+    TenantQueues,
+    slo_priority,
+)
 from distkeras_tpu.serving.paged_cache import (  # noqa: F401
     BlockAllocator,
     BlockPoolExhausted,
@@ -44,6 +57,11 @@ from distkeras_tpu.serving.server import (  # noqa: F401
 )
 
 __all__ = [
+    "SLO_PRIORITY",
+    "PrefixMatch",
+    "RadixPrefixCache",
+    "TenantQueues",
+    "slo_priority",
     "BlockAllocator",
     "BlockPoolExhausted",
     "PagedKVCache",

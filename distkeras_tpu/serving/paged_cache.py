@@ -30,6 +30,8 @@ serving is bit-identical to :func:`~distkeras_tpu.models.lm.generate`.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -129,6 +131,23 @@ class PagedKVCache:
         per = self.k_pools[0].dtype.itemsize
         return 2 * len(self.k_pools) * int(np.prod(self.k_pools[0].shape)) \
             * per
+
+    def copy_slots(self, src_slots, dst_slots) -> None:
+        """Device-copy K/V from flat pool slots ``src_slots`` to
+        ``dst_slots`` in every layer — the prefix cache's copy-on-write
+        primitive: a request diverging mid-block duplicates the shared
+        block's common positions into its own fresh block instead of
+        recomputing them. One jitted gather-scatter per call (all layers),
+        donated so steady-state COW never copies a whole pool."""
+        src = jnp.asarray(np.asarray(src_slots, np.int32))
+        dst = jnp.asarray(np.asarray(dst_slots, np.int32))
+        self.k_pools = _copy_pool_slots(self.k_pools, src, dst)
+        self.v_pools = _copy_pool_slots(self.v_pools, src, dst)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _copy_pool_slots(pools, src, dst):
+    return tuple(p.at[dst].set(p[src]) for p in pools)
 
 
 def slot_map(tables: np.ndarray, block_size: int) -> np.ndarray:

@@ -57,6 +57,11 @@ import numpy as np
 from distkeras_tpu.model import ModelSpec
 from distkeras_tpu.networking import ServerBusyError
 from distkeras_tpu.observability import trace as _trace
+from distkeras_tpu.serving.frontdoor import (
+    RadixPrefixCache,
+    TenantQueues,
+    slo_priority,
+)
 from distkeras_tpu.serving.paged_cache import (
     BlockAllocator,
     PagedKVCache,
@@ -132,7 +137,7 @@ class Request:
                  temperature: float, top_k: int | None,
                  top_p: float | None, seed: int, eos_id: int | None,
                  request_id: str | None = None,
-                 slo_class: str = "default"):
+                 slo_class: str = "default", tenant: str = "default"):
         self.id = request_id if request_id is not None \
             else f"req-{next(_req_ids)}"
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -142,10 +147,15 @@ class Request:
         self.top_p = top_p
         self.seed = int(seed)
         self.eos_id = eos_id
-        # SLO class (ISSUE 13): a label, not a priority — admission stays
-        # strict-FIFO; the label buckets the latency telemetry so the
-        # watchdog can hold each class to ITS bound (interactive vs batch)
+        # SLO class (ISSUE 13): a latency-telemetry label always; under
+        # admission="slo" (ISSUE 17) ALSO the admission priority — see
+        # frontdoor.SLO_PRIORITY
         self.slo_class = str(slo_class)
+        # multi-tenant admission (ISSUE 17): the fairness bucket — one
+        # tenant's backlog round-robins against its class siblings'
+        # instead of occupying the whole queue. Scheduling metadata only
+        # under admission="fifo".
+        self.tenant = str(tenant)
         # the engine's model version this request was ADMITTED under
         # (stamped at admission; re-stamped when a hot swap re-prefills
         # it) — the version its served stream is bit-identical to
@@ -185,15 +195,31 @@ class Request:
 
 
 class _Slot:
-    """Host bookkeeping for one occupied batch row."""
+    """Host bookkeeping for one occupied batch row.
 
-    __slots__ = ("request", "blocks", "next_pos", "last_tok")
+    ``blocks`` are the row's PRIVATE pool blocks (freed at retire);
+    under a prefix cache the row may additionally reference shared
+    tree blocks through ``pinned`` (released, never freed, at retire).
+    ``phase`` is ``"decode"`` for legacy rows; front-door rows start in
+    ``"prefill"`` and feed ``feed[next_pos:feed_len]`` in chunks before
+    flipping to decode."""
+
+    __slots__ = ("request", "blocks", "next_pos", "last_tok",
+                 "phase", "feed", "feed_len", "pinned", "cow",
+                 "sample_first", "resume_tok")
 
     def __init__(self, request: Request, blocks: list[int]):
         self.request = request
         self.blocks = blocks
         self.next_pos = 0   # absolute position of the token being FED
         self.last_tok = 0
+        self.phase = "decode"
+        self.feed: np.ndarray | None = None   # tokens still to prefill
+        self.feed_len = 0
+        self.pinned: list = []                # pinned radix-tree nodes
+        self.cow: tuple | None = None         # (node, m, dst_block)
+        self.sample_first = True   # sample at prefill end (fresh request)
+        self.resume_tok = 0        # pending token of a preempted request
 
 
 class GenerationEngine:
@@ -211,7 +237,10 @@ class GenerationEngine:
     def __init__(self, model, params, *, max_batch: int = 8,
                  block_size: int = 16, num_blocks: int | None = None,
                  max_queue: int = 64, draft=None, draft_params=None,
-                 spec_tokens: int = 4, model_version: int = 0):
+                 spec_tokens: int = 4, model_version: int = 0,
+                 prefix_cache: bool = False,
+                 prefill_chunk: int | None = None,
+                 admission: str = "fifo"):
         from distkeras_tpu.models.lm import TransformerLM
 
         module = model.module if isinstance(model, ModelSpec) else model
@@ -245,6 +274,37 @@ class GenerationEngine:
             num_blocks = self.max_batch * self._nb_per_seq + 1
         self.allocator = BlockAllocator(num_blocks, self.block_size)
         self.cache = PagedKVCache(module, num_blocks, self.block_size)
+
+        # -- the serving front door (ISSUE 17) ---------------------------
+        if admission not in ("fifo", "slo"):
+            raise ValueError(
+                f"admission must be 'fifo' or 'slo', got {admission!r}"
+            )
+        if prefill_chunk is not None and int(prefill_chunk) < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
+        self.prefix_cache = bool(prefix_cache)
+        self.prefill_chunk = (None if prefill_chunk is None
+                              else int(prefill_chunk))
+        self.admission = str(admission)
+        # any front-door feature routes ALL prefill through the chunked
+        # paged program (suffix prefill past a cached prefix and
+        # preemption's prompt+generated recompute are the same mechanism)
+        self._frontdoor = (self.prefix_cache
+                           or self.prefill_chunk is not None
+                           or self.admission == "slo")
+        if self._frontdoor and draft is not None:
+            raise ValueError(
+                "prefix_cache/prefill_chunk/admission='slo' cannot be "
+                "combined with a draft model: the draft's pools never "
+                "hold a cached prefix's K/V, so speculative verify "
+                "would read garbage"
+            )
+        self._prefix = (RadixPrefixCache(self.block_size)
+                        if self.prefix_cache else None)
+        self._tq = TenantQueues() if self.admission == "slo" else None
+        self._chunk_fns: dict[tuple, object] = {}
 
         self._draft_module = None
         self._draft_params = draft_params
@@ -298,6 +358,11 @@ class GenerationEngine:
             "spec_rounds": 0, "spec_proposed": 0, "spec_accepted": 0,
             "swaps": 0, "refilled": 0,
         }
+        if self.admission == "slo":
+            self.stats_["preemptions"] = 0
+        if self.prefix_cache:
+            self.stats_.update(prefix_hit_tokens=0,
+                               prefix_prompt_tokens=0, cow_copies=0)
         # retired-request latency ring (ISSUE 13): one bounded record
         # per finalized request — the per-SLO-class p50/p99 +
         # queue/prefill/decode breakdown the watchtower samples and the
@@ -381,6 +446,39 @@ class GenerationEngine:
 
         return jax.jit(fn, donate_argnums=(2, 3, 4, 5))
 
+    def _make_chunk(self):
+        """The front-door prefill program: one ``paged_extend_rows`` pass
+        feeding each row's next chunk of uncached tokens at its own
+        position — suffix prefill past a cached prefix, Sarathi-style
+        chunked prefill of a long prompt, and preemption's
+        prompt+generated recompute are all this one program. The sampled
+        token is only meaningful on a row's FINAL chunk (``last_idx``
+        points at the last prompt token's logits; ``sample_pos`` is the
+        prompt length so the key matches ``_make_prefill`` exactly);
+        intermediate chunks discard it."""
+        from distkeras_tpu.models.lm import TransformerLM
+
+        module, bs = self._module, self.block_size
+
+        def fn(params, k_pools, v_pools, tokens, tables, write_slots,
+               positions, last_idx, temp, top_k, top_p, greedy, seeds,
+               sample_pos):
+            logits, k_pools, v_pools = module.apply(
+                {"params": params}, tokens, k_pools, v_pools, tables,
+                write_slots, positions, bs,
+                method=TransformerLM.paged_extend_rows,
+            )
+            last = jnp.take_along_axis(
+                logits, last_idx[:, None, None], axis=1
+            )[:, 0]                                          # [n, V]
+            keys = jax.vmap(
+                lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p)
+            )(seeds, sample_pos)
+            tok = sample_rows(last, keys, temp, top_k, top_p, greedy)
+            return tok, k_pools, v_pools
+
+        return jax.jit(fn, donate_argnums=(1, 2))
+
     def _make_spec(self):
         from distkeras_tpu.models.lm import TransformerLM
 
@@ -435,14 +533,16 @@ class GenerationEngine:
                top_p: float | None = None, seed: int = 0,
                eos_id: int | None = None,
                request_id: str | None = None,
-               slo_class: str = "default") -> Request:
+               slo_class: str = "default",
+               tenant: str = "default") -> Request:
         """Queue one generation; returns the :class:`Request` handle
         immediately. Raises :class:`ServerBusyError` when the bounded
         admission queue is full (backpressure) and ``ValueError`` on
         malformed requests — both BEFORE the queue, so a rejected request
         costs the engine nothing. ``slo_class`` labels the request's
         latency telemetry (per-class p50/p99 vs SLO in the watchdog);
-        it does not change scheduling."""
+        under ``admission="slo"`` it is ALSO the admission priority, and
+        ``tenant`` buckets the per-tenant fairness rotation."""
         module = self._module
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1:
@@ -492,20 +592,20 @@ class GenerationEngine:
             prompt, max_new_tokens=max_new, temperature=float(temperature),
             top_k=top_k, top_p=top_p, seed=int(seed),
             eos_id=None if eos_id is None else int(eos_id),
-            request_id=request_id, slo_class=slo_class,
+            request_id=request_id, slo_class=slo_class, tenant=tenant,
         )
         with self._wake:
             if self._closed:
                 raise ServerBusyError("engine is draining: not accepting "
                                       "new requests")
-            if len(self._queue) >= self.max_queue:
+            if self._queued_count() >= self.max_queue:
                 self.stats_["rejected"] += 1
                 req.state = "rejected"
                 raise ServerBusyError(
                     f"admission queue full ({self.max_queue} waiting)"
                 )
             self.stats_["submitted"] += 1
-            self._queue.append(req)
+            self._q_push(req)
             self._wake.notify_all()
         # flight recorder: the request id is the serving tier's
         # correlation id (carried in the wire frame), so this enqueue
@@ -520,6 +620,30 @@ class GenerationEngine:
         with self._wake:
             request._cancelled = True
             self._wake.notify_all()
+
+    # -- queue plumbing: one strict-FIFO deque, or the tenant queues ---------
+
+    def _queued_count(self) -> int:
+        return len(self._tq) if self._tq is not None else len(self._queue)
+
+    def _q_push(self, req: Request) -> None:
+        if self._tq is not None:
+            self._tq.push(req)
+        else:
+            self._queue.append(req)
+
+    def _q_push_front(self, req: Request) -> None:
+        if self._tq is not None:
+            self._tq.push_front(req)
+        else:
+            self._queue.appendleft(req)
+
+    def _q_drain(self) -> list[Request]:
+        if self._tq is not None:
+            return self._tq.drain()
+        out = list(self._queue)
+        self._queue.clear()
+        return out
 
     # -- the hot-swap version gate (distkeras_tpu/deploy) --------------------
 
@@ -571,19 +695,16 @@ class GenerationEngine:
                           key=lambda b: self._slots[b].request.t_admit,
                           reverse=True)
             for b in rows:
-                slot = self._slots[b]
-                self._slots[b] = None
-                self._tables[b, :] = 0
-                self.allocator.free(slot.blocks)
-                req = slot.request
-                req.new_tokens = []
-                req.state = "queued"
-                req.t_admit = None
-                req.prefill_s = None
-                req.model_version = None
-                self._queue.appendleft(req)
+                self._evacuate_row(b, reset_tokens=True)
                 self.stats_["refilled"] += 1
-            self._batch_dirty = True
+        if self._prefix is not None:
+            # version gate for the radix tree: every cached block holds
+            # K/V computed under the OLD weights — flush it all (no node
+            # is pinned here: drain waited for an empty batch, refill
+            # just evacuated every row)
+            freed = self._prefix.flush()
+            if freed:
+                self.allocator.free(freed)
         self._params = params
         if draft_params is not None:
             self._draft_params = draft_params
@@ -638,8 +759,47 @@ class GenerationEngine:
             self._slots[b] = None
             self._tables[b, :] = 0
             self._batch_dirty = True
+            self._release_pins(slot)
             self.allocator.free(slot.blocks)
             self._finalize(slot.request, state, error)
+
+    def _release_pins(self, slot: _Slot) -> None:
+        """Drop the row's references on shared radix-tree nodes: its
+        matched chain and, if the copy-on-write landed nobody yet, the
+        pending COW source (pinned at admission so eviction could not
+        free it between match and copy)."""
+        if self._prefix is None:
+            return
+        if slot.pinned:
+            self._prefix.release(slot.pinned)
+            slot.pinned = []
+        if slot.cow is not None:
+            self._prefix.release([slot.cow[0]])
+            slot.cow = None
+
+    def _evacuate_row(self, b: int, *, reset_tokens: bool) -> None:
+        """Tear one RUNNING row down and re-queue its request at the
+        head: private blocks freed, tree pins released, request back to
+        ``queued``. Hot-swap ``refill`` and preemption-by-recompute share
+        this — refill also resets the emitted stream (it replays under
+        the new weights); preemption keeps ``new_tokens`` and the
+        re-admission re-prefills prompt+generated-so-far, so sampling
+        (deterministic per seed and absolute position) resumes
+        bit-identically."""
+        slot = self._slots[b]
+        self._slots[b] = None
+        self._tables[b, :] = 0
+        self._batch_dirty = True
+        self._release_pins(slot)
+        self.allocator.free(slot.blocks)
+        req = slot.request
+        if reset_tokens:
+            req.new_tokens = []
+        req.state = "queued"
+        req.t_admit = None
+        req.prefill_s = None
+        req.model_version = None
+        self._q_push_front(req)
 
     def _admit(self) -> list[tuple[int, Request]]:
         """FIFO admission under the lock; returns newly filled (row, req)
@@ -678,6 +838,159 @@ class GenerationEngine:
                               int(head.t_admit * 1e9), corr=head.id)
             admitted.append((b, head))
         return admitted
+
+    # -- front-door admission (ISSUE 17) --------------------------------------
+
+    def _q_pop_head(self, req: Request) -> None:
+        if self._tq is not None:
+            self._tq.pop(req)
+        else:
+            self._queue.popleft()
+
+    def _admit_frontdoor(self) -> int:
+        """Admission with the front door on: the head candidate (highest
+        SLO class, tenant round-robin within it) is matched against the
+        prefix cache, reserved only its UNCACHED blocks, and installed in
+        ``"prefill"`` phase for the chunk loop. The head is never skipped
+        — when it cannot fit even after tree eviction and (under SLO
+        admission) preemption of strictly-lower-priority rows, admission
+        stops: the same no-starvation rule as strict FIFO."""
+        admitted = 0
+        if (self._staged_swap is not None
+                and self._staged_swap[2] == "drain"):
+            return admitted  # draining toward a staged swap
+        while True:
+            if not any(s is None for s in self._slots):
+                break
+            if self._tq is not None:
+                head = self._tq.candidate()
+            else:
+                head = self._queue[0] if self._queue else None
+            if head is None:
+                break
+            if head._cancelled:
+                self._q_pop_head(head)
+                self._finalize(head, "cancelled", "cancelled while queued")
+                continue
+            res = self._reserve_for(head)
+            if res is None:
+                break
+            self._q_pop_head(head)
+            b = next(i for i, s in enumerate(self._slots) if s is None)
+            self._install_row(b, head, res)
+            admitted += 1
+        return admitted
+
+    def _reserve_for(self, req: Request):
+        """Reserve blocks (and a pinned prefix-cache match) for ``req``
+        under the lock, or return None when the pool cannot fit it. The
+        shortfall ladder: evict refcount-0 cached chains first, then
+        (SLO admission only) preempt strictly-lower-priority running
+        rows, latest-admitted first. A valid request always fits an
+        empty pool (submit() rejects anything over capacity), so the
+        ladder terminates."""
+        bs = self.block_size
+        lp = req.prompt.shape[0]
+        g = len(req.new_tokens)
+        if g:
+            # resume after preemption/requeue: re-prefill the prompt plus
+            # everything emitted EXCEPT the pending last token — its K/V
+            # is written when decode feeds it, exactly as if the request
+            # had never left the batch
+            feed = np.concatenate(
+                [req.prompt, np.asarray(req.new_tokens[:-1], np.int32)])
+        else:
+            feed = req.prompt
+        feed_len = int(feed.shape[0])
+        total = self._blocks_needed(lp, req.max_new_tokens)
+        match, cached_len = None, 0
+        if self._prefix is not None:
+            # fresh requests keep at least the LAST prompt token uncached
+            # (its logits seed the first sample); a resumed request's
+            # pending token is already known, so it may match all of feed
+            cap = feed_len if g else lp - 1
+            match = self._prefix.match(feed, cap)
+            if match.cow_node is not None:
+                match.cow_node.refs += 1  # pin until the slots are copied
+            cached_len = match.tokens(bs)
+        cb = len(match.nodes) if match else 0
+        need = total - cb
+        while not self.allocator.can_alloc(need):
+            if self._prefix is not None:
+                freed = self._prefix.evict(
+                    need - self.allocator.free_blocks)
+                if freed:
+                    self.allocator.free(freed)
+                    continue
+            if not self._preempt_lower(req):
+                if match is not None:
+                    if match.cow_node is not None:
+                        self._prefix.release([match.cow_node])
+                    self._prefix.release(match.nodes)
+                return None
+        blocks = self.allocator.alloc(need)
+        return (match, blocks, feed, feed_len, cached_len, g)
+
+    def _preempt_lower(self, req: Request) -> bool:
+        """Preempt ONE running row whose request has a strictly lower
+        SLO priority (latest admitted first — the least sunk prefill
+        cost), freeing its private blocks. The victim re-queues at its
+        tenant's head and recomputes prompt+generated on re-admission."""
+        if self._tq is None:
+            return False
+        prio = slo_priority(req.slo_class)
+        victims = [b for b, s in enumerate(self._slots)
+                   if s is not None
+                   and slo_priority(s.request.slo_class) > prio]
+        if not victims:
+            return False
+        b = max(victims, key=lambda x: self._slots[x].request.t_admit)
+        victim_id = self._slots[b].request.id
+        self._evacuate_row(b, reset_tokens=False)
+        self.stats_["preemptions"] += 1
+        _trace.instant("serve.preempt", corr=victim_id,
+                       args={"for": req.id})
+        return True
+
+    def _install_row(self, b: int, req: Request, res) -> None:
+        match, blocks, feed, feed_len, cached_len, g = res
+        slot = _Slot(req, blocks)
+        self._tables[b, :] = 0
+        cb = len(match.nodes) if match else 0
+        if cb:
+            self._tables[b, :cb] = match.blocks
+            slot.pinned = list(match.nodes)
+        self._tables[b, cb:cb + len(blocks)] = blocks
+        slot.feed = np.asarray(feed, np.int32)
+        slot.feed_len = feed_len
+        slot.next_pos = int(cached_len)
+        if match is not None and match.cow_node is not None:
+            # the divergent block's first cow_len slots are copied from
+            # the COW source into the row's FIRST private block before
+            # any forward touches them (_apply_cows, same step)
+            slot.cow = (match.cow_node, match.cow_len, blocks[0])
+        if self.prefix_cache:
+            self.stats_["prefix_hit_tokens"] += int(cached_len)
+            self.stats_["prefix_prompt_tokens"] += feed_len
+        if g:
+            slot.sample_first = False
+            slot.resume_tok = int(req.new_tokens[-1])
+        if cached_len >= feed_len:
+            # fully cached resume: nothing left to prefill
+            slot.phase = "decode"
+            slot.last_tok = slot.resume_tok
+            req.prefill_s = 0.0
+        else:
+            slot.phase = "prefill"
+        self._slots[b] = slot
+        self._batch_dirty = True
+        req.state = "running"
+        req.t_admit = time.monotonic()
+        req.model_version = self.model_version
+        self.stats_["admitted"] += 1
+        if _trace.enabled():
+            _trace.record("serve.queued", int(req.t_submit * 1e9),
+                          int(req.t_admit * 1e9), corr=req.id)
 
     def _run_prefills(self, admitted) -> None:
         """Prefill an admission burst in as few forwards as possible: one
@@ -757,6 +1070,148 @@ class GenerationEngine:
                 slot.last_tok = int(tok[i])
                 self._emit(b, [slot.last_tok])
 
+    def _apply_cows(self) -> None:
+        """Land every pending copy-on-write: device-copy each COW source
+        block's shared leading slots into the row's first private block,
+        then unpin the source. Runs BEFORE any forward each step — the
+        chunk (or the fully-cached resume's decode) attends over those
+        positions."""
+        rows = [b for b, s in enumerate(self._slots)
+                if s is not None and s.cow is not None]
+        if not rows:
+            return
+        bs = self.block_size
+        src, dst, pending = [], [], []
+        for b in rows:
+            node, m, d = self._slots[b].cow
+            src.append(node.block * bs + np.arange(m, dtype=np.int64))
+            dst.append(d * bs + np.arange(m, dtype=np.int64))
+            pending.append((b, node))
+        src = np.concatenate(src)
+        dst = np.concatenate(dst)
+        # pad to a power of two with scratch self-copies (slot 0 → slot
+        # 0) so the jitted gather-scatter compiles a handful of shapes
+        npad = 1 << (len(src) - 1).bit_length()
+        pad = npad - len(src)
+        if pad:
+            src = np.concatenate([src, np.zeros(pad, np.int64)])
+            dst = np.concatenate([dst, np.zeros(pad, np.int64)])
+        self.cache.copy_slots(src, dst)
+        with self._wake:
+            for b, node in pending:
+                self._slots[b].cow = None
+                self._prefix.release([node])
+                self.stats_["cow_copies"] += 1
+
+    def _run_chunks(self, rows) -> None:
+        """One chunk of front-door prefill for every ``"prefill"``-phase
+        row: each feeds up to ``prefill_chunk`` (or its whole remaining
+        suffix) tokens at its own position through ONE batched
+        ``paged_extend_rows`` — then the step's decode batch runs, so a
+        long prompt interleaves with in-flight decode instead of
+        head-of-line-blocking it. A row whose feed completes flips to
+        decode; fresh rows sample their first token from the last prompt
+        position's logits, resumed rows re-emit nothing (their pending
+        token was sampled before preemption)."""
+        bs = self.block_size
+        vocab = self._module.vocab
+        rem = max(self._slots[b].feed_len - self._slots[b].next_pos
+                  for b in rows)
+        Tpad = (self.prefill_chunk if self.prefill_chunk is not None
+                else 1 << (rem - 1).bit_length())
+        n = len(rows)
+        npad = 1 << (n - 1).bit_length()
+        need_pos = max(min(Tpad, self._slots[b].feed_len
+                           - self._slots[b].next_pos)
+                       + self._slots[b].next_pos for b in rows)
+        nb = min(self._nb_per_seq,
+                 2 * math.ceil(math.ceil(need_pos / bs) / 2))
+        tokens = np.zeros((npad, Tpad), np.int32)
+        tables = np.zeros((npad, nb), np.int32)
+        # pad rows / pad positions write the scratch block's slots —
+        # garbage nobody reads, same trick as the legacy prefill buckets
+        write_slots = np.tile((np.arange(Tpad) % bs).astype(np.int32),
+                              (npad, 1))
+        positions = np.zeros((npad,), np.int32)
+        last_idx = np.zeros((npad,), np.int32)
+        sample_pos = np.zeros((npad,), np.int32)
+        temp = np.zeros((npad,), np.float32)
+        top_k = np.full((npad,), vocab, np.int32)
+        top_p = np.ones((npad,), np.float32)
+        greedy = np.ones((npad,), bool)
+        seeds = np.zeros((npad,), np.int32)
+        t_real = []
+        for i, b in enumerate(rows):
+            s = self._slots[b]
+            r = s.request
+            t = min(Tpad, s.feed_len - s.next_pos)
+            t_real.append(t)
+            tokens[i, :t] = s.feed[s.next_pos: s.next_pos + t]
+            tables[i] = self._tables[b, :nb]
+            pos = s.next_pos + np.arange(t)
+            write_slots[i, :t] = tables[i, pos // bs] * bs + pos % bs
+            positions[i] = s.next_pos
+            last_idx[i] = min(max(s.feed_len - 1 - s.next_pos, 0),
+                              Tpad - 1)
+            sample_pos[i] = s.feed_len   # == lp for fresh requests: the
+            temp[i] = r.temperature      # key matches _make_prefill
+            if r.top_k is not None:
+                top_k[i] = r.top_k
+            if r.top_p is not None:
+                top_p[i] = r.top_p
+            greedy[i] = r.greedy
+            seeds[i] = r.seed
+        key = (Tpad, npad, nb)
+        if key not in self._chunk_fns:
+            self._chunk_fns[key] = self._make_chunk()
+        c = self.cache
+        t_pf = time.perf_counter_ns()
+        tok, c.k_pools, c.v_pools = self._chunk_fns[key](
+            self._params, c.k_pools, c.v_pools, jnp.asarray(tokens),
+            jnp.asarray(tables), jnp.asarray(write_slots),
+            jnp.asarray(positions), jnp.asarray(last_idx),
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(greedy), jnp.asarray(seeds),
+            jnp.asarray(sample_pos),
+        )
+        tok = np.asarray(jax.device_get(tok))
+        t1_pf = time.perf_counter_ns()
+        with self._wake:
+            for i, b in enumerate(rows):
+                s = self._slots[b]
+                r = s.request
+                r.prefill_s = (r.prefill_s or 0.0) + (t1_pf - t_pf) / 1e9
+                if _trace.enabled():
+                    _trace.record("serve.prefill", t_pf, t1_pf, corr=r.id,
+                                  args={"rows": n, "chunk": int(t_real[i]),
+                                        "pos": int(s.next_pos)})
+                s.next_pos += t_real[i]
+                if s.next_pos < s.feed_len:
+                    continue
+                # feed complete: this row decodes from the next step
+                s.phase = "decode"
+                self.stats_["prefills"] += 1
+                if self._prefix is not None:
+                    # donate the prompt's full blocks to the radix tree;
+                    # blocks already cached along the chain stay private
+                    lp = r.prompt.shape[0]
+                    nfull = lp // bs
+                    if nfull:
+                        new_nodes, adopted = self._prefix.insert(
+                            r.prompt,
+                            [int(self._tables[b, k]) for k in range(nfull)],
+                        )
+                        s.pinned.extend(new_nodes)
+                        if adopted:
+                            adset = set(adopted)
+                            s.blocks = [x for x in s.blocks
+                                        if x not in adset]
+                if s.sample_first:
+                    s.last_tok = int(tok[i])
+                    self._emit(b, [s.last_tok])
+                else:
+                    s.last_tok = s.resume_tok
+
     def _emit(self, b: int, tokens: list[int]) -> None:
         """Append emitted tokens to row ``b``'s request, applying the
         retire rule (budget, then first EOS — the rule
@@ -784,12 +1239,25 @@ class GenerationEngine:
                 if slot is not None and slot.request._cancelled:
                     self._retire(b, "cancelled", "cancelled by client")
             self._apply_swap_locked()
-            admitted = self._admit()
-        if admitted:
-            self._run_prefills(admitted)
-        active = [b for b, s in enumerate(self._slots) if s is not None]
+            admitted = (self._admit_frontdoor() if self._frontdoor
+                        else self._admit())
+        worked = bool(admitted)
+        if self._frontdoor:
+            self._apply_cows()
+            prefill_rows = [b for b, s in enumerate(self._slots)
+                            if s is not None and s.phase == "prefill"]
+            if prefill_rows:
+                self._run_chunks(prefill_rows)
+                worked = True
+            active = [b for b, s in enumerate(self._slots)
+                      if s is not None and s.phase == "decode"]
+        else:
+            if admitted:
+                self._run_prefills(admitted)
+            active = [b for b, s in enumerate(self._slots)
+                      if s is not None]
         if not active:
-            return bool(admitted)
+            return worked
         # rows-in-flight rides the span (ISSUE 14): the analyzer's
         # batch-occupancy input ("batch" kept for older readers)
         _args = ({"batch": len(active), "rows": len(active)}
@@ -867,6 +1335,17 @@ class GenerationEngine:
         self._refresh_batch_cache()
         tok, positions = self._tok_positions(active)
         write_slot = self._np_slots[np.arange(self.max_batch), positions]
+        if self._frontdoor:
+            # rows mid-chunked-prefill sit in the batch with REAL blocks
+            # in their tables but position 0 here — without masking, the
+            # decode write would land in their (possibly SHARED, cached)
+            # first block's slot 0. Park every non-decode row's write in
+            # the scratch block instead.
+            mask = np.zeros((self.max_batch,), bool)
+            mask[active] = True
+            write_slot = np.where(
+                mask, write_slot,
+                np.arange(self.max_batch) % self.block_size)
         dev_tables = self._tables_for(int(positions.max()) + 1)
         c = self.cache
         if self._all_greedy:
@@ -923,7 +1402,8 @@ class GenerationEngine:
     # -- lifecycle -----------------------------------------------------------
 
     def _idle(self) -> bool:
-        return not self._queue and all(s is None for s in self._slots)
+        return (self._queued_count() == 0
+                and all(s is None for s in self._slots))
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> None:
         """Synchronous drive (tests, parity oracles): step until every
@@ -957,9 +1437,8 @@ class GenerationEngine:
                     for b, slot in enumerate(self._slots):
                         if slot is not None:
                             self._retire(b, "failed", repr(e))
-                    while self._queue:
-                        self._finalize(self._queue.popleft(), "failed",
-                                       repr(e))
+                    for req in self._q_drain():
+                        self._finalize(req, "failed", repr(e))
                 raise
 
     def start(self) -> None:
@@ -1002,9 +1481,8 @@ class GenerationEngine:
             for b, slot in enumerate(self._slots):
                 if slot is not None:
                     self._retire(b, "cancelled", "engine stopped")
-            while self._queue:
-                self._finalize(self._queue.popleft(), "cancelled",
-                               "engine stopped")
+            for req in self._q_drain():
+                self._finalize(req, "cancelled", "engine stopped")
 
     def latency_stats(self, window_s: float | None = None) -> dict:
         """Per-SLO-class latency summary (see
@@ -1020,7 +1498,7 @@ class GenerationEngine:
             # percentile math is O(ring) and the decode loop contends
             # for this lock — a scrape must not stall token generation
             retired = list(self._retired)
-            s["queued"] = len(self._queue)
+            s["queued"] = self._queued_count()
             s["active"] = sum(1 for x in self._slots if x is not None)
             s["model_version"] = self.model_version
             s["staged_version"] = (
@@ -1038,5 +1516,37 @@ class GenerationEngine:
                     round(s["spec_accepted"] / s["spec_proposed"], 4)
                     if s["spec_proposed"] else 0.0
                 )
+            if self._prefix is not None:
+                s["prefix_cached_blocks"] = len(self._prefix)
+                s["prefix_evictions"] = self._prefix.evictions
+                tot = s["prefix_prompt_tokens"]
+                s["prefix_hit_rate"] = (
+                    round(s["prefix_hit_tokens"] / tot, 4) if tot else 0.0
+                )
         s["latency"] = summarize_latencies(retired)
         return s
+
+    def prefix_hit_rate(self) -> float:
+        """Lifetime token-level prefix-cache hit rate (0.0 when the cache
+        is off or nothing admitted yet) — the number the server publishes
+        into directory meta so the router can weight replica affinity by
+        where prefixes are already warm."""
+        with self._lock:
+            if self._prefix is None:
+                return 0.0
+            tot = self.stats_["prefix_prompt_tokens"]
+            if not tot:
+                return 0.0
+            return round(self.stats_["prefix_hit_tokens"] / tot, 4)
+
+    def flush_prefix_cache(self) -> int:
+        """Drop every unpinned cached chain, returning its blocks to the
+        allocator; returns how many blocks were freed. Chains pinned by
+        in-flight rows survive."""
+        with self._lock:
+            if self._prefix is None:
+                return 0
+            freed = self._prefix.flush()
+            if freed:
+                self.allocator.free(freed)
+            return len(freed)

@@ -39,7 +39,7 @@ from distkeras_tpu.networking import ProtocolError, ServerBusyError
 from distkeras_tpu.serving.scheduler import GenerationEngine, Request
 
 _SAMPLING_KEYS = ("max_new_tokens", "temperature", "top_k", "top_p",
-                  "seed", "eos_id", "request_id", "slo_class")
+                  "seed", "eos_id", "request_id", "slo_class", "tenant")
 
 
 class GenerationServer:
@@ -297,10 +297,17 @@ class GenerationServer:
             key = f"{self.host}:{self.port}"
 
         def publish():
+            # the meta rides every renewal, so a hot swap (version) and a
+            # warming prefix cache (hit rate → router affinity weights,
+            # ISSUE 17) are both fleet-visible within ttl/3
             directory.publish(
                 "serve", key, self.host, self.port, epoch=int(epoch),
                 ttl=float(ttl),
-                meta={"model_version": int(self.engine.model_version)},
+                meta={
+                    "model_version": int(self.engine.model_version),
+                    "prefix_hit_rate": float(
+                        self.engine.prefix_hit_rate()),
+                },
             )
 
         publish()
@@ -393,7 +400,8 @@ class GenerationClient:
                  top_p: float | None = None, seed: int = 0,
                  eos_id: int | None = None,
                  request_id: str | None = None,
-                 slo_class: str = "default") -> np.ndarray:
+                 slo_class: str = "default",
+                 tenant: str = "default") -> np.ndarray:
         networking.send_data(self._sock, {
             "action": "generate",
             "prompt": np.asarray(prompt, np.int32),
@@ -401,7 +409,7 @@ class GenerationClient:
             "temperature": float(temperature),
             "top_k": top_k, "top_p": top_p, "seed": int(seed),
             "eos_id": eos_id, "request_id": request_id,
-            "slo_class": str(slo_class),
+            "slo_class": str(slo_class), "tenant": str(tenant),
         })
         r = networking.recv_data(self._sock)
         if r.get("error") == "busy":
@@ -440,6 +448,28 @@ class GenerationClient:
         """Current/staged model version + stored snapshot versions."""
         networking.send_data(self._sock, {"action": "deploy_status"})
         return networking.recv_data(self._sock)
+
+    def wait_for_swap(self, timeout: float = 10.0,
+                      poll: float = 0.02) -> dict:
+        """Block until no swap is staged (``deploy_status()``'s
+        ``staged_version`` is None — a drain landed, a refill applied)
+        and return the final status. Replaces the hand-rolled
+        staged-swap polling every deploy test used to write. Raises
+        :class:`TimeoutError` with the stuck status when ``timeout``
+        elapses first — e.g. a drain-policy swap behind a request that
+        never finishes."""
+        import time as _time
+
+        deadline = _time.monotonic() + float(timeout)
+        while True:
+            status = self.deploy_status()
+            if status.get("staged_version") is None:
+                return status
+            if _time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"swap still staged after {timeout}s: {status}"
+                )
+            _time.sleep(poll)
 
     def set_timeout(self, seconds: float | None) -> None:
         self._sock.settimeout(seconds)

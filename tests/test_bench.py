@@ -351,3 +351,50 @@ def test_serving_bench_smoke():
     assert rec["rates"] and all("offered_rps" in r for r in rec["rates"])
     # every accepted request completed (none stranded by the drain)
     assert rec["completed"] > 0
+
+
+def test_serve_prefix_bench_smoke():
+    """--serve-legs prefix plumbing (ISSUE 17): the shared-system-prompt
+    leg's stdout-JSON record contract — prefill ms at ~0% vs high hit
+    rate off the SAME engine, keyed so the --regress trajectory judges
+    cold/warm prefill as lower-better metrics."""
+    spec, params = bench._serve_lm(64, 64, 32, 2, 1, "f32")
+    rec = bench.run_serve_prefix_bench(
+        spec, params, 64, max_new=4, max_batch=2, block_size=8,
+        sys_len=24, tail_len=8, n_requests=4, prefill_chunk=8, seed=0)
+    for key in ("cold_prefill_ms", "warm_prefill_ms", "prefill_speedup",
+                "cold_hit_rate", "warm_hit_rate", "prefix_cached_blocks",
+                "cow_copies", "host_cores"):
+        assert key in rec, key
+    assert rec["config"] == "serve_prefix"
+    # the acceptance shape: hit rate rises, prefill cost falls with it
+    assert rec["cold_hit_rate"] == 0.0
+    assert rec["warm_hit_rate"] >= 0.5
+    assert rec["cold"]["completed"] == rec["warm"]["completed"] == 4
+    # the trajectory contract sees these as performance metrics
+    assert bench.metric_direction("cold_prefill_ms") == "lower"
+    assert bench.metric_direction("warm_prefill_ms") == "lower"
+    assert bench.metric_direction("host_cores") is None
+
+
+def test_serve_tenants_bench_smoke():
+    """--serve-legs tenants plumbing (ISSUE 17): the mixed-tenant SLO
+    record contract — realtime p99 under FIFO vs slo admission on a
+    block-starved engine, with the preemption count best-effort
+    absorbed."""
+    spec, params = bench._serve_lm(64, 160, 32, 2, 1, "f32")
+    rec = bench.run_serve_tenants_bench(
+        spec, params, 64, max_batch=4, block_size=16, n_batch=3,
+        n_rt=2, rt_gap_s=0.05, seed=0)
+    for key in ("fifo_rt_p99_ms", "slo_rt_p99_ms", "fifo_be_p99_ms",
+                "slo_be_p99_ms", "rt_p99_gain_x", "preemptions",
+                "host_cores"):
+        assert key in rec, key
+    assert rec["config"] == "serve_tenants"
+    # nothing stranded, nothing leaked, on either engine
+    for leg in ("fifo", "slo"):
+        assert rec[leg]["rt_completed"] == 2
+        assert rec[leg]["be_completed"] == 3
+        assert rec[leg]["blocks_in_use_after"] == 0
+    assert bench.metric_direction("slo_rt_p99_ms") == "lower"
+    assert bench.metric_direction("preemptions") is None

@@ -24,6 +24,7 @@ import json
 import os
 import sys
 import threading
+import time
 import warnings
 
 import numpy as np
@@ -376,12 +377,19 @@ def test_socket_correlation_stitching_with_wal(tmp_path):
             c.exchange(0, {"w": np.ones(8, np.float32)})
         corr = trace.current_corr()
         assert corr is not None and corr.startswith("w0:s")
-        evs = trace.events()
 
         def names_with(corr_):
-            return {e["name"] for e in evs if e["corr"] == corr_}
+            return {e["name"] for e in trace.events()
+                    if e["corr"] == corr_}
 
+        # The handler's ``ps.exchange`` span wraps the reply send, so it
+        # closes AFTER the client's exchange() returns — give the server
+        # thread a beat to land it before reading the event log.
+        deadline = time.monotonic() + 5.0
         got = names_with(corr)
+        while "ps.exchange" not in got and time.monotonic() < deadline:
+            time.sleep(0.01)
+            got = names_with(corr)
         assert "worker.exchange" in got
         assert "ps.fold" in got
         assert "ps.wal_append" in got
